@@ -1,0 +1,18 @@
+"""chainermn_tpu — TPU-native distributed training framework.
+
+A ground-up JAX/XLA/Pallas rebuild of the capability surface of
+levelfour/chainermn (see SURVEY.md): communicator backends lowering to XLA
+collectives over ICI/DCN, a multi-node optimizer wrapper, dataset
+scattering, synchronized/multi-node iterators, a multi-node evaluator,
+synchronized batch normalization, differentiable point-to-point and
+collective communication, a MultiNodeChainList-style model-parallel API,
+ring-attention / Ulysses sequence parallelism, and distributed
+checkpoint/resume.
+"""
+
+from chainermn_tpu.communicators import (  # noqa: F401
+    CommunicatorBase,
+    create_communicator,
+)
+
+__version__ = "0.1.0"

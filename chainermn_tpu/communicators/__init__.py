@@ -1,0 +1,85 @@
+"""Communicator factory.
+
+Reference parity: ``chainermn/communicators/__init__.py`` —
+``create_communicator(communicator_name='hierarchical', mpi_comm=None,
+allreduce_grad_dtype=None)``: string -> class dispatch.
+
+TPU-native changes: there is no ``mpi_comm`` (topology comes from
+``jax.devices()``); instead an optional ``devices=`` sequence selects the
+chips, which is also how tests run every variant on a virtual CPU mesh.
+The default name is ``'tpu'`` (the flat-ICI production backend) rather than
+``'hierarchical'``, but all reference names resolve.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+from .communicator_base import CommunicatorBase
+from .xla_communicator_base import XlaCommunicatorBase
+from ._topology import Topology
+from .variants import (
+    DummyCommunicator,
+    FlatCommunicator,
+    HierarchicalCommunicator,
+    NaiveCommunicator,
+    NonCudaAwareCommunicator,
+    SingleNodeCommunicator,
+    TpuCommunicator,
+    TwoDimensionalCommunicator,
+)
+
+_COMMUNICATORS = {
+    "tpu": TpuCommunicator,
+    # Reference names (chainermn/communicators/__init__.py dispatch table);
+    # `pure_nccl` maps to the flat-ICI backend, its moral equivalent.
+    "pure_nccl": TpuCommunicator,
+    "flat": FlatCommunicator,
+    "hierarchical": HierarchicalCommunicator,
+    "two_dimensional": TwoDimensionalCommunicator,
+    "single_node": SingleNodeCommunicator,
+    "naive": NaiveCommunicator,
+    "non_cuda_aware": NonCudaAwareCommunicator,
+    "dummy": DummyCommunicator,
+}
+
+
+def create_communicator(
+    communicator_name: str = "tpu",
+    devices: Optional[Sequence] = None,
+    allreduce_grad_dtype=None,
+) -> CommunicatorBase:
+    """Create a communicator by name.
+
+    Args:
+      communicator_name: one of ``tpu``, ``pure_nccl``, ``flat``,
+        ``hierarchical``, ``two_dimensional``, ``single_node``, ``naive``,
+        ``non_cuda_aware``, ``dummy``.
+      devices: devices to span (default: all of ``jax.devices()``).
+      allreduce_grad_dtype: optional reduced precision (e.g. ``bfloat16`` /
+        ``float16``) for gradient allreduce, as in PureNcclCommunicator.
+    """
+    try:
+        cls = _COMMUNICATORS[communicator_name]
+    except KeyError:
+        raise ValueError(
+            f"unknown communicator {communicator_name!r}; available: "
+            f"{sorted(_COMMUNICATORS)}"
+        ) from None
+    return cls(devices=devices, allreduce_grad_dtype=allreduce_grad_dtype)
+
+
+__all__ = [
+    "CommunicatorBase",
+    "XlaCommunicatorBase",
+    "Topology",
+    "create_communicator",
+    "TpuCommunicator",
+    "FlatCommunicator",
+    "HierarchicalCommunicator",
+    "TwoDimensionalCommunicator",
+    "SingleNodeCommunicator",
+    "NaiveCommunicator",
+    "NonCudaAwareCommunicator",
+    "DummyCommunicator",
+]

@@ -1,0 +1,154 @@
+"""Host-side object (control-plane) transport.
+
+Reference parity: the ``*_obj`` methods of
+``chainermn/communicators/mpi_communicator_base.py`` (pickle + chunked MPI
+send with a ~256 MB cap per message).
+
+TPU-native redesign: object traffic is *control plane*, not ICI traffic.
+
+* Single controller (``jax.process_count() == 1``): every rank lives in this
+  process, so transport is an in-memory mailbox.  ``send_obj``/``recv_obj``
+  still round-trip through pickle so that anything a multi-process run would
+  reject (unpicklable payloads) fails identically in tests.
+* Multi-process: rides ``jax.experimental.multihost_utils`` (which uses the
+  jax.distributed KV store / host collectives underneath).  Rank-addressed
+  send/recv between processes maps onto the distributed KV store.
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Any
+
+import jax
+import numpy as np
+
+from .communicator_base import dumps, loads
+
+# Chunk cap mirroring the reference's max message length for pickled sends
+# (mpi_communicator_base.py, ~256 MB).  Applies to the KV-store path.
+MAX_OBJ_CHUNK_BYTES = 256 * 1024 * 1024
+
+
+class LocalObjStore:
+    """In-process mailbox — all ranks share one controller."""
+
+    def __init__(self, size: int):
+        self._size = size
+        self._mail: dict = collections.defaultdict(collections.deque)
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        if not 0 <= dest < self._size:
+            raise ValueError(f"dest {dest} out of range for size {self._size}")
+        self._mail[(dest, tag)].append(dumps(obj))
+
+    def recv(self, source: int, tag: int = 0) -> Any:
+        del source  # single mailbox per (dest, tag) under one controller
+        box = self._mail[(self._my_rank(), tag)]
+        if not box:
+            raise RuntimeError(
+                f"recv_obj: no message pending for tag {tag} "
+                "(single-controller recv must follow the matching send)"
+            )
+        return loads(box.popleft())
+
+    def recv_for(self, dest: int, tag: int = 0) -> Any:
+        box = self._mail[(dest, tag)]
+        if not box:
+            raise RuntimeError(f"recv_obj: no message for rank {dest}/tag {tag}")
+        return loads(box.popleft())
+
+    def _my_rank(self) -> int:
+        return 0
+
+    def bcast(self, obj: Any, root: int = 0) -> Any:
+        del root
+        return loads(dumps(obj))
+
+    def gather(self, obj: Any, root: int = 0) -> list:
+        del root
+        return [loads(dumps(obj)) for _ in range(self._size)]
+
+    def allgather(self, obj: Any) -> list:
+        return [loads(dumps(obj)) for _ in range(self._size)]
+
+
+class MultiprocessObjStore:
+    """Cross-process object transport over the jax.distributed control plane.
+
+    Collective ops (bcast/gather/allgather) use ``multihost_utils`` host
+    collectives on the pickled payload; addressed send/recv uses the
+    KV store exposed by the distributed client.
+    """
+
+    def __init__(self, size: int):
+        self._size = size
+        self._seq = collections.Counter()
+
+    # -- collectives ---------------------------------------------------
+    def _host_allgather_bytes(self, payload: bytes) -> list:
+        from jax.experimental import multihost_utils
+
+        nproc = jax.process_count()
+        length = np.array([len(payload)], np.int64)
+        lengths = multihost_utils.process_allgather(length).reshape(-1)
+        maxlen = int(lengths.max())
+        buf = np.zeros((maxlen,), np.uint8)
+        arr = np.frombuffer(payload, np.uint8)
+        buf[: arr.size] = arr
+        gathered = multihost_utils.process_allgather(buf)
+        return [
+            gathered[p, : int(lengths[p])].tobytes() for p in range(nproc)
+        ]
+
+    def bcast(self, obj: Any, root: int = 0) -> Any:
+        del root  # process 0 is the broadcast source, as in bcast_data
+        from jax.experimental import multihost_utils
+
+        payloads = self._host_allgather_bytes(dumps(obj))
+        return loads(payloads[0])
+
+    def allgather(self, obj: Any) -> list:
+        return [loads(p) for p in self._host_allgather_bytes(dumps(obj))]
+
+    def gather(self, obj: Any, root: int = 0) -> list:
+        return self.allgather(obj)
+
+    # -- addressed send/recv over the KV store -------------------------
+    def _kv(self):
+        from jax._src import distributed
+
+        client = distributed.global_state.client
+        if client is None:
+            raise RuntimeError(
+                "multi-process obj transport requires jax.distributed."
+                "initialize()"
+            )
+        return client
+
+    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        payload = dumps(obj)
+        key = f"cmn_obj/{jax.process_index()}->{dest}/{tag}/{self._seq[(dest, tag)]}"
+        self._seq[(dest, tag)] += 1
+        client = self._kv()
+        for i in range(0, max(len(payload), 1), MAX_OBJ_CHUNK_BYTES):
+            chunk = payload[i : i + MAX_OBJ_CHUNK_BYTES]
+            client.key_value_set_bytes(f"{key}/{i}", chunk)
+        client.key_value_set_bytes(f"{key}/len", str(len(payload)).encode())
+
+    def recv(self, source: int, tag: int = 0) -> Any:
+        key = f"cmn_obj/{source}->{jax.process_index()}/{tag}/{self._seq[('r', source, tag)]}"
+        self._seq[("r", source, tag)] += 1
+        client = self._kv()
+        total = int(client.blocking_key_value_get_bytes(f"{key}/len", 600_000))
+        payload = b"".join(
+            client.blocking_key_value_get_bytes(f"{key}/{i}", 600_000)
+            for i in range(0, max(total, 1), MAX_OBJ_CHUNK_BYTES)
+        )
+        return loads(payload[:total])
+
+
+def create_obj_store(size: int, process_count: int = 1):
+    if process_count > 1:
+        return MultiprocessObjStore(size)
+    return LocalObjStore(size)

@@ -1,0 +1,118 @@
+"""Device/rank topology discovery for TPU meshes.
+
+Reference parity: ``chainermn/communicators/_communication_utility.py``
+(``init_ranks`` — hostname allgather -> intra/inter rank derivation).  On TPU
+there is no hostname grouping: the pod topology is discoverable directly from
+``jax.devices()`` (slice index, process index, chip coords), so ``init_ranks``
+becomes a pure function of the device list.
+
+Rank model (mirrors ChainerMN's):
+
+* ``rank``       — global index of a chip in the communicator's device order.
+* ``intra_rank`` — index of the chip *within its node*.  A "node" on TPU is a
+  slice (preferred, ICI-connected island) or, failing that, a host process.
+* ``inter_rank`` — index of the node itself.
+
+ChainerMN derived these by all-gathering hostnames over MPI
+(``_communication_utility.init_ranks``); here they are derived from device
+attributes with no communication at all.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Sequence
+
+import numpy as np
+
+
+def _node_key(device: Any) -> Any:
+    """Grouping key that plays the role of ChainerMN's hostname.
+
+    Prefer the TPU slice index (chips within a slice are ICI-connected, the
+    moral equivalent of "same node" for collective topology); fall back to the
+    owning host process.
+    """
+    slice_index = getattr(device, "slice_index", None)
+    if slice_index is not None:
+        return ("slice", slice_index)
+    return ("process", device.process_index)
+
+
+def sort_devices(devices: Sequence[Any]) -> list[Any]:
+    """Canonical device order: by node, then by id within the node.
+
+    This guarantees that ``intra_rank`` ranges are contiguous in ``rank``
+    order, which is what the hierarchical communicators rely on (ChainerMN got
+    the same property from ``mpi_comm.Split`` by hostname color).
+    """
+
+    def key(d: Any) -> tuple:
+        nk = _node_key(d)
+        coords = getattr(d, "coords", None)
+        coords = tuple(coords) if coords is not None else ()
+        return (nk, coords, d.id)
+
+    return sorted(devices, key=key)
+
+
+@dataclasses.dataclass(frozen=True)
+class Topology:
+    """Immutable rank/topology table for a set of devices.
+
+    Parity: the rank attributes of ``CommunicatorBase``
+    (chainermn/communicators/communicator_base.py — ``rank``, ``size``,
+    ``intra_rank``, ``intra_size``, ``inter_rank``, ``inter_size``).
+    """
+
+    devices: tuple  # canonical order; index == rank
+    node_keys: tuple  # node key per rank
+    intra_ranks: tuple  # intra-node rank per rank
+    inter_ranks: tuple  # node index per rank
+    intra_sizes: tuple  # size of each rank's node
+    inter_size: int
+
+    @classmethod
+    def create(cls, devices: Sequence[Any]) -> "Topology":
+        devs = sort_devices(devices)
+        keys = [_node_key(d) for d in devs]
+        unique_keys: list = []
+        for k in keys:
+            if k not in unique_keys:
+                unique_keys.append(k)
+        inter_ranks = [unique_keys.index(k) for k in keys]
+        counts: dict = {}
+        intra_ranks = []
+        for k in keys:
+            intra_ranks.append(counts.get(k, 0))
+            counts[k] = counts.get(k, 0) + 1
+        intra_sizes = [counts[k] for k in keys]
+        return cls(
+            devices=tuple(devs),
+            node_keys=tuple(keys),
+            intra_ranks=tuple(intra_ranks),
+            inter_ranks=tuple(inter_ranks),
+            intra_sizes=tuple(intra_sizes),
+            inter_size=len(unique_keys),
+        )
+
+    @property
+    def size(self) -> int:
+        return len(self.devices)
+
+    def is_uniform(self) -> bool:
+        """True if every node holds the same number of chips (required by the
+        hierarchical / two-dimensional layouts, as in ChainerMN)."""
+        return len(set(self.intra_sizes)) <= 1
+
+    def device_grid(self) -> np.ndarray:
+        """Devices as an (inter_size, intra_size) grid for 2-D meshes."""
+        if not self.is_uniform():
+            raise ValueError(
+                "hierarchical topology requires the same number of chips per "
+                f"node; got intra sizes {sorted(set(self.intra_sizes))}"
+            )
+        intra = self.intra_sizes[0] if self.devices else 0
+        return np.array(self.devices, dtype=object).reshape(
+            self.inter_size, intra
+        )

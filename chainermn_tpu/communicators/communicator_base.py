@@ -1,0 +1,282 @@
+"""Abstract communicator API.
+
+Reference parity: ``chainermn/communicators/communicator_base.py``
+(``CommunicatorBase`` — properties ``rank``/``size``/``intra_rank``/
+``intra_size``/``inter_rank``/``inter_size``; collectives ``bcast``,
+``allreduce``, ``send``, ``recv``, ``gather``, ``allgather``, ``alltoall``,
+``split``; pickled ``*_obj`` variants; model-level ``bcast_data`` and
+``allreduce_grad``).
+
+TPU-native redesign
+-------------------
+ChainerMN is MPI-SPMD: every *rank* is a separate process holding its own
+array, and a collective is a blocking call into mpi4py/NCCL.  JAX on TPU is
+single-controller SPMD: one Python process drives many chips, arrays are
+*global* (sharded across a ``jax.sharding.Mesh``), and collectives are XLA
+ops (``psum``/``all_gather``/``ppermute``/``all_to_all``) compiled into a
+program that runs on every chip over ICI.
+
+The eager API therefore works on **stacked arrays**: an array whose leading
+axis is the rank axis, sharded one-slice-per-chip over the communicator's
+mesh.  ``x[r]`` is "rank r's value".  ``allreduce(x)`` returns a stacked
+array in which every slice holds the reduction — exactly the post-state of
+``MPI_Allreduce`` across ranks.  This keeps ChainerMN's per-rank semantics
+testable in one process while the hot path (see ``optimizers.py``) stays
+fully compiled.
+
+Two tiers (SURVEY.md section 7):
+
+* *Compiled tier*: training steps are jitted; gradient sync is ``psum`` over
+  ``comm.axis_names`` inside the program.  This is the performance path.
+* *Eager tier* (this API): each collective is a tiny cached-jit program over
+  the same mesh — the ChainerMN-shaped escape hatch and test surface.
+"""
+
+from __future__ import annotations
+
+import pickle
+from typing import Any, Callable, Mapping, Optional, Sequence
+
+import jax
+import numpy as np
+
+# Reductions supported by `allreduce`.  ChainerMN's MPI backend exposes sum
+# (and mean via allreduce_grad's divide); we add the other XLA reductions.
+REDUCE_OPS = ("sum", "mean", "max", "min", "prod")
+
+
+class CommunicatorBase:
+    """Abstract base class of all communicators.
+
+    Concrete subclasses implement the array collectives; object (pickle)
+    transport and model-level helpers are implemented here on top of them.
+    """
+
+    def __init__(self, topology):
+        self._topology = topology
+        self._obj_store = None  # set by subclasses / factory
+
+    # ------------------------------------------------------------------
+    # Rank model (parity: CommunicatorBase properties)
+    # ------------------------------------------------------------------
+    @property
+    def topology(self):
+        return self._topology
+
+    @property
+    def devices(self) -> tuple:
+        return self._topology.devices
+
+    @property
+    def size(self) -> int:
+        """Number of chips in this communicator (ChainerMN: #processes)."""
+        return self._topology.size
+
+    @property
+    def platform(self) -> str:
+        """Backend platform of this communicator's devices.  Always passed
+        explicitly to process queries so creating a communicator over CPU
+        devices never initializes (or blocks on) an accelerator backend."""
+        return self.devices[0].platform if self.devices else "cpu"
+
+    @property
+    def process_index(self) -> int:
+        return jax.process_index(backend=self.platform)
+
+    @property
+    def process_count(self) -> int:
+        return jax.process_count(backend=self.platform)
+
+    @property
+    def rank(self) -> int:
+        """Rank of *this controller process's first device*.
+
+        In single-controller SPMD one process owns every rank, so "my rank"
+        is not unique the way it is under MPI.  For data-loading decisions
+        (the main use of ``comm.rank`` in ChainerMN scripts) the meaningful
+        quantity is the process index; ``local_ranks`` gives the full set.
+        """
+        pid = self.process_index
+        for i, d in enumerate(self.devices):
+            if d.process_index == pid:
+                return i
+        return 0
+
+    @property
+    def local_ranks(self) -> tuple:
+        """Ranks whose devices are addressable from this process."""
+        pid = self.process_index
+        return tuple(
+            i for i, d in enumerate(self.devices) if d.process_index == pid
+        )
+
+    @property
+    def intra_rank(self) -> int:
+        return self._topology.intra_ranks[self.rank]
+
+    @property
+    def intra_size(self) -> int:
+        return self._topology.intra_sizes[self.rank] if self.size else 0
+
+    @property
+    def inter_rank(self) -> int:
+        return self._topology.inter_ranks[self.rank]
+
+    @property
+    def inter_size(self) -> int:
+        return self._topology.inter_size
+
+    # ------------------------------------------------------------------
+    # Array collectives (abstract; stacked-array semantics)
+    # ------------------------------------------------------------------
+    def allreduce(self, x, op: str = "sum"):
+        """Stacked (size, ...) -> stacked (size, ...), every slice = reduce."""
+        raise NotImplementedError
+
+    def bcast(self, x, root: int = 0):
+        """Stacked (size, ...) -> stacked; every slice = x[root]."""
+        raise NotImplementedError
+
+    def gather(self, x, root: int = 0):
+        """Stacked (size, ...) -> (size, ...) materialized on root's device."""
+        raise NotImplementedError
+
+    def allgather(self, x):
+        """Stacked (size, ...) -> (size, ...) replicated on every device."""
+        raise NotImplementedError
+
+    def scatter(self, x, root: int = 0):
+        """(size, ...) on root -> stacked (size, ...), one slice per rank."""
+        raise NotImplementedError
+
+    def alltoall(self, x):
+        """Stacked (size, size, ...); out[j, i] = in[i, j]."""
+        raise NotImplementedError
+
+    def send(self, x, dest: int, source: int):
+        """Move slice ``source`` of a stacked array to rank ``dest``.
+
+        Unlike MPI there is no ambient "my rank", so the source is explicit.
+        Returns a stacked array whose ``dest`` slice holds the payload.
+        """
+        raise NotImplementedError
+
+    def recv(self, x, source: int, dest: int):
+        """Transpose view of :meth:`send`; provided for API parity."""
+        return self.send(x, dest=dest, source=source)
+
+    def reduce_scatter(self, x, op: str = "sum"):
+        """Stacked (size, n) -> stacked; slice r = reduce of column-block r."""
+        raise NotImplementedError
+
+    def barrier(self) -> None:
+        """Synchronize all processes (no-op within one controller)."""
+        if self.process_count > 1:
+            from jax.experimental import multihost_utils
+
+            multihost_utils.sync_global_devices("chainermn_tpu_barrier")
+
+    # ------------------------------------------------------------------
+    # split (parity: CommunicatorBase.split via mpi_comm.Split)
+    # ------------------------------------------------------------------
+    def split(self, colors: Sequence[int], keys: Optional[Sequence[int]] = None
+              ) -> Mapping[int, "CommunicatorBase"]:
+        """Partition into sub-communicators.
+
+        ChainerMN's ``split(color, key)`` is called with per-process scalars;
+        under a single controller the caller holds *all* ranks, so colors is
+        a length-``size`` sequence and the result is ``{color: sub_comm}``
+        covering every group (each sub-communicator is fully usable since all
+        devices are addressable).
+        """
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    # Object (control-plane) transport — parity: send_obj/recv_obj/
+    # bcast_obj/gather_obj/allreduce_obj (pickled, chunked MPI messages).
+    # On TPU these ride the host control plane, never ICI.
+    # ------------------------------------------------------------------
+    def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
+        self._obj_store.send(obj, dest=dest, tag=tag)
+
+    def recv_obj(self, source: int, tag: int = 0) -> Any:
+        return self._obj_store.recv(source=source, tag=tag)
+
+    def bcast_obj(self, obj: Any, root: int = 0) -> Any:
+        return self._obj_store.bcast(obj, root=root)
+
+    def gather_obj(self, obj: Any, root: int = 0) -> list:
+        return self._obj_store.gather(obj, root=root)
+
+    def allgather_obj(self, obj: Any) -> list:
+        return self._obj_store.allgather(obj)
+
+    def allreduce_obj(self, obj: Any, op: Callable = None) -> Any:
+        objs = self._obj_store.allgather(obj)
+        if op is None:
+            out = objs[0]
+            for o in objs[1:]:
+                out = out + o
+            return out
+        return op(objs)
+
+    # ------------------------------------------------------------------
+    # Model-level helpers (parity: bcast_data / allreduce_grad)
+    # ------------------------------------------------------------------
+    def bcast_data(self, tree):
+        """Replicate a parameter pytree across every device of this
+        communicator (parity: ``bcast_data(model)`` — initial weight sync).
+
+        Under multi-process, additionally broadcasts process 0's values so
+        all controllers agree bit-for-bit.
+        """
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        if self.process_count > 1:
+            from jax.experimental import multihost_utils
+
+            tree = multihost_utils.broadcast_one_to_all(tree)
+        sharding = NamedSharding(self.mesh, PartitionSpec())
+        return jax.device_put(tree, sharding)
+
+    def allreduce_grad(self, grads, *, mean: bool = True):
+        """Average a pytree of *stacked* gradients over the rank axis.
+
+        Parity: ``CommunicatorBase.allreduce_grad(model)`` — the data-parallel
+        gradient sync.  The compiled path does this inside the jitted train
+        step (see ``optimizers.py``); this eager form exists for
+        ChainerMN-shaped scripts and tests.
+        """
+        op = "mean" if mean else "sum"
+        return jax.tree_util.tree_map(lambda g: self.allreduce(g, op=op), grads)
+
+    # `mesh` is provided by concrete XLA-backed subclasses; declared here so
+    # helpers above can rely on it.
+    @property
+    def mesh(self):
+        raise NotImplementedError
+
+    @property
+    def axis_names(self) -> tuple:
+        """Mesh axis names to ``psum`` over for a full-communicator reduce."""
+        raise NotImplementedError
+
+    # ------------------------------------------------------------------
+    def finalize(self) -> None:
+        """Release resources (parity: MPI communicator teardown)."""
+
+    def __repr__(self):
+        return (
+            f"<{type(self).__name__} size={self.size} "
+            f"inter={self.inter_size}x{self.intra_size if self.size else 0}>"
+        )
+
+
+def dumps(obj: Any) -> bytes:
+    """Pickle helper shared by object-transport backends (parity:
+    chunked-pickle protocol of ``mpi_communicator_base.py``)."""
+    return pickle.dumps(obj, protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def loads(data: bytes) -> Any:
+    return pickle.loads(data)

@@ -1,0 +1,9 @@
+from .scatter_dataset import scatter_dataset, scatter_index, SubDataset  # noqa: F401
+from .empty_dataset import create_empty_dataset  # noqa: F401
+
+__all__ = [
+    "scatter_dataset",
+    "scatter_index",
+    "SubDataset",
+    "create_empty_dataset",
+]

@@ -1,0 +1,29 @@
+"""Empty dataset stub.
+
+Reference parity: ``chainermn/datasets/empty_dataset.py`` —
+``create_empty_dataset(dataset)``: a length-preserving dataset of ``None``s
+for ranks that only consume activations in model-parallel execution (they
+must still iterate the same number of steps as data-holding ranks).
+"""
+
+from __future__ import annotations
+
+
+class _EmptyDataset:
+    def __init__(self, length: int):
+        self._length = length
+
+    def __len__(self) -> int:
+        return self._length
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [None] * len(range(*i.indices(self._length)))
+        if not -self._length <= i < self._length:
+            raise IndexError(i)
+        return None
+
+
+def create_empty_dataset(dataset):
+    """Length-preserving stub of ``None``s (see module docstring)."""
+    return _EmptyDataset(len(dataset))

@@ -1,0 +1,48 @@
+"""Multi-node iterator.
+
+Reference parity: ``chainermn/iterators/_multi_node_iterator.py`` —
+``create_multi_node_iterator(actual_iterator, comm, rank_master=0)``: the
+master rank iterates the real dataset and broadcasts each batch; slave
+ranks receive, so *all* ranks see identical batches (the model-parallel
+pattern where every pipeline stage needs the same input stream).
+
+TPU-native redesign: under a single controller, every model-parallel rank
+already shares the host process, so "broadcast each batch" is: master
+iterator draws the batch, and it is device_put replicated (or sharded along
+model axes) over the mesh.  Under multi-process, the batch is broadcast
+over the control plane so all processes feed identical arrays — the
+same guarantee the MPI bcast gave, then placed as a global array.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+import jax
+
+
+class _MultiNodeIterator:
+    def __init__(self, actual_iterator, comm, rank_master: int = 0):
+        self._it = actual_iterator
+        self._comm = comm
+        self._rank_master = rank_master
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        batch = next(self._it)
+        if self._comm.process_count > 1:
+            # Make every controller agree on the master's batch
+            # (parity: per-batch MPI bcast from rank_master).
+            batch = self._comm.bcast_obj(batch, root=self._rank_master)
+        return batch
+
+    next = __next__
+
+    def __getattr__(self, name):
+        return getattr(self._it, name)
+
+
+def create_multi_node_iterator(actual_iterator, comm, rank_master: int = 0):
+    """All ranks receive the master's batch stream (see module docstring)."""
+    return _MultiNodeIterator(actual_iterator, comm, rank_master)

@@ -1,0 +1,109 @@
+"""Minimal epoch-aware batch iterator.
+
+The reference leaned on Chainer's ``SerialIterator``/``MultiprocessIterator``
+(external to chainermn); this framework needs its own host-side iterator to
+hang the multi-node/synchronized wrappers on.  It yields stacked NumPy
+batches ready for ``jax.device_put`` onto a data-sharded mesh.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import numpy as np
+
+
+def _collate(samples):
+    first = samples[0]
+    if isinstance(first, tuple):
+        return tuple(
+            np.stack([np.asarray(s[i]) for s in samples])
+            for i in range(len(first))
+        )
+    if isinstance(first, dict):
+        return {
+            k: np.stack([np.asarray(s[k]) for s in samples]) for k in first
+        }
+    if first is None:
+        return None
+    return np.stack([np.asarray(s) for s in samples])
+
+
+class SerialIterator:
+    def __init__(self, dataset, batch_size: int, *, repeat: bool = True,
+                 shuffle: bool = True, seed: Optional[int] = None,
+                 drop_last: bool = True):
+        self.dataset = dataset
+        self.batch_size = batch_size
+        self._repeat = repeat
+        self._shuffle = shuffle
+        self._drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+        self.epoch = 0
+        self.is_new_epoch = False
+        self._pos = 0
+        self._order = self._new_order()
+
+    def _new_order(self):
+        n = len(self.dataset)
+        return self._rng.permutation(n) if self._shuffle else np.arange(n)
+
+    def reset(self):
+        self.epoch = 0
+        self._pos = 0
+        self.is_new_epoch = False
+        self._order = self._new_order()
+
+    @property
+    def epoch_detail(self) -> float:
+        return self.epoch + self._pos / max(len(self.dataset), 1)
+
+    def __iter__(self):
+        return self
+
+    def __next__(self):
+        n = len(self.dataset)
+        if self._pos >= n or (self._drop_last and self._pos + self.batch_size > n):
+            if not self._repeat and self.epoch >= 0 and self._pos > 0:
+                raise StopIteration
+            self.epoch += 1
+            self.is_new_epoch = True
+            self._pos = 0
+            self._order = self._new_order()
+        else:
+            self.is_new_epoch = False
+        if not self._repeat and self.epoch > 0 and self._pos == 0 and self.epoch > 1:
+            raise StopIteration
+        idx = self._order[self._pos : self._pos + self.batch_size]
+        self._pos += self.batch_size
+        return _collate([self.dataset[int(i)] for i in idx])
+
+    next = __next__
+
+    def serialize(self):
+        return {
+            "epoch": self.epoch,
+            "pos": self._pos,
+            "order": self._order.tolist(),
+            "rng": self._rng.get_state()[1].tolist(),
+        }
+
+    def restore(self, state):
+        self.epoch = state["epoch"]
+        self._pos = state["pos"]
+        self._order = np.asarray(state["order"])
+
+
+class EpochIterator:
+    """Non-repeating pass over a dataset (used by the evaluator)."""
+
+    def __init__(self, dataset, batch_size: int):
+        self.dataset = dataset
+        self.batch_size = batch_size
+
+    def __iter__(self):
+        n = len(self.dataset)
+        for start in range(0, n, self.batch_size):
+            yield _collate(
+                [self.dataset[i] for i in range(start, min(start + self.batch_size, n))]
+            )

@@ -1,0 +1,28 @@
+"""Synchronized iterator.
+
+Reference parity: ``chainermn/iterators/_synchronized_iterator.py`` —
+``create_synchronized_iterator(actual_iterator, comm)``: broadcast the RNG
+seed from rank 0 so every rank draws the same shuffle order each epoch.
+
+TPU-native form: the seed agreement rides the control plane
+(``bcast_obj``); the iterator is then re-seeded identically on every
+process.  Under one controller this is trivially satisfied but still
+exercised so tests match multi-process behavior.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def create_synchronized_iterator(actual_iterator, comm):
+    """Re-seed ``actual_iterator`` with a communicator-agreed seed."""
+    seed = int(np.random.randint(0, 2**31 - 1))
+    seed = comm.bcast_obj(seed, root=0)
+    rng = np.random.RandomState(seed)
+    # Re-seed in place: the iterator draws every epoch's order from _rng.
+    if hasattr(actual_iterator, "_rng"):
+        actual_iterator._rng = rng
+        if hasattr(actual_iterator, "reset"):
+            actual_iterator.reset()
+    return actual_iterator

@@ -1,0 +1,10 @@
+from .multi_node_batch_normalization import MultiNodeBatchNormalization  # noqa: F401
+from .create_mnbn_model import create_mnbn_model  # noqa: F401
+from .n_step_rnn import create_multi_node_n_step_rnn, MultiNodeNStepRNN  # noqa: F401
+
+__all__ = [
+    "MultiNodeBatchNormalization",
+    "create_mnbn_model",
+    "create_multi_node_n_step_rnn",
+    "MultiNodeNStepRNN",
+]

@@ -1,0 +1,59 @@
+"""Recursively replace BatchNorm with MultiNodeBatchNormalization.
+
+Reference parity: ``chainermn/links/create_mnbn_model.py`` —
+``create_mnbn_model(link, comm)``: clone a model, substituting every
+``BatchNormalization`` child with ``MultiNodeBatchNormalization``.
+
+TPU-native form: flax modules are immutable dataclass pytrees, so instead
+of cloning a mutable link tree we rebuild the module with
+``nn.BatchNorm``-typed fields/submodules swapped.  Because flax modules
+declare submodules in ``setup``/``__call__`` rather than as runtime
+children, wholesale substitution is done by a module transform: models in
+``chainermn_tpu.models`` accept a ``norm`` factory argument, and
+``create_mnbn_model`` returns the same model class re-parameterized with a
+MultiNodeBatchNormalization factory bound to the communicator's axes.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Any, Optional
+
+from flax import linen as nn
+
+from .multi_node_batch_normalization import MultiNodeBatchNormalization
+
+
+def mnbn_factory(comm, **bn_kwargs):
+    """A ``norm`` factory usable by models: ``norm(size) -> Module``."""
+
+    def make(size: int, **kw):
+        merged = dict(bn_kwargs)
+        merged.update(kw)
+        return MultiNodeBatchNormalization(
+            size=size, axis_name=comm.axis_names, **merged
+        )
+
+    return make
+
+
+def create_mnbn_model(model: nn.Module, comm, **bn_kwargs) -> nn.Module:
+    """Return ``model`` with synchronized batch normalization.
+
+    Works with any model exposing a ``norm`` dataclass field (the convention
+    used throughout ``chainermn_tpu.models``); for foreign modules with an
+    ``axis_name`` field on their BatchNorms, those are rebound instead.
+    """
+    if hasattr(model, "norm"):
+        return dataclasses.replace(model, norm=mnbn_factory(comm, **bn_kwargs))
+    if isinstance(model, (nn.BatchNorm,)):
+        return MultiNodeBatchNormalization(
+            size=model.num_features if hasattr(model, "num_features") else 0,
+            axis_name=comm.axis_names,
+        )
+    raise TypeError(
+        f"cannot convert {type(model).__name__}: expected a model with a "
+        "`norm` factory field (chainermn_tpu.models convention) or a "
+        "flax BatchNorm"
+    )

@@ -1,0 +1,5 @@
+from .trainer import Trainer, Updater  # noqa: F401
+from .triggers import IntervalTrigger, get_trigger  # noqa: F401
+from . import extensions  # noqa: F401
+
+__all__ = ["Trainer", "Updater", "IntervalTrigger", "get_trigger", "extensions"]

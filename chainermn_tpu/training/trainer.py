@@ -1,0 +1,150 @@
+"""Training loop: Updater + Trainer.
+
+The reference has no trainer of its own — ChainerMN plugs into Chainer's
+``Trainer``/``StandardUpdater`` (SURVEY.md section 3.2: ``trainer.run() ->
+StandardUpdater.update_core -> optimizer.update``).  A standalone framework
+needs the loop itself, so this module provides a minimal functional
+equivalent: the Updater owns (params, opt_state, step_fn); the Trainer owns
+the iteration/epoch bookkeeping, extensions, and reporting.
+
+TPU-native properties: the per-iteration work is ONE jitted SPMD step (built
+by ``optimizers.build_train_step``); the loop never blocks on device results
+unless an extension asks for them (async dispatch keeps the TPU busy while
+the host prepares the next batch).
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Callable, Dict, Optional
+
+import jax
+import numpy as np
+
+from .triggers import get_trigger
+
+
+class Updater:
+    """Owns the train state and applies one compiled step per iteration."""
+
+    def __init__(self, iterator, step_fn: Callable, params, opt_state,
+                 *, batch_sharding=None):
+        self.iterator = iterator
+        self.step_fn = step_fn
+        self.params = params
+        self.opt_state = opt_state
+        self.batch_sharding = batch_sharding or getattr(
+            step_fn, "batch_sharding", None
+        )
+        self.last_metrics: Dict[str, Any] = {}
+
+    @property
+    def epoch(self) -> int:
+        return getattr(self.iterator, "epoch", 0)
+
+    @property
+    def epoch_detail(self) -> float:
+        return getattr(self.iterator, "epoch_detail", 0.0)
+
+    def update(self) -> None:
+        batch = next(self.iterator)
+        if self.batch_sharding is not None:
+            batch = jax.device_put(batch, self.batch_sharding)
+        self.params, self.opt_state, self.last_metrics = self.step_fn(
+            self.params, self.opt_state, batch
+        )
+
+
+class _ExtensionEntry:
+    def __init__(self, ext, trigger, priority: int, name: str):
+        self.ext = ext
+        self.trigger = get_trigger(trigger)
+        self.priority = priority
+        self.name = name
+
+
+class Trainer:
+    """Runs the updater until a stop condition, firing extensions.
+
+    Stop condition mirrors Chainer: ``stop_trigger=(n, 'epoch'|'iteration')``.
+    Extension protocol: a callable ``ext(trainer)``; optional attributes
+    ``trigger`` (default each epoch), ``priority``, ``initialize(trainer)``,
+    ``finalize(trainer)``.
+    """
+
+    def __init__(self, updater: Updater, stop_trigger=(1, "epoch"),
+                 out: str = "result"):
+        self.updater = updater
+        self.stop_n, self.stop_unit = stop_trigger
+        self.out = out
+        self.iteration = 0
+        self.observation: Dict[str, Any] = {}
+        self._extensions: list[_ExtensionEntry] = []
+        self._start_time: Optional[float] = None
+
+    # -- extension management -----------------------------------------
+    def extend(self, ext, trigger=None, priority: Optional[int] = None,
+               name: Optional[str] = None):
+        trigger = trigger if trigger is not None else getattr(
+            ext, "trigger", (1, "epoch")
+        )
+        priority = priority if priority is not None else getattr(
+            ext, "priority", 100
+        )
+        name = name or getattr(ext, "name", None) or type(ext).__name__
+        self._extensions.append(_ExtensionEntry(ext, trigger, priority, name))
+        return self
+
+    def get_extension(self, name: str):
+        for e in self._extensions:
+            if e.name == name:
+                return e.ext
+        raise KeyError(name)
+
+    # -- loop ----------------------------------------------------------
+    @property
+    def epoch(self) -> int:
+        return self.updater.epoch
+
+    @property
+    def elapsed_time(self) -> float:
+        return time.time() - (self._start_time or time.time())
+
+    def _stop(self) -> bool:
+        if self.stop_unit == "iteration":
+            return self.iteration >= self.stop_n
+        return self.updater.epoch >= self.stop_n
+
+    def run(self) -> None:
+        self._start_time = time.time()
+        for e in self._extensions:
+            init = getattr(e.ext, "initialize", None)
+            if init:
+                init(self)
+        exts = sorted(self._extensions, key=lambda e: -e.priority)
+        while not self._stop():
+            self.updater.update()
+            self.iteration += 1
+            self.observation = {
+                k: v for k, v in (self.updater.last_metrics or {}).items()
+            }
+            for e in exts:
+                if e.trigger(self):
+                    e.ext(self)
+        for e in self._extensions:
+            fin = getattr(e.ext, "finalize", None)
+            if fin:
+                fin(self)
+
+    # -- state (for checkpointing) -------------------------------------
+    def state_dict(self) -> Dict[str, Any]:
+        return {
+            "iteration": self.iteration,
+            "iterator": self.updater.iterator.serialize()
+            if hasattr(self.updater.iterator, "serialize") else None,
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.iteration = state["iteration"]
+        if state.get("iterator") and hasattr(self.updater.iterator, "restore"):
+            self.updater.iterator.restore(state["iterator"])

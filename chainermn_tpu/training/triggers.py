@@ -1,0 +1,43 @@
+"""Trigger objects deciding when trainer extensions fire.
+
+The reference delegated this to Chainer's trainer
+(``trainer.extend(ext, trigger=(1, 'epoch'))``); this framework carries its
+own minimal implementation so the multi-node extensions (evaluator,
+checkpointer, reports) have the same ergonomics.
+"""
+
+from __future__ import annotations
+
+
+class IntervalTrigger:
+    def __init__(self, period: int, unit: str = "epoch"):
+        if unit not in ("epoch", "iteration"):
+            raise ValueError(f"unit must be epoch|iteration, got {unit!r}")
+        self.period = period
+        self.unit = unit
+        self._last_fired = 0
+
+    def __call__(self, trainer) -> bool:
+        if self.unit == "iteration":
+            count = trainer.iteration
+        else:
+            count = trainer.epoch
+        if count - self._last_fired >= self.period:
+            self._last_fired = count
+            return True
+        return False
+
+    def state(self):
+        return {"last_fired": self._last_fired}
+
+    def restore(self, state):
+        self._last_fired = state["last_fired"]
+
+
+def get_trigger(trigger) -> IntervalTrigger:
+    if isinstance(trigger, IntervalTrigger):
+        return trigger
+    if trigger is None:
+        return IntervalTrigger(1, "epoch")
+    period, unit = trigger
+    return IntervalTrigger(period, unit)

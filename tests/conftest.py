@@ -1,0 +1,43 @@
+"""Test harness configuration.
+
+Mirrors the reference's "real small world, no mocks" strategy (SURVEY.md
+section 4): instead of `mpiexec -n 8 pytest`, we run every communicator
+against a *real* 8-device mesh — virtual CPU devices created via
+``--xla_force_host_platform_device_count`` — so collectives execute real
+XLA programs, not stubs.  Env vars must be set before jax initializes.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+# Force the CPU backend.  Site plugins may pre-import jax with
+# JAX_PLATFORMS pointing at an accelerator; the config update (not the env
+# var) is what reliably keeps tests off the real TPU so they never contend
+# for the chip.
+jax.config.update("jax_platforms", "cpu")
+
+
+def cpu_devices(n=8):
+    devs = jax.devices("cpu")
+    if len(devs) < n:
+        pytest.skip(f"need {n} cpu devices, have {len(devs)}")
+    return devs[:n]
+
+
+@pytest.fixture(scope="session")
+def devices8():
+    return cpu_devices(8)
+
+
+@pytest.fixture(scope="session")
+def mesh8(devices8):
+    import numpy as np
+    from jax.sharding import Mesh
+
+    return Mesh(np.array(devices8), ("mn",))

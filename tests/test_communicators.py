@@ -1,0 +1,201 @@
+"""Communicator test matrix.
+
+Parity: ``tests/chainermn_tests/communicator_tests/test_communicator.py`` —
+one parametrized suite run against every communicator variant, checking
+bcast/allreduce numerics, send/recv round-trips, obj variants, split.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+import chainermn_tpu as cmn
+from chainermn_tpu.communicators import create_communicator
+
+ALL_NAMES = [
+    "tpu", "pure_nccl", "flat", "hierarchical", "two_dimensional",
+    "single_node", "naive", "non_cuda_aware",
+]
+# `dummy` intentionally does no exchange; tested separately.
+
+
+@pytest.fixture(params=ALL_NAMES, scope="module")
+def comm(request, devices8):
+    return create_communicator(request.param, devices=devices8)
+
+
+def _stack(comm, shape=(3,), dtype=np.float32, seed=0):
+    rng = np.random.RandomState(seed)
+    return jnp.asarray(
+        rng.randn(comm.size, *shape).astype(dtype)
+    )
+
+
+class TestRankModel:
+    def test_size_and_ranks(self, comm):
+        assert comm.size == 8
+        assert comm.inter_size * comm.intra_size == comm.size or (
+            comm.inter_size == 1
+        )
+        assert 0 <= comm.rank < comm.size
+        assert comm.local_ranks == tuple(range(8))
+
+    def test_topology_consistency(self, comm):
+        t = comm.topology
+        assert len(t.devices) == 8
+        assert t.inter_size >= 1
+        for r in range(8):
+            assert 0 <= t.intra_ranks[r] < t.intra_sizes[r]
+
+
+class TestCollectives:
+    def test_allreduce_sum(self, comm):
+        x = _stack(comm)
+        out = np.asarray(comm.allreduce(x, op="sum"))
+        expect = np.asarray(x).sum(axis=0)
+        for r in range(comm.size):
+            np.testing.assert_allclose(out[r], expect, rtol=1e-5)
+
+    def test_allreduce_mean_max_min(self, comm):
+        x = _stack(comm, seed=1)
+        h = np.asarray(x)
+        for op, ref in [("mean", h.mean(0)), ("max", h.max(0)), ("min", h.min(0))]:
+            out = np.asarray(comm.allreduce(x, op=op))
+            for r in range(comm.size):
+                np.testing.assert_allclose(out[r], ref, rtol=1e-5)
+
+    @pytest.mark.parametrize("root", [0, 3, 7])
+    def test_bcast(self, comm, root):
+        x = _stack(comm, seed=2)
+        out = np.asarray(comm.bcast(x, root=root))
+        for r in range(comm.size):
+            np.testing.assert_allclose(out[r], np.asarray(x)[root], rtol=1e-6)
+
+    def test_allgather(self, comm):
+        x = _stack(comm, seed=3)
+        out = np.asarray(comm.allgather(x))
+        np.testing.assert_allclose(out, np.asarray(x), rtol=1e-6)
+
+    def test_gather(self, comm):
+        x = _stack(comm, seed=4)
+        out = np.asarray(comm.gather(x, root=2))
+        np.testing.assert_allclose(out, np.asarray(x), rtol=1e-6)
+
+    def test_alltoall(self, comm):
+        x = jnp.arange(comm.size * comm.size * 2, dtype=jnp.float32).reshape(
+            comm.size, comm.size, 2
+        )
+        out = np.asarray(comm.alltoall(x))
+        np.testing.assert_allclose(out, np.swapaxes(np.asarray(x), 0, 1))
+
+    def test_send_recv_roundtrip(self, comm):
+        x = _stack(comm, seed=5)
+        moved = comm.send(x, dest=6, source=1)
+        h = np.asarray(moved)
+        np.testing.assert_allclose(h[6], np.asarray(x)[1], rtol=1e-6)
+        back = np.asarray(comm.recv(moved, source=6, dest=1))
+        np.testing.assert_allclose(back[1], np.asarray(x)[1], rtol=1e-6)
+
+    def test_reduce_scatter(self, comm):
+        x = _stack(comm, shape=(16,), seed=6)
+        out = np.asarray(comm.reduce_scatter(x, op="sum"))
+        full = np.asarray(x).sum(0).reshape(comm.size, -1)
+        np.testing.assert_allclose(out, full, rtol=1e-5)
+
+    def test_multidim_payload(self, comm):
+        x = _stack(comm, shape=(4, 5), seed=7)
+        out = np.asarray(comm.allreduce(x))
+        np.testing.assert_allclose(out[0], np.asarray(x).sum(0), rtol=1e-5)
+
+
+class TestSplit:
+    def test_split_halves(self, comm):
+        subs = comm.split([0, 0, 0, 0, 1, 1, 1, 1])
+        assert set(subs) == {0, 1}
+        for color, sub in subs.items():
+            assert sub.size == 4
+            x = jnp.arange(4.0).reshape(4, 1)
+            out = np.asarray(sub.allreduce(x))
+            np.testing.assert_allclose(out, 6.0)
+
+    def test_split_undefined_color(self, comm):
+        subs = comm.split([0, 0, None, None, None, None, None, None])
+        assert set(subs) == {0}
+        assert subs[0].size == 2
+
+    def test_split_key_reorders(self, comm):
+        subs = comm.split([0] * 8, keys=[7, 6, 5, 4, 3, 2, 1, 0])
+        sub = subs[0]
+        assert sub.size == 8
+
+
+class TestObjTransport:
+    def test_bcast_obj(self, comm):
+        obj = {"step": 3, "names": ["a", "b"]}
+        assert comm.bcast_obj(obj) == obj
+
+    def test_gather_allgather_obj(self, comm):
+        objs = comm.allgather_obj(("x", 1))
+        assert objs == [("x", 1)] * comm.size
+        objs = comm.gather_obj(5)
+        assert objs == [5] * comm.size
+
+    def test_allreduce_obj(self, comm):
+        assert comm.allreduce_obj(2.5) == 2.5 * comm.size
+
+    def test_send_recv_obj(self, comm):
+        comm.send_obj({"payload": 42}, dest=0, tag=9)
+        assert comm.recv_obj(source=1, tag=9) == {"payload": 42}
+
+
+class TestModelLevel:
+    def test_bcast_data_replicates(self, comm):
+        tree = {"w": jnp.ones((4, 4)), "b": jnp.zeros((4,))}
+        out = comm.bcast_data(tree)
+        assert out["w"].shape == (4, 4)
+        np.testing.assert_allclose(np.asarray(out["w"]), 1.0)
+
+    def test_allreduce_grad_means(self, comm):
+        grads = {"w": _stack(comm, shape=(2, 2), seed=8)}
+        out = comm.allreduce_grad(grads)
+        expect = np.asarray(grads["w"]).mean(0)
+        for r in range(comm.size):
+            np.testing.assert_allclose(
+                np.asarray(out["w"])[r], expect, rtol=1e-5
+            )
+
+
+class TestReducedPrecision:
+    @pytest.mark.parametrize("name", ["tpu", "hierarchical", "naive"])
+    def test_allreduce_grad_bf16(self, name, devices8):
+        comm = create_communicator(
+            name, devices=devices8, allreduce_grad_dtype=jnp.bfloat16
+        )
+        g = jnp.ones((8, 16), jnp.float32)
+        out = comm.allreduce_grad({"g": g})["g"]
+        assert out.dtype == jnp.float32 or out.dtype == jnp.bfloat16
+        np.testing.assert_allclose(np.asarray(out, np.float32), 1.0, rtol=1e-2)
+
+
+class TestDummy:
+    def test_dummy_passthrough(self, devices8):
+        comm = create_communicator("dummy", devices=devices8)
+        x = jnp.arange(8.0).reshape(8, 1)
+        np.testing.assert_allclose(np.asarray(comm.allreduce(x)), np.asarray(x))
+
+
+class TestSingleNodeAssert:
+    def test_single_node_ok_on_one_host(self, devices8):
+        comm = create_communicator("single_node", devices=devices8)
+        assert comm.inter_size == 1
+
+
+class TestFactory:
+    def test_unknown_name_raises(self):
+        with pytest.raises(ValueError, match="unknown communicator"):
+            create_communicator("warp_drive")
+
+    def test_default_spans_all_devices(self, devices8):
+        comm = create_communicator("naive", devices=devices8)
+        assert comm.size == len(devices8)

@@ -1,0 +1,99 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 data-parallel training throughput.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Baseline (BASELINE.md): ChainerMN's published ResNet-50/ImageNet runs work
+out to ~125 images/sec/chip (1024 P100s, 90 epochs in 15 min ≈ 128k img/s
+total).  The north star is matching/beating per-chip throughput with ≥90 %
+scaling efficiency; on one attached chip we measure images/sec/chip for the
+full train step (fwd+bwd+update, bf16, global-batch-sharded input).
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+CHAINERMN_RESNET50_IMG_PER_SEC_PER_CHIP = 125.0
+
+
+def main():
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models import ResNet50
+
+    devices = jax.devices()
+    comm = cmn.create_communicator("tpu", devices=devices)
+
+    batch = int(os.environ.get("BENCH_BATCH", 128)) * comm.size
+    image = int(os.environ.get("BENCH_IMAGE", 224))
+    steps = int(os.environ.get("BENCH_STEPS", 20))
+    warmup = int(os.environ.get("BENCH_WARMUP", 5))
+
+    model = ResNet50(num_classes=1000, train=True)
+    rng = jax.random.PRNGKey(0)
+    variables = model.init(rng, jnp.zeros((1, image, image, 3), jnp.bfloat16))
+    params = {"params": variables["params"],
+              "batch_stats": variables.get("batch_stats", {})}
+    params = comm.bcast_data(params)
+
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.1, momentum=0.9), comm
+    )
+
+    def loss_fn(p, b):
+        x, y = b
+        logits, mut = model.apply(
+            {"params": p["params"], "batch_stats": p["batch_stats"]},
+            x, mutable=["batch_stats"],
+        )
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    step = cmn.build_train_step(comm, loss_fn, opt)
+
+    opt_state = opt.init(params)
+    params, opt_state = step.place(params, opt_state)
+
+    x = jnp.asarray(
+        np.random.RandomState(0).randn(batch, image, image, 3),
+        jnp.bfloat16,
+    )
+    y = jnp.asarray(
+        np.random.RandomState(1).randint(0, 1000, size=(batch,)), jnp.int32
+    )
+    bx = jax.device_put(x, step.batch_sharding)
+    by = jax.device_put(y, step.batch_sharding)
+
+    for _ in range(warmup):
+        params, opt_state, m = step(params, opt_state, (bx, by))
+    jax.block_until_ready(m["loss"])
+
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = step(params, opt_state, (bx, by))
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * steps / dt
+    per_chip = img_per_sec / comm.size
+    print(json.dumps({
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(
+            per_chip / CHAINERMN_RESNET50_IMG_PER_SEC_PER_CHIP, 3
+        ),
+    }))
+
+
+if __name__ == "__main__":
+    main()

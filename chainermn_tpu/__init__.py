@@ -8,11 +8,50 @@ synchronized batch normalization, differentiable point-to-point and
 collective communication, a MultiNodeChainList-style model-parallel API,
 ring-attention / Ulysses sequence parallelism, and distributed
 checkpoint/resume.
+
+Facade parity: ``chainermn/__init__.py`` re-exports (component #1 in
+SURVEY.md section 2).
 """
 
 from chainermn_tpu.communicators import (  # noqa: F401
     CommunicatorBase,
     create_communicator,
 )
+from chainermn_tpu.optimizers import (  # noqa: F401
+    create_multi_node_optimizer,
+    build_train_step,
+)
+from chainermn_tpu.datasets import (  # noqa: F401
+    scatter_dataset,
+    create_empty_dataset,
+)
+from chainermn_tpu.extensions import (  # noqa: F401
+    create_multi_node_evaluator,
+    create_multi_node_checkpointer,
+    AllreducePersistent,
+)
+from chainermn_tpu import global_except_hook  # noqa: F401
 
 __version__ = "0.1.0"
+
+
+def __getattr__(name):
+    # Heavier subsystems load lazily to keep import light.
+    if name in ("functions", "links", "iterators", "training", "parallel",
+                "models", "ops", "utils"):
+        import importlib
+
+        return importlib.import_module(f"chainermn_tpu.{name}")
+    if name == "MultiNodeChainList":
+        from chainermn_tpu.link import MultiNodeChainList
+
+        return MultiNodeChainList
+    if name == "create_multi_node_iterator":
+        from chainermn_tpu.iterators import create_multi_node_iterator
+
+        return create_multi_node_iterator
+    if name == "create_synchronized_iterator":
+        from chainermn_tpu.iterators import create_synchronized_iterator
+
+        return create_synchronized_iterator
+    raise AttributeError(name)

@@ -221,6 +221,23 @@ class CommunicatorBase:
         return op(objs)
 
     # ------------------------------------------------------------------
+    @property
+    def sync_seed(self) -> int:
+        """A seed every rank/process of this communicator agrees on.
+
+        Parity: the seed-broadcast of the synchronized iterator
+        (chainermn/iterators/_synchronized_iterator.py).  Agreed once per
+        communicator (process 0's draw wins under multi-process); anything
+        built from the same communicator shares the same stream.
+        """
+        if getattr(self, "_sync_seed", None) is None:
+            import numpy as _np
+
+            seed = int(_np.random.randint(0, 2**31 - 1))
+            self._sync_seed = int(self.bcast_obj(seed, root=0))
+        return self._sync_seed
+
+    # ------------------------------------------------------------------
     # Model-level helpers (parity: bcast_data / allreduce_grad)
     # ------------------------------------------------------------------
     def bcast_data(self, tree):

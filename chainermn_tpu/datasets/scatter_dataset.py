@@ -80,13 +80,19 @@ def scatter_dataset(
     seed: Optional[int] = None,
     *,
     rank: Optional[int] = None,
+    n_shards: Optional[int] = None,
     force_equal_length: bool = True,
 ):
-    """Shard ``dataset`` across the communicator's ranks.
+    """Shard ``dataset`` across the communicator.
 
-    Returns the shard for ``rank`` (default: ``comm.rank`` — this process's
-    rank).  All processes agree on the permutation by broadcasting the seed
-    over the control plane (parity with the reference's root-generated
+    Default sharding is by *process* (each controller keeps the slice that
+    feeds its addressable chips; the jitted step then shards each batch
+    across chips) — the single-controller equivalent of the reference's
+    one-shard-per-MPI-rank.  Pass ``n_shards=comm.size`` with an explicit
+    ``rank`` for per-chip shards (model-parallel drivers, parity tests).
+
+    All processes agree on the permutation by broadcasting the seed over
+    the control plane (parity with the reference's root-generated
     permutation, minus the O(data) pickle transfer).
     """
     del root  # seed agreement below plays the root's role
@@ -95,20 +101,34 @@ def scatter_dataset(
     # Agree on the seed across processes (rank 0's wins), like the
     # reference's root-owned permutation.
     seed = comm.bcast_obj(int(seed), root=0)
-    r = comm.rank if rank is None else rank
+    if n_shards is None:
+        if rank is not None:
+            # Ambiguous: rank could index process-shards or chip-shards.
+            raise ValueError(
+                "scatter_dataset(rank=...) requires n_shards= as well "
+                "(use n_shards=comm.size for per-chip shards or "
+                "n_shards=comm.process_count for per-process shards)"
+            )
+        n_shards, r = comm.process_count, comm.process_index
+    else:
+        r = comm.rank if rank is None else rank
+    if not 0 <= r < n_shards:
+        raise ValueError(f"rank {r} out of range for {n_shards} shards")
     order, start, end = scatter_index(
-        len(dataset), comm.size, r, shuffle=shuffle, seed=seed,
+        len(dataset), n_shards, r, shuffle=shuffle, seed=seed,
         equalize=force_equal_length,
     )
     return SubDataset(dataset, order, start, end)
 
 
 def scatter_dataset_all(dataset, comm, shuffle=False, seed=None):
-    """All shards at once (single-controller convenience: one process owns
-    every rank, so tests and model-parallel drivers can see each shard)."""
+    """All per-chip shards at once (single-controller convenience: one
+    process owns every rank, so tests and model-parallel drivers can see
+    each shard)."""
     if seed is None:
         seed = 0
     return [
-        scatter_dataset(dataset, comm, shuffle=shuffle, seed=seed, rank=r)
+        scatter_dataset(dataset, comm, shuffle=shuffle, seed=seed, rank=r,
+                        n_shards=comm.size)
         for r in range(comm.size)
     ]

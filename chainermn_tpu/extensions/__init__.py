@@ -1,0 +1,10 @@
+from .evaluator import create_multi_node_evaluator, Evaluator  # noqa: F401
+from .checkpoint import create_multi_node_checkpointer  # noqa: F401
+from .allreduce_persistent import AllreducePersistent  # noqa: F401
+
+__all__ = [
+    "create_multi_node_evaluator",
+    "Evaluator",
+    "create_multi_node_checkpointer",
+    "AllreducePersistent",
+]

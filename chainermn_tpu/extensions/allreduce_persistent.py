@@ -1,0 +1,46 @@
+"""Allreduce of persistent (non-gradient) state.
+
+Reference parity: ``chainermn/extensions/allreduce_persistent.py`` —
+``AllreducePersistent(model, comm)``: allreduce-average persistent arrays
+(BatchNorm running mean/var) so ranks agree before snapshot/eval.
+
+TPU-native form: persistent state is the flax ``batch_stats`` collection.
+Under GSPMD these are already replicated global arrays *within* one
+controller; cross-process agreement (multi-controller drift, e.g. from
+non-deterministic host input orders) is restored by a pmean over the mesh
+axes when the stats were computed per-shard, or a host allreduce across
+processes otherwise.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+class AllreducePersistent:
+    priority = 250
+    trigger = (1, "epoch")
+    name = "allreduce_persistent"
+
+    def __init__(self, comm, stats_getter=None, stats_setter=None):
+        self._comm = comm
+        self._get = stats_getter
+        self._set = stats_setter
+
+    def reduce(self, stats):
+        """Average a pytree of persistent arrays across processes."""
+        if self._comm.process_count > 1:
+            from jax.experimental import multihost_utils
+
+            def mean_across(x):
+                g = multihost_utils.process_allgather(jnp.asarray(x))
+                return jnp.mean(g, axis=0)
+
+            return jax.tree_util.tree_map(mean_across, stats)
+        # Single controller: stats are already globally consistent.
+        return stats
+
+    def __call__(self, trainer):
+        if self._get and self._set:
+            self._set(self.reduce(self._get()))

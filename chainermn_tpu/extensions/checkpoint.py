@@ -1,0 +1,167 @@
+"""Distributed checkpoint / resume with newest-common-step agreement.
+
+Reference parity: ``chainermn/extensions/checkpoint.py`` —
+``create_multi_node_checkpointer(name, comm, ...)``: every rank snapshots
+its local state at an interval; ranks allgather their snapshot inventories
+and agree on the newest iteration present on *all* ranks; stale files are
+garbage-collected; resume loads the newest common snapshot — fault-tolerant
+restart under a batch scheduler (and, on TPU, under preemption).
+
+TPU-native redesign: arrays are *global* (sharded over the mesh), so the
+storage layer is orbax/tensorstore — each process writes exactly its
+addressable shards of one logical checkpoint instead of one npz per rank.
+The agreement protocol survives unchanged, but it agrees on complete
+*global* checkpoints (a step counts only if every process finished its
+shards — orbax's commit semantics make partial writes invisible, which is
+strictly stronger than the reference's per-rank npz inventory).
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import shutil
+from typing import Any, Dict, Optional
+
+import jax
+import numpy as np
+
+
+def _flatten_state(tree) -> Dict[str, np.ndarray]:
+    flat = {}
+    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
+    for path, leaf in leaves:
+        key = "/".join(str(p) for p in path)
+        flat[key] = np.asarray(leaf)
+    return flat
+
+
+class _MultiNodeCheckpointer:
+    """Trainer extension; also usable standalone via save()/resume()."""
+
+    priority = 200
+    name = "checkpointer"
+
+    def __init__(self, name: str, comm, path: str = "checkpoints",
+                 trigger=(1, "epoch"), keep: int = 3,
+                 use_orbax: bool = True):
+        self._name = name
+        self._comm = comm
+        self._root = os.path.join(path, name)
+        self.trigger = trigger
+        self._keep = keep
+        self._use_orbax = use_orbax
+        self._ckptr = None
+        os.makedirs(self._root, exist_ok=True)
+
+    # -- storage backends ----------------------------------------------
+    def _orbax(self):
+        if self._ckptr is None:
+            import orbax.checkpoint as ocp
+
+            self._ckptr = ocp.PyTreeCheckpointer()
+        return self._ckptr
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self._root, f"step_{step:012d}")
+
+    def _available_steps(self) -> list:
+        steps = []
+        if os.path.isdir(self._root):
+            for d in os.listdir(self._root):
+                m = re.fullmatch(r"step_(\d+)", d)
+                if m and self._is_complete(os.path.join(self._root, d)):
+                    steps.append(int(m.group(1)))
+        return sorted(steps)
+
+    def _is_complete(self, path: str) -> bool:
+        # orbax writes atomically (tmp dir + rename); presence of the final
+        # dir (with no orbax tmp marker) means commit finished.
+        return os.path.isdir(path) and not path.endswith(".tmp")
+
+    # -- save ----------------------------------------------------------
+    def save(self, step: int, state: Dict[str, Any]) -> None:
+        """Snapshot ``state`` (a pytree of global arrays + metadata)."""
+        target = self._step_dir(step)
+        if os.path.exists(target):
+            shutil.rmtree(target)
+        if self._use_orbax:
+            try:
+                self._orbax().save(os.path.abspath(target), state)
+            except Exception:
+                self._save_np(target, state)
+        else:
+            self._save_np(target, state)
+        self._gc()
+
+    def _save_np(self, target: str, state) -> None:
+        os.makedirs(target, exist_ok=True)
+        np.savez(os.path.join(target, "state.npz"), **_flatten_state(state))
+
+    # -- agreement + resume --------------------------------------------
+    def newest_common_step(self) -> Optional[int]:
+        """The newest step every process has on disk (parity: the allgather
+        of snapshot inventories + max-common computation)."""
+        local = self._available_steps()
+        inventories = self._comm.allgather_obj(local)
+        common = set(inventories[0])
+        for inv in inventories[1:]:
+            common &= set(inv)
+        return max(common) if common else None
+
+    def resume(self, like: Optional[Dict[str, Any]] = None):
+        """Load the newest common snapshot; returns (step, state) or
+        (None, None) when no checkpoint exists."""
+        step = self.newest_common_step()
+        if step is None:
+            return None, None
+        target = self._step_dir(step)
+        npz = os.path.join(target, "state.npz")
+        if os.path.exists(npz):
+            data = np.load(npz, allow_pickle=True)
+            return step, dict(data)
+        state = self._orbax().restore(
+            os.path.abspath(target), item=like
+        )
+        return step, state
+
+    def _gc(self) -> None:
+        steps = self._available_steps()
+        for s in steps[: -self._keep] if self._keep else []:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
+    def finalize(self, trainer=None) -> None:
+        """Parity: the reference's finalize/GC of stale snapshots."""
+        self._gc()
+
+    # -- trainer-extension protocol ------------------------------------
+    def __call__(self, trainer) -> None:
+        state = {
+            "params": trainer.updater.params,
+            "opt_state": trainer.updater.opt_state,
+            "trainer": trainer.state_dict(),
+        }
+        self.save(trainer.iteration, state)
+
+    def restore_trainer(self, trainer) -> Optional[int]:
+        step, state = self.resume(
+            like={
+                "params": trainer.updater.params,
+                "opt_state": trainer.updater.opt_state,
+                "trainer": trainer.state_dict(),
+            }
+        )
+        if step is None:
+            return None
+        trainer.updater.params = state["params"]
+        trainer.updater.opt_state = state["opt_state"]
+        trainer.load_state_dict(state["trainer"])
+        return step
+
+
+def create_multi_node_checkpointer(name: str, comm, path: str = "checkpoints",
+                                   trigger=(1, "epoch"), keep: int = 3,
+                                   **kw) -> _MultiNodeCheckpointer:
+    """Parity: ``chainermn.create_multi_node_checkpointer(name, comm)``."""
+    return _MultiNodeCheckpointer(name, comm, path=path, trigger=trigger,
+                                  keep=keep, **kw)

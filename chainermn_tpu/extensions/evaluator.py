@@ -1,0 +1,128 @@
+"""Multi-node evaluator.
+
+Reference parity: ``create_multi_node_evaluator`` in
+``chainermn/extensions/`` — wrap an evaluator so each rank evaluates its
+local validation shard and the result dict is allreduce-averaged, making
+every rank report *global* validation metrics.
+
+TPU-native redesign: the evaluation step is a jitted SPMD function over the
+communicator's mesh (batch sharded over all mesh axes, metrics pmean-ed
+inside the program), so "run local shard then average the dicts" becomes a
+single compiled pass over a globally-sharded eval set.  An eager dict
+reduction (``allreduce_obj``-style) is kept for custom host-side metrics.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, Optional
+
+import jax
+import numpy as np
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+
+class Evaluator:
+    """Runs ``metric_fn(params, batch) -> dict`` over an iterator and
+    reports the global mean of each metric.
+
+    ``metric_fn`` is written per-shard (local batch); the evaluator builds
+    one jitted SPMD program in which the batch is sharded across the mesh
+    and every metric is ``pmean``-ed over the communicator axes.
+    """
+
+    trigger = (1, "epoch")
+    priority = 300
+    name = "validation"
+
+    def __init__(self, iterator_factory, metric_fn: Callable, comm,
+                 params_getter: Optional[Callable] = None,
+                 prefix: str = "val/"):
+        self._make_iterator = iterator_factory
+        self._comm = comm
+        self._prefix = prefix
+        self._params_getter = params_getter
+        mesh = comm.mesh
+        axes = comm.axis_names
+        spec = P(axes)
+
+        def _step(params, batch):
+            metrics = metric_fn(params, batch)
+            return {k: lax.pmean(v, axes) for k, v in metrics.items()}
+
+        self._step = jax.jit(
+            jax.shard_map(
+                _step, mesh=mesh, in_specs=(P(), spec), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        self._batch_sharding = NamedSharding(mesh, spec)
+        self._rep = NamedSharding(mesh, P())
+
+    def evaluate(self, params) -> Dict[str, float]:
+        params = jax.device_put(params, self._rep)
+        n_chips = self._comm.size
+        totals: Dict[str, float] = {}
+        count = 0
+        for batch in self._make_iterator():
+            leaves = jax.tree_util.tree_leaves(batch)
+            if leaves and leaves[0].shape[0] % n_chips:
+                raise ValueError(
+                    f"evaluation batch of {leaves[0].shape[0]} rows is not "
+                    f"divisible by {n_chips} chips; use EpochIterator("
+                    "..., pad_to=comm.size)"
+                )
+            batch = jax.device_put(batch, self._batch_sharding)
+            out = self._step(params, batch)
+            for k, v in out.items():
+                totals[k] = totals.get(k, 0.0) + float(v)
+            count += 1
+        return {
+            self._prefix + k: v / max(count, 1) for k, v in totals.items()
+        }
+
+    # Trainer-extension protocol
+    def __call__(self, trainer):
+        params = (
+            self._params_getter() if self._params_getter
+            else trainer.updater.params
+        )
+        result = self.evaluate(params)
+        trainer.observation.update(result)
+        return result
+
+
+def create_multi_node_evaluator(actual_evaluator, communicator):
+    """Make an evaluator report communicator-global averaged metrics.
+
+    Parity: ``chainermn.create_multi_node_evaluator(evaluator, comm)``.
+    Accepts either this module's :class:`Evaluator` (returned as-is — it is
+    already communicator-aware) or any object with an ``evaluate()``
+    returning a metrics dict, which gets wrapped so the dict is averaged
+    across processes via the control plane.
+    """
+    if isinstance(actual_evaluator, Evaluator):
+        return actual_evaluator
+
+    class _Wrapped:
+        def __init__(self, ev, comm):
+            self._ev = ev
+            self._comm = comm
+
+        def evaluate(self, *a, **kw):
+            local = self._ev.evaluate(*a, **kw)
+            gathered = self._comm.allgather_obj(local)
+            keys = gathered[0].keys()
+            return {
+                k: float(np.mean([g[k] for g in gathered])) for k in keys
+            }
+
+        def __call__(self, trainer):
+            res = self.evaluate(trainer.updater.params)
+            trainer.observation.update(res)
+            return res
+
+        def __getattr__(self, name):
+            return getattr(self._ev, name)
+
+    return _Wrapped(actual_evaluator, communicator)

@@ -1,0 +1,16 @@
+from .point_to_point import send, recv, exchange, pseudo_connect  # noqa: F401
+from .collectives import (  # noqa: F401
+    all_gather,
+    all_to_all,
+    bcast,
+    gather,
+    scatter,
+    reduce_scatter,
+    psum,
+)
+
+__all__ = [
+    "send", "recv", "exchange", "pseudo_connect",
+    "all_gather", "all_to_all", "bcast", "gather", "scatter",
+    "reduce_scatter", "psum",
+]

@@ -1,0 +1,67 @@
+"""Global exception hook — distributed failure containment.
+
+Reference parity: ``chainermn/global_except_hook.py`` — installs a
+``sys.excepthook`` that prints the traceback and calls
+``MPI_Abort(COMM_WORLD)``, so one crashed rank kills the whole job instead
+of leaving the other ranks deadlocked inside a collective.
+
+TPU-native redesign: the failure domain is the ``jax.distributed`` client.
+On an uncaught exception the hook prints the traceback (prefixed with the
+process index), best-effort shuts down the distributed client (which
+releases the coordination service and makes peers fail fast instead of
+hanging on the next collective), and exits non-zero.  Under a single
+controller it degrades to print + exit.  An environment switch
+``CHAINERMN_TPU_FORCE_ABORT_ON_EXCEPTION`` skips the graceful shutdown and
+hard-exits, mirroring the reference's force-abort behavior.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import traceback
+
+_hook_installed = False
+
+
+def _global_except_hook(exctype, value, tb):
+    try:
+        pid = "?"
+        try:
+            import jax
+
+            pid = str(jax.process_index())
+        except Exception:
+            pass
+        sys.stderr.write(
+            f"\n*** chainermn_tpu: uncaught exception on process {pid} — "
+            "aborting the distributed job ***\n"
+        )
+        traceback.print_exception(exctype, value, tb)
+        sys.stderr.flush()
+        if os.environ.get("CHAINERMN_TPU_FORCE_ABORT_ON_EXCEPTION"):
+            os._exit(1)
+        try:
+            import jax
+
+            jax.distributed.shutdown()
+        except Exception:
+            pass
+    finally:
+        os._exit(1)
+
+
+def add_hook() -> None:
+    """Install the hook (idempotent).  Parity:
+    ``chainermn.global_except_hook.add_hook()``."""
+    global _hook_installed
+    if not _hook_installed:
+        sys.excepthook = _global_except_hook
+        _hook_installed = True
+
+
+def remove_hook() -> None:
+    global _hook_installed
+    if _hook_installed:
+        sys.excepthook = sys.__excepthook__
+        _hook_installed = False

@@ -95,15 +95,25 @@ class SerialIterator:
 
 
 class EpochIterator:
-    """Non-repeating pass over a dataset (used by the evaluator)."""
+    """Non-repeating pass over a dataset (used by the evaluator).
 
-    def __init__(self, dataset, batch_size: int):
+    ``pad_to``: pad the final partial batch to a multiple by wrapping to
+    the dataset's start — the same equalization trick the reference's
+    ``scatter_dataset`` used for shards, so sharded evaluation never sees
+    an indivisible batch (slight over-weighting of the first samples on
+    the last batch, as in the reference).
+    """
+
+    def __init__(self, dataset, batch_size: int, pad_to: int = 1):
         self.dataset = dataset
         self.batch_size = batch_size
+        self.pad_to = max(pad_to, 1)
 
     def __iter__(self):
         n = len(self.dataset)
         for start in range(0, n, self.batch_size):
-            yield _collate(
-                [self.dataset[i] for i in range(start, min(start + self.batch_size, n))]
-            )
+            idx = list(range(start, min(start + self.batch_size, n)))
+            if len(idx) % self.pad_to:
+                pad = self.pad_to - len(idx) % self.pad_to
+                idx += [i % n for i in range(pad)]
+            yield _collate([self.dataset[i] for i in idx])

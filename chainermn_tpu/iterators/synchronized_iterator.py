@@ -16,10 +16,19 @@ import numpy as np
 
 
 def create_synchronized_iterator(actual_iterator, comm):
-    """Re-seed ``actual_iterator`` with a communicator-agreed seed."""
-    seed = int(np.random.randint(0, 2**31 - 1))
-    seed = comm.bcast_obj(seed, root=0)
-    rng = np.random.RandomState(seed)
+    """Re-seed ``actual_iterator`` with a communicator-agreed seed.
+
+    All ranks/processes of the same communicator agree on the seed
+    (``comm.sync_seed`` is agreed once, process 0's draw winning), and a
+    per-call counter keeps *different* iterators independent — calls must
+    happen in the same order on every process, exactly as the reference's
+    per-call MPI broadcast required.
+    """
+    count = getattr(comm, "_sync_iterator_calls", 0)
+    comm._sync_iterator_calls = count + 1
+    rng = np.random.RandomState(
+        (comm.sync_seed + 0x9E3779B9 * count) % (2**31 - 1)
+    )
     # Re-seed in place: the iterator draws every epoch's order from _rng.
     if hasattr(actual_iterator, "_rng"):
         actual_iterator._rng = rng

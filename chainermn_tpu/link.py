@@ -1,0 +1,233 @@
+"""MultiNodeChainList — model-parallel stage composition.
+
+Reference parity: ``chainermn/link.py`` — ``MultiNodeChainList(comm)`` with
+``add_link(link, rank_in, rank_out)``: the model is partitioned across
+ranks; ``__call__`` threads activations between ranks by auto-inserting
+``functions.send``/``recv``/``pseudo_connect``, enabling pipeline- and
+graph-partitioned models (``rank_in`` may be a list for multi-input
+stages).
+
+TPU-native redesign (SURVEY.md section 7, "hard parts"): the reference's
+blocking per-rank MPI calls cannot exist under XLA — instead the single
+controller owns *every* stage and executes them in topological order, with
+each stage's parameters **committed to its own chip** and each
+activation edge realized as a device-to-device transfer over ICI:
+
+* ``init`` places stage ``s``'s parameters on ``comm.devices[rank(s)]`` —
+  model memory is genuinely partitioned across chips, which is the point
+  of model parallelism (a 4-chip MultiNodeChainList holds ~1/4 of the
+  parameters per chip).
+* ``__call__`` runs each stage as its own jitted computation on its chip
+  ("computation follows data"); cross-stage activations are moved with
+  ``jax.device_put`` — an async ICI copy, the moral equivalent of the
+  reference's MPI send/recv but scheduled by the runtime, so no deadlock
+  machinery (delegate variables) is needed.
+* ``value_and_grad`` chains the per-stage VJPs in reverse stage order —
+  the backward "transpose communication" of the reference, with residuals
+  staying resident on each stage's own chip.
+
+For *homogeneous* stages where throughput matters, use
+``chainermn_tpu.parallel.pipeline`` (microbatched GPipe/1F1B via
+``shard_map`` + ``ppermute``) — this class optimizes for the reference's
+flexible-graph ergonomics instead.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
+
+import jax
+import jax.numpy as jnp
+
+
+class _Stage:
+    def __init__(self, module, rank_in, rank_out, index: int):
+        self.module = module
+        self.rank_in = rank_in  # None | int | list[int]
+        self.rank_out = rank_out  # None | int | list[int]
+        self.index = index
+        self.rank: Optional[int] = None  # assigned placement
+
+
+class MultiNodeChainList:
+    """Compose modules across chips with explicit activation routing.
+
+    ``add_link(module, rank_in, rank_out)`` declares that the module runs
+    on the next free chip (or ``rank=`` explicitly), consumes the
+    activation(s) produced by the stage(s) on ``rank_in`` (``None`` = the
+    external input), and ships its output toward ``rank_out`` (``None`` =
+    this stage produces the final output).
+    """
+
+    def __init__(self, comm):
+        self._comm = comm
+        self._stages: List[_Stage] = []
+
+    # -- graph construction -------------------------------------------
+    def add_link(self, module, rank_in=None, rank_out=None,
+                 rank: Optional[int] = None) -> "MultiNodeChainList":
+        st = _Stage(module, rank_in, rank_out, len(self._stages))
+        st.rank = rank if rank is not None else (
+            len(self._stages) % self._comm.size
+        )
+        self._stages.append(st)
+        return self
+
+    @property
+    def n_stages(self) -> int:
+        return len(self._stages)
+
+    def _device(self, stage: _Stage):
+        return self._comm.devices[stage.rank % self._comm.size]
+
+    # -- init ----------------------------------------------------------
+    def init(self, rng: jax.Array, x) -> List[Any]:
+        """Initialize each stage's params *on its own chip*."""
+        params: List[Any] = []
+        outputs: dict = {}
+        for st in self._stages:
+            inp = self._resolve_input(st, x, outputs)
+            dev = self._device(st)
+            inp = jax.tree_util.tree_map(
+                lambda t: jax.device_put(t, dev), inp
+            )
+            rng, sub = jax.random.split(rng)
+            p = st.module.init(sub, *inp) if isinstance(inp, tuple) else (
+                st.module.init(sub, inp)
+            )
+            p = jax.device_put(p, dev)
+            params.append(p)
+            out = st.module.apply(p, *inp) if isinstance(inp, tuple) else (
+                st.module.apply(p, inp)
+            )
+            outputs[st.index] = out
+        return params
+
+    def _resolve_input(self, st: _Stage, x, outputs: dict):
+        """Input(s) of a stage: the external input or upstream outputs.
+
+        ``rank_in`` semantics follow the reference: ``None`` -> external
+        input; an int/list -> output(s) of the stage(s) placed on those
+        rank(s) (multi-input gather when a list).
+        """
+        if st.rank_in is None:
+            return x
+        ranks = st.rank_in if isinstance(st.rank_in, (list, tuple)) else [
+            st.rank_in
+        ]
+        ins = []
+        for r in ranks:
+            src = self._find_producer(r, before=st.index)
+            ins.append(outputs[src.index])
+        return tuple(ins) if len(ins) > 1 else ins[0]
+
+    def _find_producer(self, rank: int, before: int) -> _Stage:
+        for st in reversed(self._stages[:before]):
+            if st.rank == rank:
+                return st
+        raise ValueError(
+            f"no stage placed on rank {rank} precedes stage {before}"
+        )
+
+    # -- forward -------------------------------------------------------
+    def __call__(self, params: Sequence[Any], x):
+        """Forward pass: stages execute on their chips in order; edges are
+        ICI transfers.  Returns the final stage's output."""
+        outputs: dict = {}
+        last = None
+        for st, p in zip(self._stages, params):
+            inp = self._resolve_input(st, x, outputs)
+            dev = self._device(st)
+            inp_moved = jax.tree_util.tree_map(
+                lambda t: jax.device_put(t, dev), inp
+            )
+            fn = self._stage_fn(st)
+            out = fn(p, inp_moved)
+            outputs[st.index] = out
+            last = out
+        return last
+
+    def _stage_fn(self, st: _Stage) -> Callable:
+        if not hasattr(st, "_jitted"):
+            def run(p, inp, _m=st.module):
+                return _m.apply(p, *inp) if isinstance(inp, tuple) else (
+                    _m.apply(p, inp)
+                )
+
+            st._jitted = jax.jit(run)
+        return st._jitted
+
+    # -- training ------------------------------------------------------
+    def value_and_grad(self, loss_fn: Callable):
+        """Build ``step(params, x, *loss_args) -> (loss, grads)``.
+
+        ``loss_fn(final_output, *loss_args) -> scalar``.  The backward pass
+        chains per-stage VJPs in reverse: cotangents flow chip-to-chip in
+        the transpose direction, residuals stay on each stage's chip —
+        the generated equivalent of the reference's backward send/recv.
+        """
+
+        def step(params, x, *loss_args):
+            outputs: dict = {}
+            vjps: List[Tuple[_Stage, Callable]] = []
+            last = None
+            for st, p in zip(self._stages, params):
+                inp = self._resolve_input(st, x, outputs)
+                dev = self._device(st)
+                inp = jax.tree_util.tree_map(
+                    lambda t: jax.device_put(t, dev), inp
+                )
+
+                def run(p, inp, _m=st.module):
+                    return _m.apply(p, *inp) if isinstance(inp, tuple) else (
+                        _m.apply(p, inp)
+                    )
+
+                out, vjp = jax.vjp(run, p, inp)
+                outputs[st.index] = out
+                vjps.append((st, vjp))
+                last = out
+
+            loss, loss_vjp = jax.vjp(
+                lambda y: loss_fn(y, *loss_args), last
+            )
+            seed = jax.device_put(
+                jnp.ones_like(loss), self._device(self._stages[-1])
+            )
+            (g_out,) = loss_vjp(seed)
+
+            # Reverse sweep: route each stage's input-cotangent to its
+            # producer(s).
+            cotangents: dict = {self._stages[-1].index: g_out}
+            grads: List[Any] = [None] * len(self._stages)
+            for st, vjp in reversed(vjps):
+                ct = cotangents.pop(st.index, None)
+                if ct is None:
+                    # Dead branch (output unused) — zero cotangent.
+                    ct = jax.tree_util.tree_map(
+                        jnp.zeros_like, outputs[st.index]
+                    )
+                g_params, g_in = vjp(ct)
+                grads[st.index] = g_params
+                # Accumulate input cotangent onto producer stage(s).
+                if st.rank_in is None:
+                    continue
+                ranks = st.rank_in if isinstance(
+                    st.rank_in, (list, tuple)
+                ) else [st.rank_in]
+                gs = g_in if isinstance(g_in, tuple) and len(ranks) > 1 else (
+                    g_in,
+                )
+                for r, g in zip(ranks, gs):
+                    src = self._find_producer(r, before=st.index)
+                    sdev = self._device(src)
+                    g = jax.tree_util.tree_map(
+                        lambda t: jax.device_put(t, sdev), g
+                    )
+                    prev = cotangents.get(src.index)
+                    cotangents[src.index] = g if prev is None else (
+                        jax.tree_util.tree_map(jnp.add, prev, g)
+                    )
+            return loss, grads
+
+        return step

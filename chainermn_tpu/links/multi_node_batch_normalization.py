@@ -56,6 +56,8 @@ class MultiNodeBatchNormalization(nn.Module):
     use_scale: bool = True
     dtype: Any = jnp.float32
     axis: int = -1  # feature axis
+    scale_init: Any = nn.initializers.ones
+    bias_init: Any = nn.initializers.zeros
 
     @nn.compact
     def __call__(self, x, use_running_average: bool = False):
@@ -85,7 +87,12 @@ class MultiNodeBatchNormalization(nn.Module):
                     jnp.mean(jnp.square(xf), axis=reduction_axes),
                 ]
             )
-            if self.axis_name:
+            if self.axis_name and not self.is_initializing():
+                # Outside shard_map this raises NameError (unbound axis) —
+                # deliberately not swallowed: a wrong axis name silently
+                # disabling cross-chip sync is the exact failure mode this
+                # link exists to prevent.  Eval-mode calls (running stats)
+                # and init never reach here.
                 stats = lax.pmean(stats, self.axis_name)
             mean, sq_mean = stats[0], stats[1]
             var = sq_mean - jnp.square(mean)
@@ -104,12 +111,12 @@ class MultiNodeBatchNormalization(nn.Module):
         y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
         if self.use_scale:
             gamma = self.param(
-                "scale", nn.initializers.ones, (self.size,), jnp.float32
+                "scale", self.scale_init, (self.size,), jnp.float32
             )
             y = y * gamma.reshape(shape)
         if self.use_bias:
             beta = self.param(
-                "bias", nn.initializers.zeros, (self.size,), jnp.float32
+                "bias", self.bias_init, (self.size,), jnp.float32
             )
             y = y + beta.reshape(shape)
         return y.astype(self.dtype)

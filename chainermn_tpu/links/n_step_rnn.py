@@ -50,15 +50,22 @@ class LSTMStack(nn.Module):
         for layer in range(self.num_layers):
             cell_in = nn.Dense(4 * self.hidden_size, dtype=self.dtype,
                                name=f"wx_{layer}")
-            cell_h = nn.Dense(4 * self.hidden_size, use_bias=False,
-                              dtype=self.dtype, name=f"wh_{layer}")
             # Precompute input projections for the whole sequence in one
             # (b*t, 4H) matmul — large MXU tiles instead of t small ones.
             xproj = cell_in(seq)  # (b, t, 4H)
+            # Recurrent weight as an explicit param so the scan body is a
+            # pure function (flax submodule calls inside raw lax.scan leak
+            # tracers during init).
+            wh = self.param(
+                f"wh_{layer}",
+                nn.initializers.lecun_normal(),
+                (self.hidden_size, 4 * self.hidden_size),
+                jnp.float32,
+            ).astype(self.dtype)
 
-            def step(carry, xp, _wh=cell_h):
+            def step(carry, xp):
                 h, c = carry
-                gates = xp + _wh(h)
+                gates = xp + h.astype(self.dtype) @ wh
                 i, f, g, o = jnp.split(gates, 4, axis=-1)
                 c = jax.nn.sigmoid(f) * c + jax.nn.sigmoid(i) * jnp.tanh(g)
                 h = jax.nn.sigmoid(o) * jnp.tanh(c)

@@ -1,0 +1,33 @@
+from .mlp import MLP  # noqa: F401
+
+__all__ = ["MLP"]
+
+
+def __getattr__(name):
+    # Lazy imports keep `import chainermn_tpu` light; model families pull in
+    # their own modules on first use.
+    if name in ("ResNet50", "ResNet18", "ResNet101"):
+        from . import resnet
+
+        return getattr(resnet, name)
+    if name in ("VGG16",):
+        from . import vgg
+
+        return getattr(vgg, name)
+    if name in ("AlexNet",):
+        from . import alexnet
+
+        return getattr(alexnet, name)
+    if name in ("GoogLeNet", "GoogLeNetBN"):
+        from . import googlenet
+
+        return getattr(googlenet, name)
+    if name in ("NIN",):
+        from . import nin
+
+        return getattr(nin, name)
+    if name in ("Seq2Seq", "Encoder", "Decoder"):
+        from . import seq2seq
+
+        return getattr(seq2seq, name)
+    raise AttributeError(name)

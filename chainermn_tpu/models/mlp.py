@@ -1,0 +1,28 @@
+"""MNIST MLP.
+
+Parity target: the model in the reference's ``examples/mnist/train_mnist.py``
+(a 3-layer fully-connected net) — the canonical data-parallel smoke model.
+TPU notes: compute in bfloat16 with fp32 params (MXU-native), single fused
+matmuls per layer.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class MLP(nn.Module):
+    n_units: int = 1000
+    n_out: int = 10
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        x = x.reshape((x.shape[0], -1)).astype(self.dtype)
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.dtype)(x))
+        x = nn.relu(nn.Dense(self.n_units, dtype=self.dtype)(x))
+        x = nn.Dense(self.n_out, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

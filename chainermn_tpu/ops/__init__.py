@@ -1,0 +1,4 @@
+from .losses import softmax_cross_entropy, accuracy  # noqa: F401
+from .attention import multi_head_attention  # noqa: F401
+
+__all__ = ["softmax_cross_entropy", "accuracy", "multi_head_attention"]

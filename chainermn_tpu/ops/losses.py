@@ -1,0 +1,34 @@
+"""Loss / metric ops.
+
+Small fused building blocks used by the examples and benches.  TPU notes:
+logits enter in bf16 but the log-sum-exp accumulates in fp32 (bf16's 8-bit
+exponent survives exp, but the 7-bit mantissa loses the softmax tail);
+XLA fuses the whole loss into the preceding matmul's epilogue.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+
+def softmax_cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray,
+                          *, z_loss: float = 0.0) -> jnp.ndarray:
+    """Mean cross-entropy for integer labels, fp32 accumulation.
+
+    ``z_loss`` adds the PaLM-style log-normalizer penalty
+    (z_loss * logZ^2), which keeps logits from drifting at large scale.
+    """
+    logits = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(logits, axis=-1)
+    ll = jnp.take_along_axis(
+        logits, labels[..., None].astype(jnp.int32), axis=-1
+    )[..., 0]
+    loss = logz - ll
+    if z_loss:
+        loss = loss + z_loss * jnp.square(logz)
+    return loss.mean()
+
+
+def accuracy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
+    return (jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32).mean()

@@ -41,6 +41,7 @@ from typing import Any, NamedTuple, Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 import optax
 from jax import lax
 
@@ -232,16 +233,68 @@ def build_train_step(
             out_shardings=(rep, rep, rep),
         )
 
+    n_shards = 1
+    for a in axes:
+        n_shards *= dict(mesh.shape)[a]
+    n_procs = comm.process_count
+    local_shards = max(n_shards // n_procs, 1)
+
+    def _check_batch(batch, divisor, kind):
+        leaves = jax.tree_util.tree_leaves(batch)
+        if leaves and hasattr(leaves[0], "shape") and leaves[0].ndim:
+            b = leaves[0].shape[0]
+            if b % divisor:
+                raise ValueError(
+                    f"{kind} batch size {b} is not divisible by the "
+                    f"{divisor} chips it feeds; pick a batch size that is "
+                    f"a multiple of {divisor} (iterators with "
+                    "drop_last=True and scatter_dataset's equalized shards "
+                    "guarantee this)"
+                )
+
+    def _place_batch(batch):
+        """Place a batch as a global array.
+
+        Single controller: the array IS the global batch; device_put shards
+        it.  Multi-process: each controller holds its *local* rows, so the
+        global array is assembled from per-process shards.
+        """
+        if n_procs > 1:
+            from jax.experimental import multihost_utils
+
+            _check_batch(batch, local_shards, "per-process")
+            return jax.tree_util.tree_map(
+                lambda x: jax.make_array_from_process_local_data(
+                    batch_sharding, np.asarray(x)
+                ),
+                batch,
+            )
+        _check_batch(batch, n_shards, "global")
+        return jax.device_put(batch, batch_sharding)
+
+    def _is_placed(batch):
+        leaves = jax.tree_util.tree_leaves(batch)
+        return leaves and all(isinstance(l, jax.Array) for l in leaves)
+
+    def checked_step(params, opt_state, batch):
+        if not _is_placed(batch):
+            batch = _place_batch(batch)
+        return step(params, opt_state, batch)
+
     def place(params, opt_state=None, batch=None):
         """Device-put helper: replicate state, shard a batch."""
         out = [jax.device_put(params, rep)]
         if opt_state is not None:
             out.append(jax.device_put(opt_state, rep))
         if batch is not None:
-            out.append(jax.device_put(batch, batch_sharding))
+            out.append(_place_batch(batch))
         return out[0] if len(out) == 1 else tuple(out)
 
-    step.place = place
-    step.batch_sharding = batch_sharding
-    step.replicated_sharding = rep
-    return step
+    place_batch = _place_batch
+
+    checked_step.place = place
+    checked_step.place_batch = place_batch
+    checked_step.batch_sharding = batch_sharding
+    checked_step.replicated_sharding = rep
+    checked_step.jitted = step
+    return checked_step

@@ -1,0 +1,12 @@
+from .ring_attention import ring_attention  # noqa: F401
+from .ulysses import ulysses_attention  # noqa: F401
+from .pipeline import gpipe  # noqa: F401
+from .tensor_parallel import ColumnParallelDense, RowParallelDense  # noqa: F401
+
+__all__ = [
+    "ring_attention",
+    "ulysses_attention",
+    "gpipe",
+    "ColumnParallelDense",
+    "RowParallelDense",
+]

@@ -1,0 +1,95 @@
+"""Microbatched pipeline parallelism (GPipe schedule) over ICI.
+
+Reference parity: ``MultiNodeChainList`` (chainermn/link.py) partitions a
+model across ranks but runs stages strictly sequentially — a fill-drain
+pipeline with no microbatching (SURVEY.md section 2, row PP).  This module
+is the performance-tier upgrade: homogeneous stages, microbatched GPipe
+schedule, expressed as one SPMD program (``shard_map`` over the 'pp' mesh
+axis) with ``ppermute`` moving activations between neighbor stages.
+
+Shape of the trick: every chip holds ONE stage's params.  At schedule tick
+t, chip s processes microbatch (t - s) while its previous output rides the
+ring to chip s+1 — a skewed ``lax.scan`` over t with static control flow
+(ticks where a chip has no work compute on zeros and are masked out),
+which is exactly how XLA wants a pipeline written: no host round-trips,
+collectives overlapped with compute by the async scheduler.
+
+Backward is generated: differentiating the scan yields the reverse
+schedule with transposed ppermutes (the 1F1B-ish interleaving falls out of
+XLA's scheduling rather than hand-written phases).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def gpipe(
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    stage_params: Any,
+    x_microbatches: jnp.ndarray,
+    axis_name: str,
+) -> jnp.ndarray:
+    """Run a homogeneous-stage pipeline under ``shard_map``.
+
+    Args:
+      stage_fn: ``(params, h) -> h`` — one pipeline stage (same structure
+        on every chip; per-chip *values* differ).
+      stage_params: this chip's stage parameters (shard_map-sharded over
+        ``axis_name``).
+      x_microbatches: (n_micro, micro_batch, ...) — the *input* microbatch
+        stream; only stage 0 actually consumes it (other chips receive
+        activations from their neighbor).
+      axis_name: the pipeline mesh axis.
+
+    Returns:
+      (n_micro, micro_batch, ...) — the final stage's outputs for every
+      microbatch, valid on the LAST stage's chip (zeros elsewhere; callers
+      typically ``functions.bcast`` or compute loss on the last stage and
+      ``psum``).
+    """
+    n_stage = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    n_micro = x_microbatches.shape[0]
+    total_ticks = n_micro + n_stage - 1
+    h_shape = x_microbatches.shape[1:]
+
+    fwd_perm = [(i, (i + 1) % n_stage) for i in range(n_stage)]
+
+    def tick(carry, t):
+        incoming, outputs = carry
+        # Stage 0 injects microbatch t (if any); others use the ring input.
+        mb_idx = jnp.clip(t, 0, n_micro - 1)
+        my_mb = jnp.clip(t - me, 0, n_micro - 1)
+        inject = lax.dynamic_index_in_dim(
+            x_microbatches, mb_idx, keepdims=False
+        )
+        h_in = jnp.where(me == 0, inject, incoming)
+        h_out = stage_fn(stage_params, h_in)
+        # Valid iff this chip is working on a real microbatch this tick.
+        valid = (t >= me) & (t - me < n_micro)
+        h_out = jnp.where(valid, h_out, jnp.zeros_like(h_out))
+        # Last stage records its output for microbatch (t - me).
+        is_last = me == n_stage - 1
+        record = jnp.where(valid & is_last, h_out, jnp.zeros_like(h_out))
+        outputs = lax.dynamic_update_index_in_dim(
+            outputs,
+            jnp.where(valid & is_last, record,
+                      lax.dynamic_index_in_dim(outputs, my_mb,
+                                               keepdims=False)),
+            my_mb, axis=0,
+        )
+        # Ship to the next stage.
+        incoming = lax.ppermute(h_out, axis_name, fwd_perm)
+        return (incoming, outputs), None
+
+    incoming0 = jnp.zeros(h_shape, x_microbatches.dtype)
+    outputs0 = jnp.zeros((n_micro,) + h_shape, x_microbatches.dtype)
+    (_, outputs), _ = lax.scan(
+        tick, (incoming0, outputs0), jnp.arange(total_ticks)
+    )
+    return outputs

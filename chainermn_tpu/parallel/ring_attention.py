@@ -1,0 +1,109 @@
+"""Ring attention — sequence/context parallelism over ICI.
+
+The reference predates attention entirely (SURVEY.md section 5.7): its
+closest primitives are the differentiable p2p send/recv
+(point_to_point_communication.py) and the hidden-state-streaming RNN.
+This module is the modern capability those primitives point at: shard the
+*sequence* across chips and compute exact attention by rotating key/value
+blocks around the ICI ring (Liu et al., "Ring Attention with Blockwise
+Transformers"), overlapping each block's compute with the next block's
+transfer.
+
+Design: runs inside ``shard_map`` with queries resident and K/V blocks
+circulating via ``lax.ppermute``; softmax is computed online (running max
+and normalizer), so memory is O(seq_shard) regardless of total sequence
+length.  Causal masking uses the ring step to decide block visibility —
+entire future blocks are skipped numerically (their contribution is
+masked), keeping control flow static for XLA.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def _block_attend(q, k, v, bias, scale):
+    """One (q_block, k_block) attention partial: returns (unnormalized
+    numerator, running max, running denominator) pieces."""
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if bias is not None:
+        s = s + bias
+    m = jnp.max(s, axis=-1, keepdims=True)  # (b, h, q, 1)
+    p = jnp.exp(s - m)
+    num = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    den = jnp.sum(p, axis=-1, keepdims=True)  # (b, h, q, 1)
+    return num, den, m
+
+
+def ring_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jnp.ndarray:
+    """Exact attention over a sequence sharded along ``axis_name``.
+
+    Args:
+      q, k, v: (batch, seq_shard, heads, head_dim) — the local sequence
+        block of each chip.  Must be called inside ``shard_map`` with the
+        sequence axis bound to ``axis_name``.
+      causal: apply a causal mask consistent with the *global* sequence
+        order (shard r holds positions [r*S, (r+1)*S)).
+    Returns:
+      (batch, seq_shard, heads, head_dim) attention output for the local
+      queries, numerically identical to full attention over the gathered
+      sequence.
+    """
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+
+    neg = jnp.asarray(jnp.finfo(q.dtype).min, q.dtype)
+
+    def causal_bias(kv_owner):
+        """Bias for my query block attending kv_owner's key block."""
+        # global positions: q_pos = my*s_q + i ; k_pos = kv_owner*s_k + j
+        qi = my * s_q + jnp.arange(s_q)[:, None]
+        kj = kv_owner * s_k + jnp.arange(s_k)[None, :]
+        return jnp.where(qi >= kj, 0.0, neg).astype(q.dtype)[None, None]
+
+    def body(carry, step):
+        kb, vb, num, den, mx = carry
+        owner = (my - step) % n  # whose block we currently hold
+        bias = causal_bias(owner) if causal else None
+        bnum, bden, bm = _block_attend(q, kb, vb, bias, scale)
+        # online softmax merge
+        new_m = jnp.maximum(mx, bm)
+        corr_old = jnp.exp(mx - new_m)
+        corr_new = jnp.exp(bm - new_m)
+        # (b,h,q,1) -> (b,q,h,1) to broadcast against num's (b,q,h,d)
+        num = num * jnp.swapaxes(corr_old, 1, 2) + bnum * jnp.swapaxes(
+            corr_new, 1, 2
+        )
+        den = den * corr_old + bden * corr_new
+        # rotate K/V to the next chip (overlaps with next iteration's
+        # compute under XLA's async collective scheduling)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, num, den, new_m), None
+
+    num0 = jnp.zeros((b, s_q, h, d), q.dtype)
+    den0 = jnp.zeros((b, h, s_q, 1), q.dtype)
+    m0 = jnp.full((b, h, s_q, 1), neg, q.dtype)
+    (_, _, num, den, _), _ = lax.scan(
+        body, (k, v, num0, den0, m0), jnp.arange(n)
+    )
+    out = num / jnp.swapaxes(jnp.maximum(den, 1e-20), 1, 2)
+    return out.astype(q.dtype)

@@ -1,0 +1,80 @@
+"""Ulysses (all-to-all) sequence parallelism.
+
+The reference's differentiable ``alltoall``
+(chainermn/functions/collective_communication.py) is exactly the primitive
+DeepSpeed-Ulysses builds on (SURVEY.md section 5.7); this module is that
+modern capability: attention over a sequence sharded across chips, by
+exchanging sequence-sharding for head-sharding around the attention core.
+
+seq-sharded (b, S/n, H, d) --all_to_all--> head-sharded (b, S, H/n, d)
+  -> exact local attention over the full sequence per head
+  --all_to_all--> seq-sharded output.
+
+Two all-to-alls per attention instead of ring steps; preferable when
+head_count >= chip_count and the interconnect favors bulk transposes
+(single ICI hop) over n-step rings.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+import jax.numpy as jnp
+from jax import lax
+
+
+def _default_attention(q, k, v, causal: bool, scale: float):
+    s = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        S_q, S_k = s.shape[-2], s.shape[-1]
+        mask = jnp.tril(jnp.ones((S_q, S_k), bool))
+        s = jnp.where(mask[None, None], s, jnp.finfo(s.dtype).min)
+    p = jnp.asarray(
+        jnp.exp(s - jnp.max(s, axis=-1, keepdims=True)), s.dtype
+    )
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def ulysses_attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    axis_name: str,
+    *,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    attention_fn: Optional[Callable] = None,
+) -> jnp.ndarray:
+    """Attention over a sequence sharded along ``axis_name``.
+
+    Args:
+      q, k, v: (batch, seq_shard, heads, head_dim) local blocks; ``heads``
+        must be divisible by the axis size.  Call inside ``shard_map``.
+      attention_fn: optional core ``(q, k, v, causal, scale) -> out`` run
+        on full-sequence, head-sharded blocks (e.g. a Pallas flash kernel).
+    Returns:
+      (batch, seq_shard, heads, head_dim), numerically equal to full
+      attention over the gathered sequence.
+    """
+    n = lax.axis_size(axis_name)
+    b, s, h, d = q.shape
+    if h % n:
+        raise ValueError(f"heads ({h}) must be divisible by axis size ({n})")
+    if scale is None:
+        scale = d**-0.5
+
+    def seq_to_heads(x):
+        # (b, S/n, H, d) -> (b, S, H/n, d): split heads across chips,
+        # gather sequence.
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    qh, kh, vh = seq_to_heads(q), seq_to_heads(k), seq_to_heads(v)
+    core = attention_fn or _default_attention
+    out = core(qh, kh, vh, causal, scale)
+    return heads_to_seq(out).astype(q.dtype)

@@ -33,6 +33,7 @@ class Updater:
         self.step_fn = step_fn
         self.params = params
         self.opt_state = opt_state
+        self._explicit_sharding = batch_sharding is not None
         self.batch_sharding = batch_sharding or getattr(
             step_fn, "batch_sharding", None
         )
@@ -48,7 +49,10 @@ class Updater:
 
     def update(self) -> None:
         batch = next(self.iterator)
-        if self.batch_sharding is not None:
+        place_batch = getattr(self.step_fn, "place_batch", None)
+        if place_batch is not None and not self._explicit_sharding:
+            batch = place_batch(batch)
+        elif self.batch_sharding is not None:
             batch = jax.device_put(batch, self.batch_sharding)
         self.params, self.opt_state, self.last_metrics = self.step_fn(
             self.params, self.opt_state, batch

@@ -1,0 +1,3 @@
+from .toy_datasets import get_mnist, SyntheticImageDataset  # noqa: F401
+
+__all__ = ["get_mnist", "SyntheticImageDataset"]

@@ -1,0 +1,78 @@
+"""Toy / benchmark datasets.
+
+The reference examples load real MNIST/ImageNet from disk or network; this
+environment is zero-egress, so examples and benches default to deterministic
+synthetic datasets with the same shapes and a learnable signal (class
+centroids + noise) — loss must actually go down for the end-to-end examples
+to count as working.  A real on-disk dataset is used automatically when a
+path is provided (``CHAINERMN_TPU_MNIST`` env var or ``path=`` argument
+pointing at an ``mnist.npz``).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Tuple
+
+import numpy as np
+
+
+class SyntheticImageDataset:
+    """Classification dataset: per-class centroid + Gaussian noise."""
+
+    def __init__(self, n: int, shape: Tuple[int, ...] = (28, 28),
+                 n_classes: int = 10, seed: int = 0, noise: float = 0.35,
+                 dtype=np.float32, centroid_seed: int = 12345):
+        # Centroids (the "task") are seeded independently of the sample
+        # draw so train/test splits share classes.
+        self._centroids = np.random.RandomState(centroid_seed).randn(
+            n_classes, *shape
+        ).astype(dtype)
+        rng = np.random.RandomState(seed)
+        self._labels = rng.randint(0, n_classes, size=n).astype(np.int32)
+        self._noise = noise
+        self._shape = shape
+        self._dtype = dtype
+        self._n = n
+        # Per-sample noise seeded by index for determinism without storing
+        # the full array (ImageNet-sized synthetic sets stay O(1) memory).
+        self._seed = seed
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if not -self._n <= i < self._n:
+            raise IndexError(i)
+        if i < 0:
+            i += self._n
+        y = self._labels[i]
+        rng = np.random.RandomState((self._seed * 1_000_003 + i) % (2**31))
+        x = self._centroids[y] + self._noise * rng.randn(*self._shape).astype(
+            self._dtype
+        )
+        return x.astype(self._dtype), np.int32(y)
+
+
+def get_mnist(path: Optional[str] = None, n_train: int = 60000,
+              n_test: int = 10000, seed: int = 0):
+    """(train, test) datasets of ((28, 28) float32, int32 label) pairs.
+
+    Loads real MNIST from an ``mnist.npz`` when available; otherwise
+    returns the synthetic stand-in (same shapes/cardinality).
+    """
+    path = path or os.environ.get("CHAINERMN_TPU_MNIST")
+    if path and os.path.exists(path):
+        with np.load(path) as d:
+            xtr = d["x_train"].astype(np.float32) / 255.0
+            ytr = d["y_train"].astype(np.int32)
+            xte = d["x_test"].astype(np.float32) / 255.0
+            yte = d["y_test"].astype(np.int32)
+        train = [(xtr[i], ytr[i]) for i in range(len(xtr))]
+        test = [(xte[i], yte[i]) for i in range(len(xte))]
+        return train, test
+    train = SyntheticImageDataset(n_train, seed=seed)
+    test = SyntheticImageDataset(n_test, seed=seed + 1)
+    return train, test

@@ -1,0 +1,141 @@
+"""Data-layer tests.
+
+Parity: ``datasets_tests/test_scatter_dataset.py`` (shards partition the
+set, shuffle determinism), ``iterators_tests/test_multi_node_iterator.py``,
+``test_synchronized_iterator.py``.
+"""
+
+import numpy as np
+import pytest
+
+import chainermn_tpu as cmn
+from chainermn_tpu.datasets import scatter_dataset, create_empty_dataset
+from chainermn_tpu.datasets.scatter_dataset import scatter_dataset_all
+from chainermn_tpu.iterators import (
+    SerialIterator,
+    create_multi_node_iterator,
+    create_synchronized_iterator,
+)
+
+
+@pytest.fixture(scope="module")
+def comm(devices8):
+    return cmn.create_communicator("naive", devices=devices8)
+
+
+class TestScatterDataset:
+    def test_process_shard_is_whole_set_single_controller(self, comm):
+        ds = list(range(100))
+        shard = scatter_dataset(ds, comm)
+        assert len(shard) == 100
+
+    def test_per_rank_shards_partition(self, comm):
+        ds = list(range(64))
+        shards = scatter_dataset_all(ds, comm)
+        seen = sorted(x for s in shards for x in s[:])
+        assert seen == sorted(ds)
+        assert all(len(s) == 8 for s in shards)
+
+    def test_equalized_length_with_remainder(self, comm):
+        ds = list(range(61))  # not divisible by 8
+        shards = scatter_dataset_all(ds, comm)
+        lengths = {len(s) for s in shards}
+        assert len(lengths) == 1  # every rank steps the same count
+        assert sum(len(s) for s in shards) >= 61
+
+    def test_shuffle_determinism(self, comm):
+        ds = list(range(64))
+        a = scatter_dataset(ds, comm, shuffle=True, seed=7, rank=3,
+                            n_shards=8)
+        b = scatter_dataset(ds, comm, shuffle=True, seed=7, rank=3,
+                            n_shards=8)
+        assert a[:] == b[:]
+        c = scatter_dataset(ds, comm, shuffle=True, seed=8, rank=3,
+                            n_shards=8)
+        assert a[:] != c[:]
+
+    def test_getitem_bounds(self, comm):
+        ds = list(range(16))
+        s = scatter_dataset(ds, comm, rank=0, n_shards=8)
+        assert len(s) == 2
+        with pytest.raises(IndexError):
+            s[2]
+        assert s[-1] == s[1]
+
+
+class TestEmptyDataset:
+    def test_length_preserved_and_none(self):
+        ds = create_empty_dataset(list(range(37)))
+        assert len(ds) == 37
+        assert ds[0] is None and ds[36] is None
+        with pytest.raises(IndexError):
+            ds[37]
+
+
+class TestSerialIterator:
+    def test_epoch_accounting(self):
+        ds = [(np.zeros(2), np.int32(0))] * 10
+        it = SerialIterator(ds, 4, shuffle=False)
+        batches = [next(it) for _ in range(5)]
+        assert it.epoch >= 2
+        x, y = batches[0]
+        assert x.shape == (4, 2)
+
+    def test_no_repeat_stops(self):
+        ds = [(np.zeros(2), np.int32(0))] * 8
+        it = SerialIterator(ds, 4, repeat=False, shuffle=False)
+        n = 0
+        try:
+            while True:
+                next(it)
+                n += 1
+                if n > 10:
+                    break
+        except StopIteration:
+            pass
+        assert n <= 10
+
+
+class TestSynchronizedIterator:
+    def test_same_order_across_ranks(self, comm):
+        """Each emulated process makes its *first* synchronized-iterator
+        call (reset the per-call counter to mimic a fresh process); all
+        must draw the same shuffle order."""
+        ds = [(np.full(1, i), np.int32(i % 3)) for i in range(32)]
+        its = []
+        for r in range(3):
+            comm._sync_iterator_calls = 0  # fresh "process"
+            its.append(
+                create_synchronized_iterator(
+                    SerialIterator(ds, 4, shuffle=True, seed=r), comm
+                )
+            )
+        b0 = [next(its[0])[0].ravel().tolist() for _ in range(4)]
+        for it in its[1:]:
+            b = [next(it)[0].ravel().tolist() for _ in range(4)]
+            assert b == b0
+
+    def test_distinct_iterators_draw_independent_orders(self, comm):
+        """Two synchronized iterators on the same communicator (train/val)
+        must NOT be correlated — per-call counter mixes the seed."""
+        ds = [(np.full(1, i), np.int32(0)) for i in range(32)]
+        it1 = create_synchronized_iterator(
+            SerialIterator(ds, 4, shuffle=True, seed=0), comm
+        )
+        it2 = create_synchronized_iterator(
+            SerialIterator(ds, 4, shuffle=True, seed=0), comm
+        )
+        b1 = [next(it1)[0].ravel().tolist() for _ in range(4)]
+        b2 = [next(it2)[0].ravel().tolist() for _ in range(4)]
+        assert b1 != b2
+
+
+class TestMultiNodeIterator:
+    def test_all_ranks_see_master_stream(self, comm):
+        ds = [(np.full(1, i), np.int32(0)) for i in range(16)]
+        base = SerialIterator(ds, 4, shuffle=False)
+        it = create_multi_node_iterator(base, comm)
+        x, _ = next(it)
+        assert x.shape == (4, 1)
+        # attribute delegation
+        assert it.batch_size == 4

@@ -1,0 +1,168 @@
+"""Extensions + trainer-loop tests.
+
+Parity: ``extensions_tests/test_checkpoint.py`` (snapshot/resume
+round-trip), evaluator test, ``test_allreduce_persistent.py``; plus the
+trainer loop this framework provides in place of Chainer's.
+"""
+
+import os
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.extensions.evaluator import Evaluator
+from chainermn_tpu.extensions.allreduce_persistent import AllreducePersistent
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.iterators.serial_iterator import EpochIterator
+from chainermn_tpu.training import Trainer, Updater
+from chainermn_tpu.training import extensions as T
+from chainermn_tpu.models import MLP
+from chainermn_tpu.utils import SyntheticImageDataset
+
+
+@pytest.fixture(scope="module")
+def comm(devices8):
+    return cmn.create_communicator("tpu", devices=devices8)
+
+
+def _make_training(comm, n=256, batch=64):
+    ds = SyntheticImageDataset(n, shape=(8, 8), n_classes=4, seed=0)
+    it = SerialIterator(ds, batch, shuffle=True, seed=1)
+    model = MLP(n_units=32, n_out=4, dtype=jnp.float32)
+    params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 8, 8)))
+    params = comm.bcast_data(params)
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+
+    def loss_fn(p, b):
+        x, y = b
+        logits = model.apply(p, x)
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, y
+        ).mean()
+
+    step = cmn.build_train_step(comm, loss_fn, opt, donate=False)
+    params, opt_state = step.place(params, opt.init(params))
+    return model, it, step, params, opt_state
+
+
+class TestTrainerLoop:
+    def test_loss_decreases(self, comm):
+        model, it, step, params, opt_state = _make_training(comm)
+        updater = Updater(it, step, params, opt_state)
+        trainer = Trainer(updater, stop_trigger=(3, "epoch"))
+        log = T.LogReport(comm=comm, filename=None)
+        trainer.extend(log, trigger=(1, "epoch"))
+        trainer.run()
+        losses = [e["loss"] for e in log.log if "loss" in e]
+        assert len(losses) >= 2
+        assert losses[-1] < losses[0]
+
+    def test_stop_by_iteration(self, comm):
+        model, it, step, params, opt_state = _make_training(comm)
+        trainer = Trainer(
+            Updater(it, step, params, opt_state),
+            stop_trigger=(5, "iteration"),
+        )
+        trainer.run()
+        assert trainer.iteration == 5
+
+
+class TestEvaluator:
+    def test_global_metrics(self, comm):
+        model, it, step, params, opt_state = _make_training(comm)
+        ds = SyntheticImageDataset(128, shape=(8, 8), n_classes=4, seed=9)
+
+        def metric_fn(p, b):
+            x, y = b
+            logits = model.apply(p, x)
+            return {
+                "accuracy": (jnp.argmax(logits, -1) == y).mean(),
+            }
+
+        ev = Evaluator(lambda: EpochIterator(ds, 64), metric_fn, comm)
+        out = ev.evaluate(params)
+        assert "val/accuracy" in out
+        assert 0.0 <= out["val/accuracy"] <= 1.0
+
+    def test_create_multi_node_evaluator_passthrough(self, comm):
+        model, it, step, params, opt_state = _make_training(comm)
+        ev = Evaluator(lambda: iter(()), lambda p, b: {}, comm)
+        assert cmn.create_multi_node_evaluator(ev, comm) is ev
+
+    def test_wrap_foreign_evaluator(self, comm):
+        class Plain:
+            def evaluate(self):
+                return {"loss": 2.0}
+
+        wrapped = cmn.create_multi_node_evaluator(Plain(), comm)
+        assert wrapped.evaluate() == {"loss": 2.0}
+
+
+class TestCheckpointer:
+    def test_save_resume_roundtrip(self, comm, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t1", comm, path=str(tmp_path)
+        )
+        state = {
+            "params": {"w": jnp.arange(4.0)},
+            "step_meta": {"iteration": 7},
+        }
+        ckpt.save(7, state)
+        step, restored = ckpt.resume(like=state)
+        assert step == 7
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), np.arange(4.0)
+        )
+
+    def test_newest_common_step_and_gc(self, comm, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t2", comm, path=str(tmp_path), keep=2
+        )
+        for s in (1, 2, 3):
+            ckpt.save(s, {"x": jnp.zeros(2)})
+        assert ckpt.newest_common_step() == 3
+        assert len(ckpt._available_steps()) == 2  # GC kept last 2
+
+    def test_resume_empty_returns_none(self, comm, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t3", comm, path=str(tmp_path)
+        )
+        assert ckpt.resume() == (None, None)
+
+
+class TestAllreducePersistent:
+    def test_single_controller_identity(self, comm):
+        arp = AllreducePersistent(comm)
+        stats = {"mean": jnp.arange(3.0)}
+        out = arp.reduce(stats)
+        np.testing.assert_allclose(np.asarray(out["mean"]), np.arange(3.0))
+
+
+class TestGlobalExceptHook:
+    def test_install_remove(self):
+        import sys
+
+        from chainermn_tpu import global_except_hook as geh
+
+        old = sys.excepthook
+        geh.add_hook()
+        assert sys.excepthook is not old
+        geh.remove_hook()
+        assert sys.excepthook is sys.__excepthook__
+
+
+class TestThroughputExtension:
+    def test_reports_after_warmup(self, comm):
+        model, it, step, params, opt_state = _make_training(comm)
+        trainer = Trainer(
+            Updater(it, step, params, opt_state),
+            stop_trigger=(6, "iteration"),
+        )
+        trainer.extend(T.Throughput(64, comm=comm), trigger=(1, "iteration"))
+        trainer.run()
+        assert "samples_per_sec" in trainer.observation
+        assert trainer.observation["samples_per_sec_per_chip"] > 0

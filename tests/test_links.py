@@ -1,0 +1,136 @@
+"""Link tests.
+
+Parity: ``links_tests/test_batch_normalization.py`` — MultiNodeBatchNorm
+must equal single-process large-batch BatchNorm; ``test_n_step_rnn.py``.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+import chainermn_tpu as cmn
+from chainermn_tpu.links import (
+    MultiNodeBatchNormalization,
+    create_mnbn_model,
+    create_multi_node_n_step_rnn,
+)
+from chainermn_tpu.links.create_mnbn_model import mnbn_factory
+
+
+@pytest.fixture(scope="module")
+def comm(devices8):
+    return cmn.create_communicator("tpu", devices=devices8)
+
+
+class TestMultiNodeBatchNormalization:
+    def test_matches_large_batch_bn(self, comm):
+        """Sharded MNBN over 8 devices == plain BN over the full batch."""
+        C = 6
+        x = np.random.RandomState(0).randn(32, C).astype(np.float32)
+
+        mnbn = MultiNodeBatchNormalization(
+            size=C, axis_name=comm.axis_names
+        )
+        variables = mnbn.init(jax.random.PRNGKey(0), jnp.zeros((4, C)))
+
+        def fwd(v, xs):
+            y, _ = mnbn.apply(v, xs, mutable=["batch_stats"])
+            return y
+
+        sharded = jax.jit(
+            jax.shard_map(
+                fwd, mesh=comm.mesh,
+                in_specs=(P(), P(comm.axis_names)),
+                out_specs=P(comm.axis_names),
+                check_vma=False,
+            )
+        )
+        xg = jax.device_put(jnp.asarray(x), comm.stack_sharding)
+        y_sharded = np.asarray(sharded(variables, xg))
+
+        # Oracle: same normalization over the full batch, no axis reduce.
+        bn = MultiNodeBatchNormalization(size=C, axis_name=None)
+        y_full = np.asarray(
+            bn.apply(variables, jnp.asarray(x), mutable=["batch_stats"])[0]
+        )
+        np.testing.assert_allclose(y_sharded, y_full, rtol=1e-4, atol=1e-5)
+
+    def test_gradient_flows_through_pmean(self, comm):
+        C = 4
+        mnbn = MultiNodeBatchNormalization(size=C, axis_name=comm.axis_names)
+        v = mnbn.init(jax.random.PRNGKey(0), jnp.zeros((2, C)))
+
+        def loss(v, xs):
+            y, _ = mnbn.apply(v, xs, mutable=["batch_stats"])
+            return jnp.sum(y**2)
+
+        def per_shard(v, xs):
+            l, g = jax.value_and_grad(loss)(v, xs)
+            return jax.lax.pmean(l, comm.axis_names), jax.tree_util.tree_map(
+                lambda t: jax.lax.pmean(t, comm.axis_names), g
+            )
+
+        f = jax.jit(
+            jax.shard_map(
+                per_shard, mesh=comm.mesh,
+                in_specs=(P(), P(comm.axis_names)),
+                out_specs=(P(), P()),
+                check_vma=False,
+            )
+        )
+        x = jnp.asarray(np.random.RandomState(1).randn(16, C), jnp.float32)
+        l, g = f(v, jax.device_put(x, comm.stack_sharding))
+        assert np.isfinite(float(l))
+        gnorm = sum(
+            float(jnp.sum(jnp.abs(t))) for t in jax.tree_util.tree_leaves(g)
+        )
+        assert np.isfinite(gnorm)
+
+    def test_eval_mode_uses_running_stats(self):
+        C = 3
+        mnbn = MultiNodeBatchNormalization(size=C, axis_name=None)
+        v = mnbn.init(jax.random.PRNGKey(0), jnp.zeros((2, C)))
+        x = jnp.asarray(np.random.RandomState(2).randn(5, C), jnp.float32)
+        y = mnbn.apply(v, x, use_running_average=True)
+        # running stats are (0, 1) at init -> output == scale*x + bias == x
+        np.testing.assert_allclose(np.asarray(y), np.asarray(x), rtol=1e-5,
+                                   atol=1e-5)
+
+
+class TestMnbnFactory:
+    def test_factory_builds_bound_module(self, comm):
+        make = mnbn_factory(comm)
+        m = make(16)
+        assert isinstance(m, MultiNodeBatchNormalization)
+        assert m.axis_name == comm.axis_names
+
+    def test_create_mnbn_model_replaces_norm_field(self, comm):
+        from chainermn_tpu.models import ResNet18
+
+        model = ResNet18(num_classes=10)
+        mn = create_mnbn_model(model, comm)
+        m = mn.norm(8)
+        assert isinstance(m, MultiNodeBatchNormalization)
+
+
+class TestNStepRNN:
+    def test_forward_shapes_and_state_handoff(self):
+        rnn = create_multi_node_n_step_rnn(hidden_size=16, num_layers=2)
+        x = jnp.zeros((3, 5, 8))
+        v = rnn.init(jax.random.PRNGKey(0), x)
+        (h, c), ys = rnn.apply(v, x)
+        assert h.shape == (2, 3, 16) and c.shape == (2, 3, 16)
+        assert ys.shape == (3, 5, 16)
+        # hand-off: feed state back in (as the next pipeline stage would)
+        (h2, c2), ys2 = rnn.apply(v, x, (h, c))
+        assert ys2.shape == (3, 5, 16)
+
+    def test_recurrence_actually_runs(self):
+        rnn = create_multi_node_n_step_rnn(hidden_size=4, num_layers=1)
+        x = jnp.asarray(np.random.RandomState(0).randn(2, 6, 3), jnp.float32)
+        v = rnn.init(jax.random.PRNGKey(1), x)
+        _, ys = rnn.apply(v, x)
+        # outputs at different timesteps must differ (state evolves)
+        assert not np.allclose(np.asarray(ys[:, 0]), np.asarray(ys[:, -1]))

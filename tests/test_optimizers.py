@@ -1,0 +1,127 @@
+"""Multi-node optimizer tests.
+
+Parity: ``optimizers_tests/test_multi_node_optimizer.py`` — grads applied
+equal the mean of per-rank grads; double-buffering staleness semantics.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.optimizers import build_train_step
+
+
+@pytest.fixture(scope="module")
+def comm(devices8):
+    return cmn.create_communicator("tpu", devices=devices8)
+
+
+def _quadratic_loss(params, batch):
+    # loss = 0.5 * ||w - x_mean||^2 per shard; grad = w - mean(local batch)
+    x = batch
+    return 0.5 * jnp.sum((params["w"] - x.mean(axis=0)) ** 2)
+
+
+class TestGradientSync:
+    def test_update_applies_mean_gradient(self, comm):
+        opt = cmn.create_multi_node_optimizer(optax.sgd(1.0), comm)
+        params = {"w": jnp.zeros((4,))}
+        step = build_train_step(comm, _quadratic_loss, opt, donate=False)
+        params, opt_state = step.place(params, opt.init(params))
+        # batch: shard r has all-r rows -> local grad = w - r
+        x = jnp.stack([jnp.full((4,), float(r)) for r in range(8)])
+        bx = jax.device_put(x, step.batch_sharding)
+        new_params, _, metrics = step(params, opt_state, bx)
+        # mean over ranks of (w - r) = -3.5 ; sgd(1.0): w <- w + 3.5
+        np.testing.assert_allclose(np.asarray(new_params["w"]), 3.5, rtol=1e-6)
+
+    def test_loss_is_global_mean(self, comm):
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.0), comm)
+        params = {"w": jnp.zeros((4,))}
+        step = build_train_step(comm, _quadratic_loss, opt, donate=False)
+        params, opt_state = step.place(params, opt.init(params))
+        x = jnp.stack([jnp.full((4,), float(r)) for r in range(8)])
+        _, _, metrics = step(params, opt_state, jax.device_put(x, step.batch_sharding))
+        expect = np.mean([0.5 * 4 * r * r for r in range(8)])
+        np.testing.assert_allclose(float(metrics["loss"]), expect, rtol=1e-5)
+
+    def test_gspmd_path_matches_shard_map_path(self, comm):
+        opt1 = cmn.create_multi_node_optimizer(optax.sgd(0.5), comm)
+        opt2 = optax.sgd(0.5)
+        params = {"w": jnp.ones((4,))}
+        x = jnp.stack([jnp.full((4,), float(r)) for r in range(8)])
+
+        s1 = build_train_step(comm, _quadratic_loss, opt1, donate=False)
+        p1, o1 = s1.place(params, opt1.init(params))
+        p1, _, _ = s1(p1, o1, jax.device_put(x, s1.batch_sharding))
+
+        def global_loss(params, batch):
+            return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+        s2 = build_train_step(comm, global_loss, opt2, donate=False,
+                              use_shard_map=False)
+        p2, o2 = s2.place(params, opt2.init(params))
+        p2, _, _ = s2(p2, o2, jax.device_put(x, s2.batch_sharding))
+        # Note: shard-map path averages per-shard losses of per-shard means;
+        # GSPMD path differentiates global-batch mean. For this loss both
+        # give w - mean(r) gradients.
+        np.testing.assert_allclose(
+            np.asarray(p1["w"]), np.asarray(p2["w"]), rtol=1e-5
+        )
+
+
+class TestDoubleBuffering:
+    def test_first_update_is_zero_then_stale(self, comm):
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(1.0), comm, double_buffering=True
+        )
+        params = {"w": jnp.zeros((2,))}
+        step = build_train_step(comm, _quadratic_loss, opt, donate=False)
+        params, opt_state = step.place(params, opt.init(params))
+        x = jnp.stack([jnp.full((2,), float(r)) for r in range(8)])
+        bx = jax.device_put(x, step.batch_sharding)
+
+        p1, opt_state, _ = step(params, opt_state, bx)
+        # step 1 applied zeros (no synced grads yet)
+        np.testing.assert_allclose(np.asarray(p1["w"]), 0.0, atol=1e-7)
+        p2, opt_state, _ = step(p1, opt_state, bx)
+        # step 2 applies step-1's grads: mean(w0 - r) = -3.5 -> w = 3.5
+        np.testing.assert_allclose(np.asarray(p2["w"]), 3.5, rtol=1e-6)
+
+    def test_state_carries_step_count(self, comm):
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm, double_buffering=True
+        )
+        params = {"w": jnp.zeros((2,))}
+        state = opt.init(params)
+        assert int(state.step) == 0
+        assert "prev_grads" in state._fields
+
+
+class TestReducedPrecisionGrads:
+    def test_bf16_grad_sync_close_to_fp32(self, devices8):
+        comm_bf16 = cmn.create_communicator(
+            "tpu", devices=devices8, allreduce_grad_dtype=jnp.bfloat16
+        )
+        comm_fp32 = cmn.create_communicator("tpu", devices=devices8)
+        params = {"w": jnp.zeros((4,))}
+        x = jnp.stack([jnp.full((4,), float(r)) for r in range(8)])
+        outs = []
+        for comm in (comm_bf16, comm_fp32):
+            opt = cmn.create_multi_node_optimizer(optax.sgd(1.0), comm)
+            step = build_train_step(comm, _quadratic_loss, opt, donate=False)
+            p, o = step.place(params, opt.init(params))
+            p, _, _ = step(p, o, jax.device_put(x, step.batch_sharding))
+            outs.append(np.asarray(p["w"]))
+        np.testing.assert_allclose(outs[0], outs[1], rtol=2e-2)
+
+
+class TestDelegation:
+    def test_wrapper_exposes_inner(self, comm):
+        inner = optax.adam(1e-3)
+        opt = cmn.create_multi_node_optimizer(inner, comm)
+        assert opt.actual_optimizer is inner
+        assert opt.communicator is comm

@@ -92,34 +92,40 @@ class MultiNodeChainList:
                 lambda t: jax.device_put(t, dev), inp
             )
             rng, sub = jax.random.split(rng)
-            p = st.module.init(sub, *inp) if isinstance(inp, tuple) else (
-                st.module.init(sub, inp)
-            )
+            p = st.module.init(sub, *inp)
             p = jax.device_put(p, dev)
             params.append(p)
-            out = st.module.apply(p, *inp) if isinstance(inp, tuple) else (
-                st.module.apply(p, inp)
-            )
-            outputs[st.index] = out
+            outputs[st.index] = st.module.apply(p, *inp)
         return params
 
-    def _resolve_input(self, st: _Stage, x, outputs: dict):
-        """Input(s) of a stage: the external input or upstream outputs.
+    def _resolve_input(self, st: _Stage, x, outputs: dict) -> tuple:
+        """Edge inputs of a stage, one tuple element per incoming edge —
+        each edge becomes one positional argument of the stage module, and
+        an edge's *value* may itself be any pytree (an LSTM ``(h, c)``
+        state travels as a single argument, never spread).
 
         ``rank_in`` semantics follow the reference: ``None`` -> external
         input; an int/list -> output(s) of the stage(s) placed on those
-        rank(s) (multi-input gather when a list).
+        rank(s) (multi-input gather when a list).  A ``None`` *inside* a
+        list means the external input as one of several inputs — the
+        single-controller equivalent of the reference's
+        ``create_multi_node_iterator`` handing every rank the batch (the
+        model-parallel seq2seq decoder consumes the encoder state *and*
+        the target tokens this way).
         """
         if st.rank_in is None:
-            return x
+            return (x,)
         ranks = st.rank_in if isinstance(st.rank_in, (list, tuple)) else [
             st.rank_in
         ]
         ins = []
         for r in ranks:
-            src = self._find_producer(r, before=st.index)
-            ins.append(outputs[src.index])
-        return tuple(ins) if len(ins) > 1 else ins[0]
+            if r is None:
+                ins.append(x)
+            else:
+                src = self._find_producer(r, before=st.index)
+                ins.append(outputs[src.index])
+        return tuple(ins)
 
     def _find_producer(self, rank: int, before: int) -> _Stage:
         for st in reversed(self._stages[:before]):
@@ -150,12 +156,18 @@ class MultiNodeChainList:
     def _stage_fn(self, st: _Stage) -> Callable:
         if not hasattr(st, "_jitted"):
             def run(p, inp, _m=st.module):
-                return _m.apply(p, *inp) if isinstance(inp, tuple) else (
-                    _m.apply(p, inp)
-                )
+                return _m.apply(p, *inp)
 
             st._jitted = jax.jit(run)
         return st._jitted
+
+    # -- optimization --------------------------------------------------
+    def optimizer(self, tx) -> "_StageOptimizer":
+        """Wrap an optax transformation so each stage's optimizer state
+        lives on (and updates happen on) that stage's own chip — the
+        analogue of every reference rank running its own local optimizer
+        over its partition of the model."""
+        return _StageOptimizer(self, tx)
 
     # -- training ------------------------------------------------------
     def value_and_grad(self, loss_fn: Callable):
@@ -179,9 +191,7 @@ class MultiNodeChainList:
                 )
 
                 def run(p, inp, _m=st.module):
-                    return _m.apply(p, *inp) if isinstance(inp, tuple) else (
-                        _m.apply(p, inp)
-                    )
+                    return _m.apply(p, *inp)
 
                 out, vjp = jax.vjp(run, p, inp)
                 outputs[st.index] = out
@@ -209,16 +219,18 @@ class MultiNodeChainList:
                     )
                 g_params, g_in = vjp(ct)
                 grads[st.index] = g_params
-                # Accumulate input cotangent onto producer stage(s).
+                # Accumulate input cotangent onto producer stage(s); g_in
+                # is a tuple with one entry per incoming edge.
                 if st.rank_in is None:
                     continue
                 ranks = st.rank_in if isinstance(
                     st.rank_in, (list, tuple)
                 ) else [st.rank_in]
-                gs = g_in if isinstance(g_in, tuple) and len(ranks) > 1 else (
-                    g_in,
-                )
-                for r, g in zip(ranks, gs):
+                for r, g in zip(ranks, g_in):
+                    if r is None:
+                        # External-input edge: no producer stage; token /
+                        # data cotangents are dropped (symmetric zeros).
+                        continue
                     src = self._find_producer(r, before=st.index)
                     sdev = self._device(src)
                     g = jax.tree_util.tree_map(
@@ -231,3 +243,38 @@ class MultiNodeChainList:
             return loss, grads
 
         return step
+
+
+class _StageOptimizer:
+    """Per-stage optax wrapper for :class:`MultiNodeChainList` (one
+    optimizer state per stage, resident on that stage's chip; a single
+    jitted cross-chip update is impossible and unnecessary)."""
+
+    def __init__(self, chain: MultiNodeChainList, tx):
+        import optax
+
+        self._chain = chain
+        self._tx = tx
+
+        def one(g, s, p):
+            up, s2 = tx.update(g, s, p)
+            return optax.apply_updates(p, up), s2
+
+        self._jitted_update = jax.jit(one)
+
+    def init(self, params: Sequence[Any]) -> List[Any]:
+        return [
+            jax.device_put(self._tx.init(p), self._chain._device(st))
+            for st, p in zip(self._chain._stages, params)
+        ]
+
+    def update(self, grads, state, params):
+        """Returns (new_params, new_state); each stage's whole update
+        (transform + apply) is one compiled computation on its own chip
+        (computation follows data)."""
+        new_params, new_state = [], []
+        for g, s, p in zip(grads, state, params):
+            p2, s2 = self._jitted_update(g, s, p)
+            new_params.append(p2)
+            new_state.append(s2)
+        return new_params, new_state

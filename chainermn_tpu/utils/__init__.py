@@ -1,3 +1,8 @@
-from .toy_datasets import get_mnist, SyntheticImageDataset  # noqa: F401
+from .toy_datasets import (  # noqa: F401
+    get_mnist,
+    SyntheticImageDataset,
+    SyntheticTranslationDataset,
+)
 
-__all__ = ["get_mnist", "SyntheticImageDataset"]
+__all__ = ["get_mnist", "SyntheticImageDataset",
+           "SyntheticTranslationDataset"]

@@ -56,6 +56,54 @@ class SyntheticImageDataset:
         return x.astype(self._dtype), np.int32(y)
 
 
+class SyntheticTranslationDataset:
+    """Deterministic toy "translation" corpus for the seq2seq examples.
+
+    Each source sentence is a random token sequence; the target is the
+    reversed source mapped through a fixed vocabulary permutation, followed
+    by EOS and PAD — a task an encoder-decoder genuinely has to learn
+    (copy + reorder + relabel), standing in for the reference's WMT En-Fr
+    data in this zero-egress environment.  Items are
+    ``(src (T,) int32, tgt (T+1,) int32)`` with static shapes.
+    """
+
+    def __init__(self, n: int, vocab: int = 32, max_len: int = 8,
+                 seed: int = 0):
+        from chainermn_tpu.models.seq2seq import EOS, N_SPECIAL, PAD
+
+        self._pad, self._eos, self._n_special = PAD, EOS, N_SPECIAL
+        self._n = n
+        self._vocab = vocab
+        self._max_len = max_len
+        self._seed = seed
+        # The "language": a fixed permutation of the non-special tokens.
+        perm = np.random.RandomState(9876).permutation(vocab - N_SPECIAL)
+        self._map = np.concatenate(
+            [np.arange(N_SPECIAL), perm + N_SPECIAL]
+        ).astype(np.int32)
+
+    def __len__(self) -> int:
+        return self._n
+
+    def __getitem__(self, i):
+        if isinstance(i, slice):
+            return [self[j] for j in range(*i.indices(self._n))]
+        if not -self._n <= i < self._n:
+            raise IndexError(i)
+        if i < 0:
+            i += self._n
+        rng = np.random.RandomState((self._seed * 999_983 + i) % (2**31))
+        length = rng.randint(2, self._max_len + 1)
+        src = rng.randint(self._n_special, self._vocab, size=length)
+        tgt = self._map[src[::-1]]
+        src_p = np.full((self._max_len,), self._pad, np.int32)
+        src_p[:length] = src
+        tgt_p = np.full((self._max_len + 1,), self._pad, np.int32)
+        tgt_p[:length] = tgt
+        tgt_p[length] = self._eos
+        return src_p, tgt_p
+
+
 def get_mnist(path: Optional[str] = None, n_train: int = 60000,
               n_test: int = 10000, seed: int = 0):
     """(train, test) datasets of ((28, 28) float32, int32 label) pairs.

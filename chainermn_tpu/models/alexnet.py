@@ -1,0 +1,49 @@
+"""AlexNet, TPU-first.
+
+Parity target: ``examples/imagenet/models/alex.py`` in the reference — the
+``Alex`` chain used by ``train_imagenet.py --arch alex``.
+
+TPU-native design choices: NHWC layout, bfloat16 compute with fp32 params,
+no LRN (the reference's local-response-norm is an accelerator-hostile
+depth-window op; per the modern consensus it contributes nothing at these
+scales, so it is dropped rather than emulated — batch statistics do the
+job), dropout gated on ``train``.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class AlexNet(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool | None = None):
+        det = not self.train if deterministic is None else deterministic
+        x = x.astype(self.dtype)
+        x = nn.Conv(96, (11, 11), strides=(4, 4), dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(256, (5, 5), padding=[(2, 2), (2, 2)], dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Conv(384, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(384, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.Conv(256, (3, 3), padding=[(1, 1), (1, 1)], dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=det)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=det)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

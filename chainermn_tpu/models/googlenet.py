@@ -1,0 +1,115 @@
+"""GoogLeNet (Inception-v1) and its BatchNorm variant, TPU-first.
+
+Parity targets: ``examples/imagenet/models/googlenet.py`` and
+``googlenetbn.py`` in the reference.  ``GoogLeNetBN`` is the variant the
+reference pairs with ``create_mnbn_model`` in multi-node runs, so its norm
+layers go through the same ``norm`` factory as ResNet — swapping in
+:class:`~chainermn_tpu.links.MultiNodeBatchNormalization` needs no model
+changes.
+
+TPU notes: inception branches are independent convs XLA schedules
+back-to-back on the MXU; the concat is a free layout op in NHWC.  The
+auxiliary classifier heads of the original paper are omitted (the reference
+uses them only as a training-era regularizer; BN makes them redundant) — loss
+is computed from the main head only.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+from .resnet import default_norm, _bind_norm
+
+
+class Inception(nn.Module):
+    """Four-branch inception block: 1x1 / 3x3 / 5x5 / pool-proj."""
+
+    out1: int
+    proj3: int
+    out3: int
+    proj5: int
+    out5: int
+    proj_pool: int
+    norm: Callable | None = None  # None → plain conv+bias (GoogLeNet v1)
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x):
+        use_norm = self.norm is not None
+        conv = functools.partial(
+            nn.Conv, use_bias=not use_norm, dtype=self.dtype
+        )
+
+        def unit(y, features, kernel, padding="SAME"):
+            y = conv(features, kernel, padding=padding)(y)
+            if use_norm:
+                y = _bind_norm(self.norm, features, self.train)(y)
+            return nn.relu(y)
+
+        b1 = unit(x, self.out1, (1, 1))
+        b3 = unit(unit(x, self.proj3, (1, 1)), self.out3, (3, 3))
+        b5 = unit(unit(x, self.proj5, (1, 1)), self.out5, (5, 5))
+        bp = nn.max_pool(x, (3, 3), strides=(1, 1), padding="SAME")
+        bp = unit(bp, self.proj_pool, (1, 1))
+        return jnp.concatenate([b1, b3, b5, bp], axis=-1)
+
+
+# (out1, proj3, out3, proj5, out5, proj_pool) per inception block, grouped
+# by stage (max-pool between stages) — the v1 paper table.
+_STAGES: Tuple[Tuple[Tuple[int, ...], ...], ...] = (
+    ((64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64)),
+    ((192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64),
+     (128, 128, 256, 24, 64, 64), (112, 144, 288, 32, 64, 64),
+     (256, 160, 320, 32, 128, 128)),
+    ((256, 160, 320, 32, 128, 128), (384, 192, 384, 48, 128, 128)),
+)
+
+
+class GoogLeNet(nn.Module):
+    num_classes: int = 1000
+    norm: Callable | None = None
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool | None = None):
+        det = not self.train if deterministic is None else deterministic
+        use_norm = self.norm is not None
+        conv = functools.partial(
+            nn.Conv, use_bias=not use_norm, dtype=self.dtype
+        )
+
+        def unit(y, features, kernel, **kw):
+            y = conv(features, kernel, **kw)(y)
+            if use_norm:
+                y = _bind_norm(self.norm, features, self.train)(y)
+            return nn.relu(y)
+
+        x = x.astype(self.dtype)
+        x = unit(x, 64, (7, 7), strides=(2, 2), padding=[(3, 3), (3, 3)])
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        x = unit(x, 64, (1, 1))
+        x = unit(x, 192, (3, 3), padding=[(1, 1), (1, 1)])
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for si, stage in enumerate(_STAGES):
+            if si:
+                x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+            for cfg in stage:
+                x = Inception(*cfg, norm=self.norm, dtype=self.dtype,
+                              train=self.train)(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dropout(0.4, deterministic=det)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def GoogLeNetBN(norm: Callable = default_norm, **kw) -> GoogLeNet:
+    """GoogLeNet with BatchNorm after every conv (reference googlenetbn.py);
+    pass a MultiNodeBatchNormalization factory (or use create_mnbn_model)
+    for cross-rank sync-BN."""
+    return GoogLeNet(norm=norm, **kw)

@@ -1,0 +1,60 @@
+"""Network-in-Network, TPU-first.
+
+Parity target: ``examples/imagenet/models/nin.py`` in the reference — the
+``NIN`` chain (mlpconv stacks + global average pooling head).
+
+A 1x1 conv is exactly an MXU matmul over the channel axis, so the mlpconv
+pattern maps perfectly to TPU; NHWC + bfloat16 as elsewhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Tuple
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+
+class _MLPConv(nn.Module):
+    """conv(k) → relu → 1x1 conv → relu → 1x1 conv → relu."""
+
+    features: Tuple[int, int, int]
+    kernel: Tuple[int, int]
+    strides: Tuple[int, int] = (1, 1)
+    padding: Any = "VALID"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        f1, f2, f3 = self.features
+        x = nn.Conv(f1, self.kernel, strides=self.strides,
+                    padding=self.padding, dtype=self.dtype)(x)
+        x = nn.relu(x)
+        x = nn.relu(nn.Conv(f2, (1, 1), dtype=self.dtype)(x))
+        x = nn.relu(nn.Conv(f3, (1, 1), dtype=self.dtype)(x))
+        return x
+
+
+class NIN(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool | None = None):
+        det = not self.train if deterministic is None else deterministic
+        x = x.astype(self.dtype)
+        x = _MLPConv((96, 96, 96), (11, 11), strides=(4, 4),
+                     dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _MLPConv((256, 256, 256), (5, 5), padding=[(2, 2), (2, 2)],
+                     dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = _MLPConv((384, 384, 384), (3, 3), padding=[(1, 1), (1, 1)],
+                     dtype=self.dtype)(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        x = nn.Dropout(0.5, deterministic=det)(x)
+        x = _MLPConv((1024, 1024, self.num_classes), (3, 3),
+                     padding=[(1, 1), (1, 1)], dtype=self.dtype)(x)
+        x = jnp.mean(x, axis=(1, 2))  # global average pooling head
+        return x.astype(jnp.float32)

@@ -21,6 +21,7 @@ TPU-native redesign:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Optional, Tuple
 
 import jax
@@ -143,6 +144,30 @@ def teacher_forcing(ys: jnp.ndarray) -> Tuple[jnp.ndarray, jnp.ndarray]:
     return jnp.concatenate([bos, ys[:, :-1]], axis=1), ys
 
 
+@functools.partial(jax.jit, static_argnums=(0, 3))
+def _greedy_decode(model, variables, xs, max_length: int):
+    """One compiled encode + scan-decode program.
+
+    Module-level and jitted with the (hashable) flax module static, so
+    repeated ``translate`` calls reuse the executable and weights stay
+    runtime arguments rather than baked-in constants; the token loop is a
+    ``lax.scan`` (static trip count, XLA-friendly).
+    """
+    state = model.apply(variables, xs, method=Seq2Seq.encode)
+    tok0 = jnp.full((xs.shape[0],), BOS, jnp.int32)
+
+    def body(carry, _):
+        state, tok = carry
+        new_state, logits = model.apply(
+            variables, state, tok[:, None], method=Seq2Seq.decode
+        )
+        nxt = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+        return (new_state, nxt), nxt
+
+    _, ys = jax.lax.scan(body, (state, tok0), None, length=max_length)
+    return ys.T  # (batch, max_length)
+
+
 def translate(model: Seq2Seq, variables, xs: jnp.ndarray,
               max_length: int = 24) -> np.ndarray:
     """Greedy decode (reference ``Seq2seq.translate``): encode once, then
@@ -151,21 +176,7 @@ def translate(model: Seq2Seq, variables, xs: jnp.ndarray,
     Returns int32 tokens ``(batch, max_length)`` with everything after the
     first EOS replaced by PAD.
     """
-    state = model.apply(variables, xs, method=Seq2Seq.encode)
-
-    @jax.jit
-    def step(state, tok):
-        new_state, logits = model.apply(
-            variables, state, tok[:, None], method=Seq2Seq.decode
-        )
-        return new_state, jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
-
-    tok = jnp.full((xs.shape[0],), BOS, jnp.int32)
-    out = []
-    for _ in range(max_length):
-        state, tok = step(state, tok)
-        out.append(tok)
-    ys = np.array(jnp.stack(out, axis=1))
+    ys = np.array(_greedy_decode(model, variables, xs, max_length))
     # Mask everything after the first EOS.
     done = np.cumsum(ys == EOS, axis=1) > 0
     after = np.concatenate(

@@ -1,0 +1,40 @@
+"""VGG-16, TPU-first.
+
+The reference imagenet example ships resnet/alex/googlenet/nin; VGG-16 is
+included here as the canonical dense-conv benchmark arch (same role as the
+reference's ``alex`` fallback for small-memory runs).  NHWC + bfloat16.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Sequence
+
+import jax.numpy as jnp
+from flax import linen as nn
+
+_CFG: Sequence[Sequence[int]] = ((64, 64), (128, 128), (256, 256, 256),
+                                 (512, 512, 512), (512, 512, 512))
+
+
+class VGG16(nn.Module):
+    num_classes: int = 1000
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x, *, deterministic: bool | None = None):
+        det = not self.train if deterministic is None else deterministic
+        x = x.astype(self.dtype)
+        for stage in _CFG:
+            for features in stage:
+                x = nn.Conv(features, (3, 3), padding=[(1, 1), (1, 1)],
+                            dtype=self.dtype)(x)
+                x = nn.relu(x)
+            x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=det)(x)
+        x = nn.relu(nn.Dense(4096, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=det)(x)
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)

@@ -175,6 +175,8 @@ def build_train_step(
     data_axes: Optional[tuple] = None,
     donate: bool = True,
     use_shard_map: bool = True,
+    has_aux: bool = False,
+    merge_aux=None,
 ):
     """Build a jitted SPMD data-parallel training step.
 
@@ -188,6 +190,15 @@ def build_train_step(
     With ``use_shard_map=False`` the step is plain ``jit`` + GSPMD sharding
     annotations (gradient sync via the compiler's partitioner) — same
     numerics, useful to A/B the two lowering styles.
+
+    Mutable model state (flax BatchNorm ``batch_stats`` etc.): pass
+    ``has_aux=True`` and write ``loss_fn(params, batch) -> (loss, aux)``.
+    The aux pytree is mean-reduced across the mesh (per-shard BN statistics
+    become global statistics, matching MultiNodeBatchNormalization's
+    semantics — SURVEY.md section 2 #21) and, if ``merge_aux(params, aux)
+    -> params`` is given, folded back into the returned params *after* the
+    optimizer update (so optimizer updates to non-trainable state are
+    overwritten, never accumulated).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -201,13 +212,26 @@ def build_train_step(
 
     if use_shard_map:
         def _step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+                params, batch
+            )
+            aux = None
+            if has_aux:
+                loss, aux = loss
+                aux = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, axes)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                    else a,
+                    aux,
+                )
             if is_mn:
                 updates, opt_state = optimizer.update(grads, opt_state, params)
             else:
                 grads = _sync_grads(grads, comm)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            if aux is not None and merge_aux is not None:
+                params = merge_aux(params, aux)
             loss = lax.pmean(loss, axes)
             return params, opt_state, {"loss": loss}
 
@@ -221,9 +245,16 @@ def build_train_step(
         step = jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
     else:
         def _step(params, opt_state, batch):
-            loss, grads = jax.value_and_grad(loss_fn)(params, batch)
+            loss, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
+                params, batch
+            )
+            aux = None
+            if has_aux:
+                loss, aux = loss
             updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
+            if aux is not None and merge_aux is not None:
+                params = merge_aux(params, aux)
             return params, opt_state, {"loss": loss}
 
         step = jax.jit(

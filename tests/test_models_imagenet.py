@@ -1,0 +1,120 @@
+"""ImageNet model-zoo tests.
+
+Parity: the reference's ``examples/imagenet/models/{alex,googlenet,
+googlenetbn,nin,resnet50}.py`` archs — forward shapes, BN-state handling,
+and the has_aux train-step path that carries batch statistics.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu import models
+from chainermn_tpu.optimizers import build_train_step
+
+IMG = 96  # small enough to be fast, large enough for every stem/pool stack
+
+
+def _init_and_forward(model, batch=2, img=IMG):
+    x = jnp.zeros((batch, img, img, 3), jnp.float32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x[:1],
+    )
+    out = model.apply(variables, x, rngs={"dropout": jax.random.PRNGKey(2)})
+    return variables, out
+
+
+@pytest.mark.parametrize("factory", [
+    models.AlexNet, models.NIN, models.VGG16, models.GoogLeNet,
+])
+def test_stateless_arch_forward_shape(factory):
+    model = factory(num_classes=11, train=False)
+    variables, out = _init_and_forward(model)
+    assert out.shape == (2, 11)
+    assert out.dtype == jnp.float32
+    assert "batch_stats" not in variables
+
+
+@pytest.mark.parametrize("factory", [
+    models.GoogLeNetBN, models.ResNet18,
+])
+def test_bn_arch_forward_shape(factory):
+    model = factory(num_classes=7, train=True)
+    x = jnp.zeros((2, IMG, IMG, 3), jnp.float32)
+    variables = model.init(
+        {"params": jax.random.PRNGKey(0), "dropout": jax.random.PRNGKey(1)},
+        x[:1],
+    )
+    assert "batch_stats" in variables
+    out, mut = model.apply(
+        variables, x, mutable=["batch_stats"],
+        rngs={"dropout": jax.random.PRNGKey(2)},
+    )
+    assert out.shape == (2, 7)
+    assert jax.tree_util.tree_structure(
+        mut["batch_stats"]
+    ) == jax.tree_util.tree_structure(variables["batch_stats"])
+
+
+def test_dropout_is_train_gated():
+    model = models.AlexNet(num_classes=5, train=True)
+    variables, _ = _init_and_forward(model)
+    x = jnp.ones((4, IMG, IMG, 3))
+    a = model.apply(variables, x, rngs={"dropout": jax.random.PRNGKey(3)})
+    b = model.apply(variables, x, rngs={"dropout": jax.random.PRNGKey(4)})
+    assert not np.allclose(np.asarray(a), np.asarray(b))
+    det = models.AlexNet(num_classes=5, train=False)
+    c = det.apply(variables, x)
+    d = det.apply(variables, x)
+    np.testing.assert_allclose(np.asarray(c), np.asarray(d))
+
+
+class TestHasAuxTrainStep:
+    """build_train_step(has_aux=True): BN stats flow through the step and
+    are mean-reduced across the mesh."""
+
+    @pytest.fixture(scope="class")
+    def comm(self, devices8):
+        return cmn.create_communicator("tpu", devices=devices8)
+
+    def test_batch_stats_updated_and_replicated(self, comm):
+        model = models.ResNet18(num_classes=4, dtype=jnp.float32, train=True)
+        x0 = jnp.zeros((1, 32, 32, 3))
+        variables = model.init(jax.random.PRNGKey(0), x0)
+        params = {"params": variables["params"],
+                  "batch_stats": variables["batch_stats"]}
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+
+        def loss_fn(p, batch):
+            x, y = batch
+            out, mut = model.apply(
+                {"params": p["params"], "batch_stats": p["batch_stats"]},
+                x, mutable=["batch_stats"],
+            )
+            loss = optax.softmax_cross_entropy_with_integer_labels(
+                out, y
+            ).mean()
+            return loss, mut["batch_stats"]
+
+        step = build_train_step(
+            comm, loss_fn, opt, has_aux=True, donate=False,
+            merge_aux=lambda p, aux: {**p, "batch_stats": aux},
+        )
+        params, opt_state = step.place(params, opt.init(params))
+        old_stats = jax.tree_util.tree_map(
+            np.asarray, jax.device_get(params["batch_stats"])
+        )
+        x = jax.random.normal(jax.random.PRNGKey(1), (8, 32, 32, 3))
+        y = jnp.arange(8, dtype=jnp.int32) % 4
+        new_params, _, metrics = step(params, opt_state, (x, y))
+        new_stats = jax.device_get(new_params["batch_stats"])
+        # Stats moved (momentum update happened)
+        changed = jax.tree_util.tree_map(
+            lambda a, b: not np.allclose(a, b), old_stats, new_stats
+        )
+        assert any(jax.tree_util.tree_leaves(changed))
+        assert np.isfinite(float(metrics["loss"]))

@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import dataclasses
 import functools
+import warnings
 from typing import Any, Optional
 
 from flax import linen as nn
@@ -52,8 +53,14 @@ def create_mnbn_model(model: nn.Module, comm, **bn_kwargs) -> nn.Module:
             size=model.num_features if hasattr(model, "num_features") else 0,
             axis_name=comm.axis_names,
         )
-    raise TypeError(
-        f"cannot convert {type(model).__name__}: expected a model with a "
-        "`norm` factory field (chainermn_tpu.models convention) or a "
-        "flax BatchNorm"
+    # Reference parity: create_mnbn_model recursively copies a chain,
+    # replacing BatchNormalization children — a chain with none comes back
+    # unchanged.  Models without the `norm` factory field are treated as
+    # BN-free; warn in case the caller expected a conversion.
+    warnings.warn(
+        f"create_mnbn_model: {type(model).__name__} exposes no `norm` "
+        "factory field (chainermn_tpu.models convention); returning it "
+        "unchanged (BN-free models need no sync-BN)",
+        stacklevel=2,
     )
+    return model

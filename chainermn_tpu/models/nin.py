@@ -53,6 +53,12 @@ class NIN(nn.Module):
         x = _MLPConv((384, 384, 384), (3, 3), padding=[(1, 1), (1, 1)],
                      dtype=self.dtype)(x)
         x = nn.max_pool(x, (3, 3), strides=(2, 2))
+        if 0 in x.shape[1:3]:
+            raise ValueError(
+                "NIN: input image too small — a VALID max_pool collapsed "
+                f"the feature map to spatial shape {x.shape[1:3]}; use an "
+                "image size >= 96 (a zero-size mean would silently be NaN)"
+            )
         x = nn.Dropout(0.5, deterministic=det)(x)
         x = _MLPConv((1024, 1024, self.num_classes), (3, 3),
                      padding=[(1, 1), (1, 1)], dtype=self.dtype)(x)

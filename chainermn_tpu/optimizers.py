@@ -193,10 +193,15 @@ def build_train_step(
 
     Mutable model state (flax BatchNorm ``batch_stats`` etc.): pass
     ``has_aux=True`` and write ``loss_fn(params, batch) -> (loss, aux)``.
-    The aux pytree is mean-reduced across the mesh (per-shard BN statistics
-    become global statistics, matching MultiNodeBatchNormalization's
-    semantics — SURVEY.md section 2 #21) and, if ``merge_aux(params, aux)
-    -> params`` is given, folded back into the returned params *after* the
+    The aux pytree is mean-reduced across the mesh so the carried state
+    stays replicated (for BN, the running-average EMAs are averaged — an
+    approximation: the mean of per-shard variances underestimates global
+    variance when shard means differ).  Training-time *normalization*
+    still uses each shard's local batch statistics; for true sync-BN
+    (global statistics inside the forward pass) use
+    MultiNodeBatchNormalization / ``create_mnbn_model`` (SURVEY.md
+    section 2 #21).  If ``merge_aux(params, aux) -> params`` is given, the
+    reduced aux is folded back into the returned params *after* the
     optimizer update (so optimizer updates to non-trainable state are
     overwritten, never accumulated).
     """

@@ -7,11 +7,12 @@ googlenet / googlenetbn / nin; scatter_dataset + hierarchical communicator
 + MultiprocessIterator + optional MNBN).
 
 TPU-native shape: one jitted SPMD train step over the communicator's mesh;
-BN statistics are carried as model state (``has_aux`` path of
-``build_train_step``) and mean-reduced across shards, so plain BN under
-data parallelism already matches MultiNodeBatchNormalization semantics;
-``--mnbn`` additionally syncs the *normalization* statistics inside the
-forward pass (reference ``create_mnbn_model``).
+BN running statistics are carried as model state (``has_aux`` path of
+``build_train_step``) and mean-reduced across shards so the carried state
+stays replicated.  Training-time normalization is still per-shard with
+plain BN; ``--mnbn`` switches to MultiNodeBatchNormalization, which
+computes *global* batch statistics inside the forward pass (reference
+``create_mnbn_model`` — true sync-BN).
 
 Without a real ImageNet tree this script trains on an in-memory synthetic
 classification set (same shapes, same step program); point ``--npz`` at a
@@ -93,6 +94,15 @@ class _RngBatchIterator:
                  + self._count * self._global + self._seed)
         self._count += 1
         return (*batch, seeds)
+
+    # Checkpoint protocol: include the seed counter, else a resumed run
+    # would replay the first iterations' dropout seeds.
+    def serialize(self):
+        return {"inner": self._it.serialize(), "count": self._count}
+
+    def restore(self, state):
+        self._it.restore(state["inner"])
+        self._count = int(state["count"])
 
 
 def main(argv=None):

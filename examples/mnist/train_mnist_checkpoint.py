@@ -1,0 +1,29 @@
+#!/usr/bin/env python
+"""Fault-tolerant MNIST: data-parallel training with checkpoint/resume.
+
+Parity target: the reference's ``examples/mnist/train_mnist_checkpoint.py``
+— the data-parallel MNIST script plus ``create_multi_node_checkpointer``;
+re-running the same command after an interruption resumes from the newest
+snapshot present on every rank (SURVEY.md section 3.5).
+
+This is the same training setup as ``train_mnist.py`` with checkpointing
+always on; interrupt it (Ctrl-C / preemption) and re-run to resume.
+
+Run:
+    python examples/mnist/train_mnist_checkpoint.py --epoch 4
+"""
+
+import sys
+
+import train_mnist
+
+
+def main(argv=None):
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if not any(a.startswith("--checkpoint") for a in argv):
+        argv += ["--checkpoint", "mnist_checkpoint"]
+    return train_mnist.main(argv)
+
+
+if __name__ == "__main__":
+    main()

@@ -2,6 +2,13 @@ from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import gpipe  # noqa: F401
 from .tensor_parallel import ColumnParallelDense, RowParallelDense  # noqa: F401
+from .expert_parallel import (  # noqa: F401
+    expert_parallel_moe,
+    mlp_experts,
+    top_k_routing,
+    compute_capacity,
+    load_balancing_loss,
+)
 
 __all__ = [
     "ring_attention",
@@ -9,4 +16,9 @@ __all__ = [
     "gpipe",
     "ColumnParallelDense",
     "RowParallelDense",
+    "expert_parallel_moe",
+    "mlp_experts",
+    "top_k_routing",
+    "compute_capacity",
+    "load_balancing_loss",
 ]

@@ -1,0 +1,192 @@
+"""Expert parallelism: Mixture-of-Experts over an ``expert`` mesh axis.
+
+The reference has no MoE, but its differentiable ``alltoall``
+(``chainermn/functions/collective_communication.py``, SURVEY.md section 2
+#19 and the parallelism table: "EP: `alltoall` is the primitive it would
+need") is exactly the dispatch/combine exchange expert parallelism is
+built from.  This module is that capability, TPU-native:
+
+* Experts are sharded across the chips of one mesh axis; each chip holds
+  ``num_experts / axis_size`` expert parameter sets.
+* A token's route is decided by a learned router (top-1 "Switch" or
+  top-2 "GShard" style) with a static capacity — shapes stay fixed so the
+  whole layer jits once; overflow tokens are dropped (standard MoE
+  semantics) and flow through the residual connection.
+* Dispatch and return are each ONE ``lax.all_to_all`` riding ICI; the
+  expert compute between them is a batched matmul over
+  ``(local_experts, axis_size * capacity, d)`` blocks — MXU-shaped.
+
+Everything is differentiable end to end (all_to_all's transpose is
+all_to_all in the reverse direction; XLA generates it), so the router
+learns through the combine weights exactly as in GShard/Switch.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Callable, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def compute_capacity(tokens: int, num_experts: int, k: int,
+                     capacity_factor: float) -> int:
+    """Static per-expert queue length for ``tokens`` routed k ways."""
+    return max(int(math.ceil(tokens * k * capacity_factor / num_experts)), 1)
+
+
+def top_k_routing(
+    probs: jnp.ndarray, k: int, capacity: int
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """Build dispatch mask and combine weights from router probabilities.
+
+    Args:
+      probs: (tokens, num_experts) router softmax.
+      k: routes per token (1 = Switch, 2 = GShard).
+      capacity: per-expert queue length (static).
+    Returns:
+      dispatch: (tokens, num_experts, capacity) one-hot {0,1} — token t
+        occupies slot c of expert e's queue.
+      combine: same shape, dispatch scaled by the (re-normalized) router
+        probability of the chosen expert.
+      raw_routes: (tokens, num_experts) pre-capacity route indicator (sum
+        of the k choice one-hots) — feed this, not dispatch, to
+        :func:`load_balancing_loss` so dropped claims still count.
+    """
+    t, e = probs.shape
+    if k > e:
+        raise ValueError(f"k ({k}) cannot exceed num_experts ({e})")
+    # Iteratively take the argmax k times, masking previous choices by
+    # setting them below any probability (multiplying by zero would let a
+    # fully-underflowed row re-pick the same expert).
+    masked = probs
+    chosen = []  # (tokens,) expert index per route
+    gates = []
+    for _ in range(k):
+        idx = jnp.argmax(masked, axis=-1)
+        chosen.append(idx)
+        gates.append(jnp.take_along_axis(probs, idx[:, None], 1)[:, 0])
+        masked = jnp.where(
+            jax.nn.one_hot(idx, e, dtype=bool), -1.0, masked
+        )
+    # Queue positions: cumulative count of earlier claims on the same
+    # expert, counting all routes in route-major then token order.
+    onehots = [jax.nn.one_hot(c, e, dtype=jnp.int32) for c in chosen]
+    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
+    combine = jnp.zeros((t, e, capacity), probs.dtype)
+    gate_sum = sum(gates) if k > 1 else None
+    prior = jnp.zeros((e,), jnp.int32)
+    for oh, c_idx, gate in zip(onehots, chosen, gates):
+        pos = jnp.cumsum(oh, axis=0) - oh  # earlier tokens, this route
+        pos = pos + prior[None, :]  # plus all earlier routes
+        prior = prior + jnp.sum(oh, axis=0)
+        slot = jnp.sum(pos * oh, axis=-1)  # (tokens,)
+        keep = (slot < capacity).astype(probs.dtype)
+        g = gate / (gate_sum + 1e-9) if gate_sum is not None else gate
+        oh_slot = jax.nn.one_hot(slot, capacity, dtype=probs.dtype)
+        d = oh.astype(probs.dtype)[:, :, None] * oh_slot[:, None, :]
+        dispatch = dispatch + d * keep[:, None, None]
+        combine = combine + d * (g * keep)[:, None, None]
+    raw_routes = sum(oh.astype(probs.dtype) for oh in onehots)
+    return dispatch, combine, raw_routes
+
+
+def load_balancing_loss(probs: jnp.ndarray,
+                        raw_routes: jnp.ndarray) -> jnp.ndarray:
+    """Switch-style auxiliary loss: num_experts * <fraction routed to e> ·
+    <mean router prob of e>, minimized at uniform routing.
+
+    ``raw_routes`` must be the *pre-capacity* route indicator from
+    :func:`top_k_routing`: counting only surviving dispatches would make a
+    collapsed router score *better* once its queue overflows (dropped
+    claims would vanish from the fraction).
+    """
+    e = probs.shape[-1]
+    k = jnp.maximum(jnp.sum(raw_routes) / raw_routes.shape[0], 1.0)
+    frac = jnp.mean(raw_routes, axis=0) / k
+    mean_prob = jnp.mean(probs, axis=0)
+    return e * jnp.sum(frac * mean_prob)
+
+
+def expert_parallel_moe(
+    x: jnp.ndarray,
+    router_w: jnp.ndarray,
+    expert_fn: Callable[[jnp.ndarray], jnp.ndarray],
+    axis_name: str,
+    num_experts: int,
+    *,
+    k: int = 2,
+    capacity_factor: float = 1.25,
+    capacity: Optional[int] = None,
+) -> Tuple[jnp.ndarray, jnp.ndarray]:
+    """One expert-parallel MoE layer.  Call inside ``shard_map``.
+
+    Args:
+      x: (tokens, d) this chip's tokens.
+      router_w: (d, num_experts) router weights (replicated).
+      expert_fn: (local_experts, n*capacity, d) -> same shape — applies
+        this chip's experts to their gathered queues (vmapped MLP etc.).
+      axis_name: mesh axis the experts are sharded over.
+      num_experts: total experts; divisible by the axis size.
+    Returns:
+      (y, aux_loss): y (tokens, d) combined expert outputs (dropped tokens
+      get zeros — add the residual outside); aux_loss the load-balancing
+      scalar (pmean'd over the axis).
+    """
+    n = lax.axis_size(axis_name)
+    if num_experts % n:
+        raise ValueError(
+            f"num_experts ({num_experts}) must be divisible by the "
+            f"'{axis_name}' axis size ({n})"
+        )
+    local_e = num_experts // n
+    t, d = x.shape
+    cap = capacity if capacity is not None else compute_capacity(
+        t, num_experts, k, capacity_factor
+    )
+
+    probs = jax.nn.softmax(
+        jnp.asarray(x, jnp.float32) @ jnp.asarray(router_w, jnp.float32),
+        axis=-1,
+    )
+    dispatch, combine, raw_routes = top_k_routing(probs, k, cap)
+    aux = lax.pmean(load_balancing_loss(probs, raw_routes), axis_name)
+
+    # Local queues: (num_experts, cap, d)
+    dispatched = jnp.einsum("td,tec->ecd", x, dispatch.astype(x.dtype))
+    # To expert owners: split expert dim over chips, gather token sources.
+    # (n, local_e, cap, d) -all_to_all-> every chip: its experts' queues
+    # from all chips, concatenated along a new source axis.
+    dispatched = dispatched.reshape(n, local_e, cap, d)
+    gathered = lax.all_to_all(dispatched, axis_name, split_axis=0,
+                              concat_axis=0, tiled=False)
+    # gathered: (n_src, local_e, cap, d) -> (local_e, n_src*cap, d)
+    gathered = gathered.transpose(1, 0, 2, 3).reshape(local_e, n * cap, d)
+
+    out = expert_fn(gathered)
+
+    # Return trip: transpose the exchange.
+    out = out.reshape(local_e, n, cap, d).transpose(1, 0, 2, 3)
+    returned = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
+                              tiled=False)
+    returned = returned.reshape(num_experts, cap, d)
+    y = jnp.einsum("ecd,tec->td", returned, combine.astype(returned.dtype))
+    return y.astype(x.dtype), aux
+
+
+def mlp_experts(w1: jnp.ndarray, w2: jnp.ndarray,
+                activation: Callable = jax.nn.gelu) -> Callable:
+    """Build an ``expert_fn`` from per-chip expert MLP weights.
+
+    w1: (local_experts, d, hidden); w2: (local_experts, hidden, d).
+    The returned fn is one batched einsum pair — (experts, tokens, d) x
+    (experts, d, h): MXU-tiled per expert.
+    """
+
+    def fn(x):
+        h = activation(jnp.einsum("etd,edh->eth", x, w1.astype(x.dtype)))
+        return jnp.einsum("eth,ehd->etd", h, w2.astype(x.dtype))
+
+    return fn

@@ -1,0 +1,193 @@
+"""Expert-parallel MoE tests.
+
+The reference's EP story is "alltoall is the primitive it would need"
+(SURVEY.md section 2, parallelism table); these tests pin the realized
+capability: routing bookkeeping, all_to_all dispatch/combine numerics
+vs a dense oracle, differentiability, and capacity-drop semantics.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.parallel.expert_parallel import (
+    compute_capacity,
+    expert_parallel_moe,
+    mlp_experts,
+    top_k_routing,
+)
+
+E = 8  # experts == mesh size: one expert per chip
+D, H = 16, 32
+T_LOCAL = 16  # tokens per shard
+
+
+def _problem(seed=0):
+    rng = np.random.RandomState(seed)
+    x = jnp.asarray(rng.randn(E * T_LOCAL, D), jnp.float32) * 0.5
+    rw = jnp.asarray(rng.randn(D, E), jnp.float32) * 0.3
+    w1 = jnp.asarray(rng.randn(E, D, H), jnp.float32) * 0.2
+    w2 = jnp.asarray(rng.randn(E, H, D), jnp.float32) * 0.2
+    return x, rw, w1, w2
+
+
+def _dense_oracle(x, rw, w1, w2, k=2):
+    """Per-token direct evaluation: top-k experts, renormalized gates."""
+    probs = jax.nn.softmax(x @ rw, axis=-1)
+    out = np.zeros_like(np.asarray(x))
+    probs_np = np.asarray(probs)
+    for t in range(x.shape[0]):
+        top = np.argsort(-probs_np[t])[:k]
+        denom = probs_np[t][top].sum() if k > 1 else 1.0
+        for e in top:
+            h = np.asarray(jax.nn.gelu(np.asarray(x[t]) @ np.asarray(w1[e])))
+            y = h @ np.asarray(w2[e])
+            g = probs_np[t][e] / (denom + 1e-9) if k > 1 else probs_np[t][e]
+            out[t] += g * y
+    return out
+
+
+class TestRouting:
+    def test_capacity_formula(self):
+        assert compute_capacity(128, 8, 2, 1.0) == 32
+        assert compute_capacity(1, 64, 1, 1.0) == 1  # never zero
+
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_dispatch_within_capacity_and_k_routes(self, k):
+        rng = np.random.RandomState(1)
+        probs = jax.nn.softmax(
+            jnp.asarray(rng.randn(24, E), jnp.float32), -1
+        )
+        cap = 5
+        dispatch, combine, raw = top_k_routing(probs, k, cap)
+        # raw routes: exactly k per token, regardless of capacity
+        np.testing.assert_allclose(np.asarray(raw).sum(axis=-1), k)
+        d = np.asarray(dispatch)
+        # each expert slot used at most once
+        assert (d.sum(axis=0) <= 1.0 + 1e-6).all()
+        # each token dispatched to at most k (expert, slot) pairs
+        assert (d.sum(axis=(1, 2)) <= k + 1e-6).all()
+        # combine weights only where dispatched, and <= prob
+        c = np.asarray(combine)
+        assert ((c > 0) <= (d > 0)).all()
+
+    def test_combine_gates_renormalized_top2(self):
+        probs = jnp.asarray([[0.6, 0.3, 0.1, 0.0, 0.0, 0.0, 0.0, 0.0]],
+                            jnp.float32)
+        _, combine, _ = top_k_routing(probs, 2, 4)
+        got = np.asarray(combine).sum()
+        np.testing.assert_allclose(got, 1.0, atol=1e-5)  # 0.6/0.9 + 0.3/0.9
+
+    def test_underflowed_row_does_not_reroute_same_expert(self):
+        # Row where every prob but one underflows to exactly 0.0: route 2
+        # must NOT re-pick the route-1 expert (zero-masking bug).
+        probs = jnp.zeros((1, E), jnp.float32).at[0, 3].set(1.0)
+        dispatch, _, raw = top_k_routing(probs, 2, 4)
+        assert float(np.asarray(raw)[0, 3]) == 1.0  # picked exactly once
+        assert np.asarray(dispatch)[0, 3].sum() <= 1.0 + 1e-6
+
+    def test_k_exceeding_experts_rejected(self):
+        probs = jnp.full((4, E), 1.0 / E, jnp.float32)
+        with pytest.raises(ValueError, match="cannot exceed"):
+            top_k_routing(probs, E + 1, 4)
+
+    def test_aux_loss_penalizes_collapse_even_with_drops(self):
+        from chainermn_tpu.parallel.expert_parallel import (
+            load_balancing_loss,
+        )
+
+        t = 16
+        collapsed = jnp.zeros((t, E), jnp.float32).at[:, 0].set(1.0)
+        uniform = jnp.full((t, E), 1.0 / E, jnp.float32)
+        cap = 1  # nearly everything at the collapsed expert is dropped
+        _, _, raw_c = top_k_routing(collapsed, 1, cap)
+        _, _, raw_u = top_k_routing(uniform, 1, cap)
+        aux_c = float(load_balancing_loss(collapsed, raw_c))
+        aux_u = float(load_balancing_loss(uniform, raw_u))
+        assert aux_c > aux_u  # collapse must score WORSE despite drops
+
+
+class TestExpertParallelMoE:
+    @pytest.mark.parametrize("k", [1, 2])
+    def test_matches_dense_oracle_when_no_drops(self, mesh8, k):
+        x, rw, w1, w2 = _problem()
+        oracle = _dense_oracle(x, rw, w1, w2, k=k)
+
+        f = jax.jit(
+            jax.shard_map(
+                lambda x, rw, w1, w2: expert_parallel_moe(
+                    x, rw, mlp_experts(w1, w2), "mn", E, k=k,
+                    capacity=T_LOCAL,  # roomy: no token dropped
+                ),
+                mesh=mesh8,
+                in_specs=(P("mn"), P(), P("mn"), P("mn")),
+                out_specs=(P("mn"), P()),
+                check_vma=False,
+            )
+        )
+        xs = jax.device_put(x, NamedSharding(mesh8, P("mn")))
+        y, aux = f(xs, rw, w1, w2)
+        np.testing.assert_allclose(
+            np.asarray(y), oracle, rtol=2e-4, atol=2e-5
+        )
+        assert float(aux) > 0.0
+
+    def test_differentiable_through_router_and_experts(self, mesh8):
+        x, rw, w1, w2 = _problem(seed=3)
+
+        def loss(x, rw, w1, w2):
+            y, aux = expert_parallel_moe(
+                x, rw, mlp_experts(w1, w2), "mn", E, k=2,
+                capacity=T_LOCAL,
+            )
+            return lax.pmean(jnp.sum(y**2), "mn") + 0.01 * aux
+
+        g = jax.jit(
+            jax.shard_map(
+                jax.grad(loss, argnums=(1, 2)), mesh=mesh8,
+                in_specs=(P("mn"), P(), P("mn"), P("mn")),
+                out_specs=(P(), P("mn")),
+                check_vma=False,
+            )
+        )
+        xs = jax.device_put(x, NamedSharding(mesh8, P("mn")))
+        g_rw, g_w1 = g(xs, rw, w1, w2)
+        assert np.isfinite(np.asarray(g_rw)).all()
+        assert np.isfinite(np.asarray(g_w1)).all()
+        assert np.abs(np.asarray(g_w1)).max() > 0
+
+    def test_capacity_drop_zeroes_overflow_not_nan(self, mesh8):
+        x, rw, w1, w2 = _problem(seed=4)
+        f = jax.jit(
+            jax.shard_map(
+                lambda x, rw, w1, w2: expert_parallel_moe(
+                    x, rw, mlp_experts(w1, w2), "mn", E, k=1, capacity=1,
+                ),
+                mesh=mesh8,
+                in_specs=(P("mn"), P(), P("mn"), P("mn")),
+                out_specs=(P("mn"), P()),
+                check_vma=False,
+            )
+        )
+        xs = jax.device_put(x, NamedSharding(mesh8, P("mn")))
+        y, _ = f(xs, rw, w1, w2)
+        y = np.asarray(y)
+        assert np.isfinite(y).all()
+        # With 16 tokens/shard, 8 experts, capacity 1: most rows dropped
+        zero_rows = (np.abs(y).max(axis=-1) == 0).sum()
+        assert zero_rows >= y.shape[0] // 2
+
+    def test_num_experts_divisibility_enforced(self, mesh8):
+        x, rw, w1, w2 = _problem()
+        f = jax.shard_map(
+            lambda x: expert_parallel_moe(
+                x, rw, mlp_experts(w1, w2), "mn", 12,
+            ),
+            mesh=mesh8, in_specs=(P("mn"),), out_specs=(P("mn"), P()),
+            check_vma=False,
+        )
+        with pytest.raises(ValueError, match="divisible"):
+            jax.jit(f)(jax.device_put(x, NamedSharding(mesh8, P("mn"))))

@@ -58,19 +58,11 @@ def top_k_routing(
     t, e = probs.shape
     if k > e:
         raise ValueError(f"k ({k}) cannot exceed num_experts ({e})")
-    # Iteratively take the argmax k times, masking previous choices by
-    # setting them below any probability (multiplying by zero would let a
-    # fully-underflowed row re-pick the same expert).
-    masked = probs
-    chosen = []  # (tokens,) expert index per route
-    gates = []
-    for _ in range(k):
-        idx = jnp.argmax(masked, axis=-1)
-        chosen.append(idx)
-        gates.append(jnp.take_along_axis(probs, idx[:, None], 1)[:, 0])
-        masked = jnp.where(
-            jax.nn.one_hot(idx, e, dtype=bool), -1.0, masked
-        )
+    # lax.top_k guarantees k distinct indices with values read from the
+    # original row — no hand-rolled argmax-and-mask loop needed.
+    gate_arr, chosen_arr = lax.top_k(probs, k)
+    chosen = [chosen_arr[:, i] for i in range(k)]
+    gates = [gate_arr[:, i] for i in range(k)]
     # Queue positions: cumulative count of earlier claims on the same
     # expert, counting all routes in route-major then token order.
     onehots = [jax.nn.one_hot(c, e, dtype=jnp.int32) for c in chosen]

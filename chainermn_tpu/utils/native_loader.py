@@ -1,0 +1,235 @@
+"""ctypes binding for the native (C++) input pipeline.
+
+SURVEY.md section 2 "native-code obligations": the reference's host-side
+data path is Chainer's MultiprocessIterator plus pinned-memory staging
+buffers; ``csrc/loader.cpp`` is the TPU rebuild's native equivalent — a
+worker-thread batch loader (crop / flip / normalize off the GIL) producing
+into a fixed ring of reusable staging slots.  This module compiles it on
+first use with ``g++`` (no pybind11 in the image; plain C ABI + ctypes)
+and wraps it as a Python iterator.
+
+Falls back cleanly: ``native_available()`` is False when no compiler is
+present, and :class:`NativeImageLoader` raises with a clear message —
+callers (e.g. the ImageNet example) can then use SerialIterator.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from typing import Optional, Sequence, Tuple
+
+import numpy as np
+
+_BUILD_LOCK = threading.Lock()
+_LIB: Optional[ctypes.CDLL] = None
+_LIB_ERR: Optional[str] = None
+
+
+def _source_path() -> str:
+    return os.path.join(
+        os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)
+        ))),
+        "csrc", "loader.cpp",
+    )
+
+
+def _build_dir() -> str:
+    d = os.path.join(os.path.dirname(_source_path()), "_build")
+    os.makedirs(d, exist_ok=True)
+    return d
+
+
+def _load_library() -> ctypes.CDLL:
+    """Compile (if stale) and dlopen the loader library."""
+    global _LIB, _LIB_ERR
+    with _BUILD_LOCK:
+        if _LIB is not None:
+            return _LIB
+        if _LIB_ERR is not None:
+            raise RuntimeError(_LIB_ERR)
+        src = _source_path()
+        so = os.path.join(_build_dir(), "libcmn_loader.so")
+        try:
+            if (not os.path.exists(so)
+                    or os.path.getmtime(so) < os.path.getmtime(src)):
+                cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
+                       "-pthread", src, "-o", so]
+                subprocess.run(cmd, check=True, capture_output=True,
+                               text=True)
+            lib = ctypes.CDLL(so)
+        except (OSError, subprocess.CalledProcessError) as e:
+            detail = getattr(e, "stderr", "") or str(e)
+            _LIB_ERR = f"native loader unavailable: {detail}"
+            raise RuntimeError(_LIB_ERR) from e
+        lib.cmn_loader_create.restype = ctypes.c_void_p
+        lib.cmn_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_void_p,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
+            ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.cmn_loader_acquire.restype = ctypes.c_int
+        lib.cmn_loader_acquire.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_float)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
+        ]
+        lib.cmn_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        for f in ("cmn_loader_epoch", "cmn_loader_iteration",
+                  "cmn_loader_batches_per_epoch"):
+            getattr(lib, f).restype = ctypes.c_longlong
+            getattr(lib, f).argtypes = [ctypes.c_void_p]
+        lib.cmn_loader_destroy.argtypes = [ctypes.c_void_p]
+        _LIB = lib
+        return lib
+
+
+def native_available() -> bool:
+    try:
+        _load_library()
+        return True
+    except RuntimeError:
+        return False
+
+
+class NativeImageLoader:
+    """Threaded native batch loader over an in-memory uint8 image array.
+
+    Yields ``(x, y)``: x float32 (batch, crop_h, crop_w, c) normalized as
+    ``(pixel - mean) / std``, y int32 (batch,).  Batch order, shuffling and
+    augmentation are deterministic in ``seed`` for any ``n_threads``.
+    Drop-last epoch semantics (matches SerialIterator's guarantee that
+    batch sizes stay mesh-divisible).
+    """
+
+    def __init__(self, images: np.ndarray, labels: np.ndarray,
+                 batch_size: int, *,
+                 crop: Optional[Tuple[int, int]] = None,
+                 n_threads: int = 4, ring: int = 8, seed: int = 0,
+                 shuffle: bool = True, train: bool = True,
+                 mean: Sequence[float] = (0.0,),
+                 std: Sequence[float] = (255.0,)):
+        lib = _load_library()
+        images = np.ascontiguousarray(images, dtype=np.uint8)
+        labels = np.ascontiguousarray(labels, dtype=np.int32)
+        if images.ndim != 4:
+            raise ValueError("images must be (n, h, w, c) uint8")
+        n, h, w, c = images.shape
+        crop_h, crop_w = crop if crop is not None else (h, w)
+        mean = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(mean, np.float32), (c,))
+        )
+        std = np.ascontiguousarray(
+            np.broadcast_to(np.asarray(std, np.float32), (c,))
+        )
+        # Keep references: the C++ side borrows these buffers.
+        self._images, self._labels = images, labels
+        self._mean, self._std = mean, std
+        self._lib = lib
+        self._shape = (batch_size, crop_h, crop_w, c)
+        self._create_args = (n, h, w, c, batch_size, crop_h, crop_w,
+                             int(n_threads), int(ring), int(seed),
+                             int(bool(shuffle)), int(bool(train)))
+        self._handle = None
+        self._create()
+
+    def _create(self):
+        (n, h, w, c, batch, crop_h, crop_w, n_threads, ring, seed,
+         shuffle, train) = self._create_args
+        self._handle = self._lib.cmn_loader_create(
+            self._images.ctypes.data_as(ctypes.c_void_p),
+            self._labels.ctypes.data_as(ctypes.c_void_p),
+            n, h, w, c, batch, crop_h, crop_w,
+            n_threads, ring, seed, shuffle, train,
+            self._mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            self._std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+        )
+        if not self._handle:
+            raise ValueError(
+                "cmn_loader_create rejected the configuration (check "
+                "batch_size <= n, crop <= image size, threads/ring > 0)"
+            )
+
+    # -- iterator protocol --------------------------------------------
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> Tuple[np.ndarray, np.ndarray]:
+        """Blocking: returns copies (the slot is released immediately).
+        For zero-copy access use :meth:`acquire` / :meth:`release`."""
+        slot, x_view, y_view = self.acquire()
+        try:
+            return np.array(x_view), np.array(y_view)
+        finally:
+            self.release(slot)
+
+    def acquire(self) -> Tuple[int, np.ndarray, np.ndarray]:
+        """Zero-copy: (slot_id, x_view, y_view); views are valid until
+        ``release(slot_id)``.  Feed them straight to ``device_put`` (which
+        copies to device memory) and release."""
+        xp = ctypes.POINTER(ctypes.c_float)()
+        yp = ctypes.POINTER(ctypes.c_int32)()
+        slot = self._lib.cmn_loader_acquire(
+            self._handle, ctypes.byref(xp), ctypes.byref(yp)
+        )
+        if slot < 0:
+            raise StopIteration
+        b, ch, cw, c = self._shape
+        x = np.ctypeslib.as_array(xp, shape=(b, ch, cw, c))
+        y = np.ctypeslib.as_array(yp, shape=(b,))
+        return slot, x, y
+
+    def release(self, slot: int) -> None:
+        self._lib.cmn_loader_release(self._handle, slot)
+
+    # -- bookkeeping (SerialIterator-compatible surface) ---------------
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.cmn_loader_epoch(self._handle))
+
+    @property
+    def epoch_detail(self) -> float:
+        bpe = int(self._lib.cmn_loader_batches_per_epoch(self._handle))
+        return int(self._lib.cmn_loader_iteration(self._handle)) / bpe
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.cmn_loader_batches_per_epoch(self._handle))
+
+    # -- checkpoint protocol (SerialIterator-compatible) ----------------
+    def serialize(self):
+        return {
+            "iteration": int(self._lib.cmn_loader_iteration(self._handle))
+        }
+
+    def restore(self, state):
+        """Reposition at ``state['iteration']``.  Determinism in (seed,
+        ticket) means replaying from 0 reproduces the exact stream, so
+        rewinding recreates the native loader and fast-forwards."""
+        target = int(state["iteration"])
+        current = int(self._lib.cmn_loader_iteration(self._handle))
+        if target < current:
+            self._lib.cmn_loader_destroy(self._handle)
+            self._handle = None
+            self._create()
+            current = 0
+        for _ in range(target - current):
+            slot, _, _ = self.acquire()
+            self.release(slot)
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.cmn_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

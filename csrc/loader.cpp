@@ -1,0 +1,254 @@
+// Native input pipeline: threaded batch loader with crop/flip/normalize.
+//
+// Role in the framework (SURVEY.md section 2, "native-code obligations"):
+// the reference leans on Chainer's MultiprocessIterator plus
+// HostPinnedMemory staging (chainermn/communicators/_memory_utility.py)
+// for its ImageNet input path.  The TPU rebuild's equivalent host-side
+// bottleneck is batch assembly + augmentation ahead of device_put; this
+// library does that work in C++ worker threads, entirely off the Python
+// GIL, producing ready float batches into a fixed ring of reusable slots
+// (the moral analogue of pinned staging buffers).
+//
+// Design:
+//  * Source data is an in-memory (or mmapped) uint8 tensor (N,H,W,C) with
+//    int32 labels — the array-backed dataset shape the framework's
+//    npz/memmap datasets provide.
+//  * Worker threads claim batch tickets from an atomic counter; ticket b
+//    fills ring slot b % ring_size, so consumption order is deterministic
+//    regardless of thread count.
+//  * Per-epoch shuffle permutations are seeded by (seed + epoch) and
+//    cached for the two epochs that can be in flight at once; per-sample
+//    crop/flip randomness is seeded by (seed, global sample ordinal), so
+//    results are reproducible for any thread count.
+//  * The consumer acquires a slot (blocking), reads the batch (zero-copy
+//    view from Python), and releases it back to the producers.
+//
+// Built with plain g++ -shared (no pybind11 in this environment); the
+// Python side binds via ctypes (chainermn_tpu/utils/native_loader.py).
+
+#include <atomic>
+#include <condition_variable>
+#include <cstdint>
+#include <cstring>
+#include <memory>
+#include <mutex>
+#include <numeric>
+#include <random>
+#include <thread>
+#include <vector>
+
+namespace {
+
+struct Slot {
+  std::vector<float> x;
+  std::vector<int32_t> y;
+  long long ready_batch = -1;  // which ticket's data this slot holds
+  long long next_fill = 0;     // the only ticket allowed to fill next —
+                               // serializes workers whose tickets alias
+                               // the same slot (b and b + ring_size)
+  bool in_use = false;         // held by the consumer
+  std::mutex m;
+  std::condition_variable cv_ready;
+  std::condition_variable cv_free;
+};
+
+struct Loader {
+  const uint8_t* data;
+  const int32_t* labels;
+  int n, h, w, c;
+  int batch, crop_h, crop_w;
+  int ring_size;
+  uint64_t seed;
+  bool shuffle, train;
+  std::vector<float> mean, stddev;
+
+  long long batches_per_epoch;
+  std::atomic<long long> next_ticket{0};
+  long long consume_idx = 0;
+  std::atomic<bool> stop{false};
+  std::vector<std::unique_ptr<Slot>> slots;
+  std::vector<std::thread> workers;
+
+  // Permutation cache: epoch -> order. Only a sliding window of epochs is
+  // ever in flight (ring_size < batches_per_epoch * window).
+  std::mutex perm_m;
+  long long perm_epochs[2] = {-1, -1};
+  std::vector<uint32_t> perms[2];
+
+  const std::vector<uint32_t>& perm_for_epoch(long long e) {
+    std::lock_guard<std::mutex> g(perm_m);
+    int slot = static_cast<int>(e & 1);
+    if (perm_epochs[slot] != e) {
+      std::vector<uint32_t>& p = perms[slot];
+      p.resize(n);
+      std::iota(p.begin(), p.end(), 0u);
+      if (shuffle) {
+        std::mt19937_64 rng(seed + 0x9e3779b97f4a7c15ULL * (e + 1));
+        for (int i = n - 1; i > 0; --i) {
+          std::uniform_int_distribution<int> d(0, i);
+          std::swap(p[i], p[d(rng)]);
+        }
+      }
+      perm_epochs[slot] = e;
+    }
+    return perms[slot];
+  }
+
+  void fill_sample(float* dst, uint32_t src_idx, uint64_t sample_ordinal) {
+    const uint8_t* img = data + static_cast<size_t>(src_idx) * h * w * c;
+    int off_h = (h - crop_h) / 2, off_w = (w - crop_w) / 2;
+    bool flip = false;
+    if (train) {
+      std::mt19937_64 rng(seed ^ (0xc2b2ae3d27d4eb4fULL * (sample_ordinal + 1)));
+      if (h > crop_h) off_h = static_cast<int>(rng() % (h - crop_h + 1));
+      if (w > crop_w) off_w = static_cast<int>(rng() % (w - crop_w + 1));
+      flip = (rng() & 1) != 0;
+    }
+    for (int i = 0; i < crop_h; ++i) {
+      const uint8_t* row = img + ((i + off_h) * w + off_w) * c;
+      float* out_row = dst + static_cast<size_t>(i) * crop_w * c;
+      for (int j = 0; j < crop_w; ++j) {
+        int src_j = flip ? (crop_w - 1 - j) : j;
+        const uint8_t* px = row + src_j * c;
+        float* out_px = out_row + j * c;
+        for (int k = 0; k < c; ++k)
+          out_px[k] = (static_cast<float>(px[k]) - mean[k]) / stddev[k];
+      }
+    }
+  }
+
+  void fill_batch(Slot& s, long long ticket) {
+    long long e = ticket / batches_per_epoch;
+    long long b_in_epoch = ticket % batches_per_epoch;
+    const std::vector<uint32_t>& p = perm_for_epoch(e);
+    for (int i = 0; i < batch; ++i) {
+      long long ordinal = b_in_epoch * batch + i;
+      uint32_t idx = p[ordinal];
+      s.y[i] = labels[idx];
+      fill_sample(s.x.data() + static_cast<size_t>(i) * crop_h * crop_w * c,
+                  idx, static_cast<uint64_t>(e) * n + ordinal);
+    }
+  }
+
+  void worker() {
+    while (!stop.load(std::memory_order_relaxed)) {
+      long long ticket = next_ticket.fetch_add(1);
+      Slot& s = *slots[ticket % ring_size];
+      {
+        std::unique_lock<std::mutex> lk(s.m);
+        s.cv_free.wait(lk, [&] {
+          return stop.load() || (s.ready_batch == -1 && !s.in_use &&
+                                 s.next_fill == ticket);
+        });
+        if (stop.load()) return;
+      }
+      fill_batch(s, ticket);
+      {
+        std::lock_guard<std::mutex> lk(s.m);
+        s.ready_batch = ticket;
+        s.next_fill = ticket + ring_size;
+      }
+      s.cv_ready.notify_all();
+    }
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+void* cmn_loader_create(const uint8_t* data, const int32_t* labels, int n,
+                        int h, int w, int c, int batch, int crop_h,
+                        int crop_w, int n_threads, int ring_size,
+                        uint64_t seed, int shuffle, int train,
+                        const float* mean, const float* stddev) {
+  if (!data || !labels || n <= 0 || batch <= 0 || batch > n ||
+      crop_h > h || crop_w > w || n_threads <= 0 || ring_size <= 0)
+    return nullptr;
+  Loader* L = new Loader();
+  L->data = data;
+  L->labels = labels;
+  L->n = n; L->h = h; L->w = w; L->c = c;
+  L->batch = batch; L->crop_h = crop_h; L->crop_w = crop_w;
+  L->ring_size = ring_size;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  L->train = train != 0;
+  L->mean.assign(mean, mean + c);
+  L->stddev.assign(stddev, stddev + c);
+  L->batches_per_epoch = n / batch;  // drop-last semantics
+  if (L->batches_per_epoch == 0) { delete L; return nullptr; }
+  // The two-entry (epoch parity) permutation cache is only safe while
+  // concurrently-filling tickets span at most two consecutive epochs.
+  // Fills in flight cover tickets [consume_idx, consume_idx + ring), so
+  // clamping ring to one epoch's batch count guarantees that: a fill for
+  // epoch e+2 can only start after every epoch-e ticket was consumed.
+  if (ring_size > L->batches_per_epoch)
+    ring_size = static_cast<int>(L->batches_per_epoch);
+  L->ring_size = ring_size;
+  for (int i = 0; i < ring_size; ++i) {
+    auto s = std::make_unique<Slot>();
+    s->x.resize(static_cast<size_t>(batch) * crop_h * crop_w * c);
+    s->y.resize(batch);
+    s->next_fill = i;  // slot i's first ticket is i
+    L->slots.push_back(std::move(s));
+  }
+  for (int i = 0; i < n_threads; ++i)
+    L->workers.emplace_back([L] { L->worker(); });
+  return L;
+}
+
+// Blocks until the next batch (in deterministic ticket order) is ready.
+// Returns the slot id (>= 0) and sets *x / *y to the slot's buffers;
+// the caller must cmn_loader_release(slot) before that slot can be
+// reused.  Returns -1 after shutdown.
+int cmn_loader_acquire(void* handle, float** x, int32_t** y) {
+  Loader* L = static_cast<Loader*>(handle);
+  long long want = L->consume_idx;
+  Slot& s = *L->slots[want % L->ring_size];
+  std::unique_lock<std::mutex> lk(s.m);
+  s.cv_ready.wait(lk, [&] { return L->stop.load() || s.ready_batch == want; });
+  if (L->stop.load()) return -1;
+  s.in_use = true;
+  *x = s.x.data();
+  *y = s.y.data();
+  L->consume_idx++;
+  return static_cast<int>(want % L->ring_size);
+}
+
+void cmn_loader_release(void* handle, int slot) {
+  Loader* L = static_cast<Loader*>(handle);
+  Slot& s = *L->slots[slot];
+  {
+    std::lock_guard<std::mutex> lk(s.m);
+    s.in_use = false;
+    s.ready_batch = -1;
+  }
+  s.cv_free.notify_all();
+}
+
+long long cmn_loader_epoch(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  return L->consume_idx / L->batches_per_epoch;
+}
+
+long long cmn_loader_iteration(void* handle) {
+  return static_cast<Loader*>(handle)->consume_idx;
+}
+
+long long cmn_loader_batches_per_epoch(void* handle) {
+  return static_cast<Loader*>(handle)->batches_per_epoch;
+}
+
+void cmn_loader_destroy(void* handle) {
+  Loader* L = static_cast<Loader*>(handle);
+  L->stop.store(true);
+  for (auto& s : L->slots) {
+    s->cv_free.notify_all();
+    s->cv_ready.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  delete L;
+}
+
+}  // extern "C"

@@ -1,0 +1,363 @@
+"""Pallas TPU kernels: flash attention and fused cast/scale.
+
+SURVEY.md section 2, native-code obligations: the reference's only
+embedded device kernels are the fp16 cast/scale ElementwiseKernels inside
+PureNcclCommunicator (#11) and the pack/unpack copy loops (#15).  The TPU
+rebuild's counterparts are (a) :func:`fused_cast_scale` — one pass over a
+gradient buffer instead of separate cast and divide ops — and (b)
+:func:`flash_attention` — a blocked online-softmax attention kernel whose
+K/V residency is one (block_k, d) tile per grid step (the S x S score
+matrix never exists in HBM; MXU matmuls, fp32 accumulation).
+``ulysses_attention`` accepts it through its ``attention_fn`` hook
+(``ring_attention`` has its own online-merge core and takes no hook).
+
+Kernels run compiled on TPU and fall back to interpret mode elsewhere
+(tests exercise them on CPU via ``interpret=True``).  The backward pass is
+a *blocked recompute* in plain JAX — chunked over queries (for dq) and
+keys (for dk/dv) with ``lax.map``, so training memory stays O(s * chunk),
+not O(s^2); XLA fuses each chunk's matmuls on its own.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+try:  # pallas is an experimental namespace; degrade gracefully
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    PALLAS_AVAILABLE = True
+except ImportError:  # pragma: no cover
+    PALLAS_AVAILABLE = False
+
+_NEG_INF = -1e30
+
+
+def _should_interpret(interpret: Optional[bool]) -> bool:
+    if interpret is not None:
+        return interpret
+    return jax.default_backend() != "tpu"
+
+
+def _round_up(x: int, m: int) -> int:
+    return (x + m - 1) // m * m
+
+
+# ----------------------------------------------------------------------
+# Flash attention — forward kernel
+# ----------------------------------------------------------------------
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
+                      *, s_k: int, causal: bool, scale: float,
+                      block_q: int, block_k: int):
+    """Grid (batch*head, q_blocks, k_blocks); the k dimension is innermost
+    and sequential on TPU, so the fp32 accumulator / running max /
+    denominator live in VMEM scratch across k steps.  K/V residency is one
+    (block_k, d) tile per step."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    # Causal: a k block strictly above the diagonal contributes nothing —
+    # skip its matmuls entirely (static predicate per (j, kb) pair).
+    first_q = j * block_q
+    first_k = kb * block_k
+    live = (first_k <= first_q + block_q - 1) if causal else True
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+        k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        q_idx = first_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = first_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = k_idx < s_k  # padded keys never contribute
+        if causal:
+            mask = mask & (k_idx <= q_idx)
+        s = jnp.where(mask, s, _NEG_INF)
+
+        m_old = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_old - m_new)
+        l_new = alpha * l_ref[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = alpha * acc_ref[:] + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        # Fully-masked rows (query padding) have l == 0.
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, 0:1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    bq = min(block_q, _round_up(s_q, 8))
+    bk = min(block_k, _round_up(s_k, 8))
+
+    def to_bh(x, s, blk):
+        # (b, s, h, d) -> (b*h, s_padded_to_blk, d)
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+        pad = _round_up(s, blk) - s
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qb = to_bh(q, s_q, bq)
+    kb_, vb = to_bh(k, s_k, bk), to_bh(v, s_k, bk)
+    s_qp, s_kp = qb.shape[1], kb_.shape[1]
+
+    grid = (b * h, s_qp // bq, s_kp // bk)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_fwd_kernel, s_k=s_k, causal=causal, scale=scale,
+            block_q=bq, block_k=bk,
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((bq, d), jnp.float32),    # acc
+            pltpu.VMEM((bq, 128), jnp.float32),  # running max (col 0)
+            pltpu.VMEM((bq, 128), jnp.float32),  # running denom (col 0)
+        ],
+        interpret=interpret,
+    )(qb, kb_, vb)
+    out = out[:, :s_q].reshape(b, h, s_q, d)
+    return jnp.moveaxis(out, 1, 2)  # (b, s, h, d)
+
+
+# ----------------------------------------------------------------------
+# Flash attention — blocked recompute backward (plain JAX, O(s * chunk))
+# ----------------------------------------------------------------------
+def _chunked(x, chunk, axis=1):
+    """Pad axis to a chunk multiple and reshape into (n_chunks, chunk)."""
+    s = x.shape[axis]
+    pad = _round_up(s, chunk) - s
+    if pad:
+        widths = [(0, 0)] * x.ndim
+        widths[axis] = (0, pad)
+        x = jnp.pad(x, widths)
+    new_shape = (
+        x.shape[:axis] + (x.shape[axis] // chunk, chunk)
+        + x.shape[axis + 1:]
+    )
+    return x.reshape(new_shape)
+
+
+def _blocked_attention_grads(q, k, v, o, do, causal, scale, chunk):
+    """dq, dk, dv without materializing the (s_q, s_k) score matrix.
+
+    All inputs (bh, s, d) fp32.  Two passes of ``lax.map`` over chunks:
+    queries for dq (scores are (chunk, s_k) — linear in s), keys for
+    dk/dv (scores are (s_q, chunk)).  The softmax statistics (lse) are
+    recomputed in the first pass and reused in the second.
+    """
+    bh, s_q, d = q.shape
+    s_k = k.shape[1]
+    D = jnp.sum(do * o, axis=-1)  # (bh, s_q)
+
+    q_pos = jnp.arange(s_q)
+    k_pos = jnp.arange(s_k)
+
+    def mask_bias(qi, kj):
+        m = jnp.ones((qi.shape[0], kj.shape[0]), bool)
+        if causal:
+            m = qi[:, None] >= kj[None, :]
+        return jnp.where(m, 0.0, _NEG_INF)
+
+    # -- pass 1: dq and lse, chunked over queries ----------------------
+    qc = _chunked(q, chunk)            # (bh, nq, c, d)
+    doc = _chunked(do, chunk)
+    Dc = _chunked(D, chunk)            # (bh, nq, c)
+    qic = _chunked(q_pos[None], chunk, axis=1)[0]  # (nq, c)
+
+    def one_q_chunk(args):
+        qc_i, do_i, D_i, qi = args  # (bh, c, d), (bh, c, d), (bh, c), (c,)
+        s = jnp.einsum("bcd,bkd->bck", qc_i, k) * scale
+        s = s + mask_bias(qi, k_pos)[None]
+        m = jnp.max(s, axis=-1, keepdims=True)
+        p = jnp.exp(s - m)
+        l = jnp.sum(p, axis=-1, keepdims=True)
+        lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (bh, c)
+        p = p / jnp.maximum(l, 1e-30)
+        dp = jnp.einsum("bcd,bkd->bck", do_i, v)
+        ds = p * (dp - D_i[..., None])
+        dq_i = jnp.einsum("bck,bkd->bcd", ds, k) * scale
+        return dq_i, lse
+
+    dq_c, lse_c = lax.map(
+        one_q_chunk,
+        (jnp.moveaxis(qc, 1, 0), jnp.moveaxis(doc, 1, 0),
+         jnp.moveaxis(Dc, 1, 0), qic),
+    )  # (nq, bh, c, d), (nq, bh, c)
+    dq = jnp.moveaxis(dq_c, 0, 1).reshape(bh, -1, d)[:, :s_q]
+    lse = jnp.moveaxis(lse_c, 0, 1).reshape(bh, -1)[:, :s_q]
+
+    # -- pass 2: dk / dv, chunked over keys ----------------------------
+    kc = _chunked(k, chunk)            # (bh, nk, c, d)
+    vc = _chunked(v, chunk)
+    kjc = _chunked(k_pos[None], chunk, axis=1)[0]  # (nk, c)
+
+    def one_k_chunk(args):
+        k_j, v_j, kj = args  # (bh, c, d), (bh, c, d), (c,)
+        s = jnp.einsum("bqd,bcd->bqc", q, k_j) * scale
+        s = s + mask_bias(q_pos, kj)[None]
+        p = jnp.exp(s - lse[..., None])  # normalized via saved lse
+        dv_j = jnp.einsum("bqc,bqd->bcd", p, do)
+        dp = jnp.einsum("bqd,bcd->bqc", do, v_j)
+        ds = p * (dp - D[..., None])
+        dk_j = jnp.einsum("bqc,bqd->bcd", ds, q) * scale
+        return dk_j, dv_j
+
+    dk_c, dv_c = lax.map(
+        one_k_chunk,
+        (jnp.moveaxis(kc, 1, 0), jnp.moveaxis(vc, 1, 0), kjc),
+    )
+    dk = jnp.moveaxis(dk_c, 0, 1).reshape(bh, -1, d)[:, :s_k]
+    dv = jnp.moveaxis(dv_c, 0, 1).reshape(bh, -1, d)[:, :s_k]
+    return dq, dk, dv
+
+
+# ----------------------------------------------------------------------
+# Public API
+# ----------------------------------------------------------------------
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention(q, k, v, causal=False, scale=None,
+                    block_q=128, block_k=128, interpret=None):
+    """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
+
+    Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
+    online softmax).  ``interpret=None`` auto-selects: compiled on TPU,
+    interpreter elsewhere.
+    """
+    if not PALLAS_AVAILABLE:
+        raise ImportError(
+            "flash_attention requires jax.experimental.pallas; use "
+            "chainermn_tpu.ops.multi_head_attention on this JAX build"
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                          _should_interpret(interpret))
+
+
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+    out = flash_attention(q, k, v, causal, scale, block_q, block_k,
+                          interpret)
+    return out, (q, k, v, out)
+
+
+def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
+                    residuals, g):
+    q, k, v, out = residuals
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b, s_q, h, d = q.shape
+
+    def to_bh(x):
+        return jnp.moveaxis(x, 2, 1).reshape(
+            b * h, x.shape[1], d
+        ).astype(jnp.float32)
+
+    chunk = max(block_q, 128)
+    dq, dk, dv = _blocked_attention_grads(
+        to_bh(q), to_bh(k), to_bh(v), to_bh(out), to_bh(g),
+        causal, scale, chunk,
+    )
+
+    def from_bh(x, s, dt):
+        return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2).astype(dt)
+
+    return (from_bh(dq, s_q, q.dtype), from_bh(dk, k.shape[1], k.dtype),
+            from_bh(dv, v.shape[1], v.dtype))
+
+
+flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def flash_attention_fn(block_q: int = 128, block_k: int = 128,
+                       interpret: Optional[bool] = None):
+    """Adapter producing the ``attention_fn`` signature used by
+    ``ulysses_attention``: ``(q, k, v, causal, scale)``."""
+
+    def fn(q, k, v, causal, scale):
+        return flash_attention(q, k, v, causal, scale, block_q, block_k,
+                               interpret)
+
+    return fn
+
+
+# ----------------------------------------------------------------------
+# Fused cast + scale (the reference's PureNccl fp16 kernels, #11)
+# ----------------------------------------------------------------------
+def _cast_scale_kernel(x_ref, o_ref, *, scale: float):
+    o_ref[:] = (x_ref[:].astype(jnp.float32) * scale).astype(o_ref.dtype)
+
+
+def fused_cast_scale(x: jnp.ndarray, scale: float, dtype,
+                     interpret: Optional[bool] = None) -> jnp.ndarray:
+    """``(x * scale).astype(dtype)`` in one VMEM pass.
+
+    Parity: the cast-and-scale ElementwiseKernels PureNcclCommunicator
+    launches around its fp16 allreduce (divide-by-size fused with the
+    cast-back).  Any shape; internally flattened to lane-aligned tiles.
+    """
+    if not PALLAS_AVAILABLE or x.size == 0:
+        return (x.astype(jnp.float32) * scale).astype(dtype)
+    shape = x.shape
+    flat = x.reshape(-1)
+    n = flat.shape[0]
+    lane = 128
+    rows = _round_up((n + lane - 1) // lane, 8)
+    pad = rows * lane - n
+    if pad:
+        flat = jnp.pad(flat, (0, pad))
+    tiled = flat.reshape(rows, lane)
+    block_rows = min(rows, 512)
+    rows_p = _round_up(rows, block_rows)
+    if rows_p != rows:
+        tiled = jnp.pad(tiled, ((0, rows_p - rows), (0, 0)))
+    out = pl.pallas_call(
+        functools.partial(_cast_scale_kernel, scale=scale),
+        out_shape=jax.ShapeDtypeStruct((rows_p, lane), jnp.dtype(dtype)),
+        grid=(rows_p // block_rows,),
+        in_specs=[pl.BlockSpec((block_rows, lane), lambda i: (i, 0))],
+        out_specs=pl.BlockSpec((block_rows, lane), lambda i: (i, 0)),
+        interpret=_should_interpret(interpret),
+    )(tiled)
+    return out.reshape(-1)[:n].reshape(shape)

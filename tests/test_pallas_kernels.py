@@ -1,0 +1,153 @@
+"""Pallas kernel tests (interpret mode on CPU).
+
+Pins: flash attention matches the reference attention core (values and
+gradients), composes with ring/Ulysses sequence parallelism through the
+``attention_fn`` hook, and fused_cast_scale matches cast+multiply.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.ops import multi_head_attention
+from chainermn_tpu.ops.pallas_attention import (
+    flash_attention,
+    flash_attention_fn,
+    fused_cast_scale,
+)
+
+
+def _qkv(b=2, s=32, h=4, d=8, seed=0):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(b, s, h, d), jnp.float32) * 0.3
+    return mk(), mk(), mk()
+
+
+class TestFlashAttention:
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_matches_reference(self, causal):
+        q, k, v = _qkv()
+        want = multi_head_attention(q, k, v, causal=causal)
+        got = flash_attention(q, k, v, causal, None, 16, 16, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_ragged_lengths_padded_correctly(self):
+        # seq length not a multiple of the block: padding keys must not
+        # leak into the softmax.
+        q, k, v = _qkv(s=23)
+        want = multi_head_attention(q, k, v, causal=True)
+        got = flash_attention(q, k, v, True, None, 16, 16, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_cross_attention_lengths(self):
+        rng = np.random.RandomState(1)
+        q = jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, 40, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, 40, 2, 8), jnp.float32)
+        want = multi_head_attention(q, k, v)
+        got = flash_attention(q, k, v, False, None, 16, 16, True)
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_gradients_match_reference(self):
+        q, k, v = _qkv(s=16)
+
+        def f_ref(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v, causal=True) ** 2)
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 8, 8, True) ** 2
+            )
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_flash):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-4
+            )
+
+    @pytest.mark.parametrize("bq,bk,s_q,s_k", [
+        (16, 24, 20, 20),   # blocks don't divide each other, ragged q
+        (24, 16, 24, 17),   # ragged k against larger q block
+        (8, 32, 40, 40),
+    ])
+    def test_mismatched_block_sizes(self, bq, bk, s_q, s_k):
+        # Regression: q and k/v must be padded by their OWN block sizes;
+        # shared padding produced NaN rows or out-of-bounds reads.
+        rng = np.random.RandomState(2)
+        q = jnp.asarray(rng.randn(2, s_q, 2, 8), jnp.float32)
+        k = jnp.asarray(rng.randn(2, s_k, 2, 8), jnp.float32)
+        v = jnp.asarray(rng.randn(2, s_k, 2, 8), jnp.float32)
+        want = multi_head_attention(q, k, v, causal=(s_q == s_k))
+        got = flash_attention(q, k, v, s_q == s_k, None, bq, bk, True)
+        assert np.isfinite(np.asarray(got)).all()
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+    def test_bf16_inputs(self):
+        q, k, v = _qkv()
+        got = flash_attention(
+            q.astype(jnp.bfloat16), k.astype(jnp.bfloat16),
+            v.astype(jnp.bfloat16), False, None, 16, 16, True,
+        )
+        want = multi_head_attention(q, k, v)
+        assert got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, dtype=np.float32), np.asarray(want),
+            rtol=2e-2, atol=2e-2,
+        )
+
+
+class TestFlashWithSequenceParallel:
+    def test_ulysses_with_flash_core(self, mesh8):
+        from chainermn_tpu.parallel import ulysses_attention
+
+        q, k, v = _qkv(b=2, s=64, h=8, d=8)
+        want = multi_head_attention(q, k, v, causal=True)
+        core = flash_attention_fn(block_q=8, block_k=8, interpret=True)
+
+        f = jax.jit(
+            jax.shard_map(
+                lambda q, k, v: ulysses_attention(
+                    q, k, v, "mn", causal=True, attention_fn=core
+                ),
+                mesh=mesh8,
+                in_specs=(P(None, "mn"),) * 3,
+                out_specs=P(None, "mn"),
+                check_vma=False,
+            )
+        )
+        sh = NamedSharding(mesh8, P(None, "mn"))
+        got = f(*(jax.device_put(t, sh) for t in (q, k, v)))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
+        )
+
+
+class TestFusedCastScale:
+    @pytest.mark.parametrize("shape", [(7,), (128,), (3, 5, 11), (256, 128)])
+    def test_matches_cast_multiply(self, shape):
+        rng = np.random.RandomState(0)
+        x = jnp.asarray(rng.randn(*shape), jnp.float32)
+        got = fused_cast_scale(x, 0.125, jnp.bfloat16, interpret=True)
+        want = (x * 0.125).astype(jnp.bfloat16)
+        assert got.shape == x.shape and got.dtype == jnp.bfloat16
+        np.testing.assert_allclose(
+            np.asarray(got, np.float32), np.asarray(want, np.float32),
+            rtol=1e-2,
+        )
+
+    def test_empty_input(self):
+        x = jnp.zeros((0,), jnp.float32)
+        got = fused_cast_scale(x, 0.5, jnp.bfloat16, interpret=True)
+        assert got.shape == (0,) and got.dtype == jnp.bfloat16

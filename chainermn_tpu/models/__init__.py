@@ -30,4 +30,9 @@ def __getattr__(name):
         from . import seq2seq
 
         return getattr(seq2seq, name)
+    if name in ("TransformerLM", "TransformerBlock", "lm_loss",
+                "sp_lm_loss"):
+        from . import transformer
+
+        return getattr(transformer, name)
     raise AttributeError(name)

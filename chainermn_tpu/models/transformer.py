@@ -1,0 +1,185 @@
+"""Transformer language model, TPU-first and sequence-parallel-native.
+
+The reference has no transformer (2017-era RNN/CNN zoo); this is the
+model family its modern successors need, built directly on the
+framework's sequence-parallel layer (SURVEY.md section 5.7: ring/Ulysses
+over the reference's p2p/alltoall primitives).
+
+Design:
+* One module, two execution regimes.  With ``seq_axis=None`` it is an
+  ordinary single-device causal LM.  Called inside ``shard_map`` with the
+  token sequence sharded over ``seq_axis``, the SAME module becomes
+  sequence-parallel: positional embeddings use global positions (axis
+  index offset) and attention runs :func:`parallel.ring_attention` over
+  the axis — everything else (LN, MLPs, embeddings) is position-local and
+  needs no communication.
+* ``attention_fn`` hook: the single-device core (default
+  ``ops.multi_head_attention``; pass ``ops.flash_attention_fn()`` for the
+  Pallas kernel).
+* bfloat16 compute, fp32 params, fp32 LayerNorm/softmax; logits fp32.
+* Pre-LN blocks; weight-tied output head (standard, halves embed params).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Optional
+
+import jax
+import jax.numpy as jnp
+from flax import linen as nn
+from jax import lax
+
+
+class MlpBlock(nn.Module):
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        d = x.shape[-1]
+        h = nn.Dense(self.d_ff, dtype=self.dtype)(x)
+        h = nn.gelu(h)
+        return nn.Dense(d, dtype=self.dtype)(h)
+
+
+class SelfAttention(nn.Module):
+    n_heads: int
+    dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x, *, causal: bool = True):
+        b, s, d = x.shape
+        if d % self.n_heads:
+            raise ValueError(f"d_model ({d}) % n_heads ({self.n_heads})")
+        dh = d // self.n_heads
+        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, self.n_heads, dh)
+        k = k.reshape(b, s, self.n_heads, dh)
+        v = v.reshape(b, s, self.n_heads, dh)
+        if self.seq_axis is not None:
+            from chainermn_tpu.parallel import ring_attention
+
+            out = ring_attention(q, k, v, self.seq_axis, causal=causal)
+        elif self.attention_fn is not None:
+            out = self.attention_fn(q, k, v, causal, dh**-0.5)
+        else:
+            from chainermn_tpu.ops import multi_head_attention
+
+            out = multi_head_attention(q, k, v, causal=causal)
+        out = out.reshape(b, s, d)
+        return nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
+
+
+class TransformerBlock(nn.Module):
+    n_heads: int
+    d_ff: int
+    dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, x):
+        ln = lambda: nn.LayerNorm(dtype=jnp.float32)
+        x = x + SelfAttention(
+            self.n_heads, dtype=self.dtype, seq_axis=self.seq_axis,
+            attention_fn=self.attention_fn,
+        )(ln()(x).astype(self.dtype))
+        x = x + MlpBlock(self.d_ff, dtype=self.dtype)(
+            ln()(x).astype(self.dtype)
+        )
+        return x
+
+
+class TransformerLM(nn.Module):
+    """Causal LM: tokens (batch, seq) -> logits (batch, seq, vocab).
+
+    Inside ``shard_map`` with tokens sequence-sharded over ``seq_axis``,
+    the returned logits are the local sequence shard's logits (global
+    positions preserved).
+    """
+
+    vocab_size: int
+    d_model: int = 256
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: Optional[int] = None
+    max_len: int = 2048
+    dtype: Any = jnp.bfloat16
+    seq_axis: Optional[str] = None
+    attention_fn: Optional[Callable] = None
+
+    @nn.compact
+    def __call__(self, tokens):
+        b, s = tokens.shape
+        d_ff = self.d_ff or 4 * self.d_model
+        embed = nn.Embed(
+            self.vocab_size, self.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            dtype=jnp.float32, name="embed",
+        )
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.d_model), jnp.float32,
+        )
+        offset = 0
+        if self.seq_axis is not None:
+            # Global positions: shard r holds [r*s, (r+1)*s).
+            offset = lax.axis_index(self.seq_axis) * s
+        pos = lax.dynamic_slice_in_dim(pos_table, offset, s, axis=0)
+
+        x = (embed(tokens) + pos[None]).astype(self.dtype)
+        for _ in range(self.n_layers):
+            x = TransformerBlock(
+                self.n_heads, d_ff, dtype=self.dtype,
+                seq_axis=self.seq_axis, attention_fn=self.attention_fn,
+            )(x)
+        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        # Weight-tied head.
+        logits = x.astype(jnp.float32) @ embed.embedding.T
+        return logits
+
+
+def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Next-token cross entropy over a (batch, seq) token block."""
+    import optax
+
+    targets = tokens[:, 1:]
+    preds = logits[:, :-1]
+    return optax.softmax_cross_entropy_with_integer_labels(
+        preds, targets
+    ).mean()
+
+
+def sp_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+               axis_name: str) -> jnp.ndarray:
+    """Next-token cross entropy for a sequence-sharded block.
+
+    Each shard's last position predicts the NEXT shard's first token, so
+    targets cross the shard boundary via ``ppermute`` (the differentiable
+    p2p layer the reference's send/recv points at); the final global
+    position has no target and is masked.  Returns the global mean
+    (psum-reduced), identical on every shard.
+    """
+    import optax
+
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, s = tokens.shape
+    # next shard's first token arrives from the right neighbor
+    nxt = lax.ppermute(
+        tokens[:, :1], axis_name,
+        [((i + 1) % n, i) for i in range(n)],
+    )
+    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)  # (b, s)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    # mask the last global position (wrapped target is shard 0's BOS)
+    global_pos = me * s + jnp.arange(s)[None, :]
+    valid = jnp.broadcast_to(
+        (global_pos < n * s - 1).astype(ce.dtype), ce.shape
+    )
+    total = lax.psum(jnp.sum(ce * valid), axis_name)
+    count = lax.psum(jnp.sum(valid), axis_name)
+    return total / count

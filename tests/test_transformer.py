@@ -1,0 +1,187 @@
+"""Transformer LM tests.
+
+Pins the sequence-parallel-native design: the SAME module (same params)
+produces identical logits single-device and sequence-sharded over an
+8-device mesh (ring attention + global positional offsets), the
+cross-shard LM loss matches the single-device loss, and a DP train step
+learns.
+"""
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+import chainermn_tpu as cmn
+from chainermn_tpu.models.transformer import (
+    TransformerLM,
+    lm_loss,
+    sp_lm_loss,
+)
+
+VOCAB, D, HEADS, LAYERS, MAXLEN = 64, 32, 4, 2, 128
+
+
+def _models():
+    dense = TransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        max_len=MAXLEN, dtype=jnp.float32,
+    )
+    sp = TransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        max_len=MAXLEN, dtype=jnp.float32, seq_axis="mn",
+    )
+    return dense, sp
+
+
+def _tokens(b=2, s=64, seed=0):
+    return jnp.asarray(
+        np.random.RandomState(seed).randint(0, VOCAB, (b, s)), jnp.int32
+    )
+
+
+class TestForward:
+    def test_shapes_and_dtype(self):
+        model, _ = _models()
+        toks = _tokens()
+        params = model.init(jax.random.PRNGKey(0), toks)
+        logits = model.apply(params, toks)
+        assert logits.shape == (2, 64, VOCAB)
+        assert logits.dtype == jnp.float32
+
+    def test_causality(self):
+        # Changing a future token must not change past logits.
+        model, _ = _models()
+        toks = _tokens()
+        params = model.init(jax.random.PRNGKey(0), toks)
+        a = model.apply(params, toks)
+        toks2 = toks.at[:, 40].set((toks[:, 40] + 1) % VOCAB)
+        b = model.apply(params, toks2)
+        np.testing.assert_allclose(
+            np.asarray(a[:, :40]), np.asarray(b[:, :40]), atol=1e-5
+        )
+        assert not np.allclose(np.asarray(a[:, 40:]), np.asarray(b[:, 40:]))
+
+
+class TestSequenceParallel:
+    def test_sp_forward_matches_dense(self, mesh8):
+        dense, sp = _models()
+        toks = _tokens(b=2, s=64)
+        params = dense.init(jax.random.PRNGKey(0), toks)
+        want = dense.apply(params, toks)
+
+        f = jax.jit(
+            jax.shard_map(
+                lambda p, t: sp.apply(p, t),
+                mesh=mesh8,
+                in_specs=(P(), P(None, "mn")),
+                out_specs=P(None, "mn"),
+                check_vma=False,
+            )
+        )
+        got = f(params, jax.device_put(
+            toks, NamedSharding(mesh8, P(None, "mn"))
+        ))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
+        )
+
+    def test_sp_loss_matches_dense(self, mesh8):
+        dense, sp = _models()
+        toks = _tokens(b=2, s=64)
+        params = dense.init(jax.random.PRNGKey(0), toks)
+        want = lm_loss(dense.apply(params, toks), toks)
+
+        def shard_loss(p, t):
+            logits = sp.apply(p, t)
+            return sp_lm_loss(logits, t, "mn")
+
+        f = jax.jit(
+            jax.shard_map(
+                shard_loss, mesh=mesh8,
+                in_specs=(P(), P(None, "mn")), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        got = f(params, jax.device_put(
+            toks, NamedSharding(mesh8, P(None, "mn"))
+        ))
+        np.testing.assert_allclose(float(got), float(want), rtol=1e-5)
+
+    def test_sp_gradients_finite_and_flow(self, mesh8):
+        dense, sp = _models()
+        toks = _tokens(b=2, s=64)
+        # init with the dense twin: identical param structure, and init
+        # outside shard_map has no axis bound
+        params = dense.init(jax.random.PRNGKey(0), toks)
+
+        def shard_loss(p, t):
+            return sp_lm_loss(sp.apply(p, t), t, "mn")
+
+        g = jax.jit(
+            jax.shard_map(
+                jax.grad(shard_loss), mesh=mesh8,
+                in_specs=(P(), P(None, "mn")), out_specs=P(),
+                check_vma=False,
+            )
+        )
+        grads = g(params, jax.device_put(
+            toks, NamedSharding(mesh8, P(None, "mn"))
+        ))
+        leaves = jax.tree_util.tree_leaves(grads)
+        assert all(np.isfinite(np.asarray(l)).all() for l in leaves)
+        assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
+
+
+class TestTraining:
+    def test_dp_train_step_learns(self, devices8):
+        comm = cmn.create_communicator("tpu", devices=devices8)
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=MAXLEN, dtype=jnp.float32,
+        )
+        # Learnable synthetic stream: next token = (t + 1) % VOCAB.
+        base = np.arange(VOCAB, dtype=np.int32)
+        toks = jnp.asarray(np.stack(
+            [np.roll(base, -i)[:32] for i in range(16)]
+        ))
+        params = model.init(jax.random.PRNGKey(0), toks[:1])
+        opt = cmn.create_multi_node_optimizer(optax.adam(1e-2), comm)
+
+        def loss_fn(p, batch):
+            return lm_loss(model.apply(p, batch), batch)
+
+        step = cmn.build_train_step(comm, loss_fn, opt, donate=False)
+        params, opt_state = step.place(params, opt.init(params))
+        bt = jax.device_put(toks, step.batch_sharding)
+        first = None
+        for i in range(30):
+            params, opt_state, m = step(params, opt_state, bt)
+            if first is None:
+                first = float(m["loss"])
+        last = float(m["loss"])
+        assert last < first * 0.5, (first, last)
+
+    def test_flash_core_matches_default(self):
+        from chainermn_tpu.ops import flash_attention_fn
+
+        toks = _tokens(b=2, s=32)
+        dense = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=MAXLEN, dtype=jnp.float32,
+        )
+        flash = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=MAXLEN, dtype=jnp.float32,
+            attention_fn=flash_attention_fn(block_q=8, block_k=8,
+                                            interpret=True),
+        )
+        params = dense.init(jax.random.PRNGKey(0), toks)
+        a = dense.apply(params, toks)
+        b = flash.apply(params, toks)
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5
+        )

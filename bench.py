@@ -15,7 +15,10 @@ import os
 import sys
 import time
 
-sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+try:  # installed package (pip install -e .)
+    import chainermn_tpu  # noqa: F401
+except ImportError:  # source checkout: repo root = this file's directory
+    sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 
 CHAINERMN_RESNET50_IMG_PER_SEC_PER_CHIP = 125.0
 

@@ -32,7 +32,7 @@ from chainermn_tpu.extensions import (  # noqa: F401
 )
 from chainermn_tpu import global_except_hook  # noqa: F401
 
-__version__ = "0.1.0"
+__version__ = "0.2.0"
 
 
 def __getattr__(name):

@@ -30,6 +30,15 @@ from .communicator_base import dumps, loads
 MAX_OBJ_CHUNK_BYTES = 256 * 1024 * 1024
 
 
+def _recv_timeout_ms() -> int:
+    """Blocking-recv timeout for the KV-store path.  A peer that died never
+    publishes its key; a bounded wait turns that into an error the global
+    except hook can contain instead of a 10-minute hang."""
+    import os
+
+    return int(os.environ.get("CHAINERMN_TPU_OBJ_TIMEOUT_MS", 600_000))
+
+
 class LocalObjStore:
     """In-process mailbox — all ranks share one controller."""
 
@@ -42,24 +51,28 @@ class LocalObjStore:
             raise ValueError(f"dest {dest} out of range for size {self._size}")
         self._mail[(dest, tag)].append(dumps(obj))
 
-    def recv(self, source: int, tag: int = 0) -> Any:
-        del source  # single mailbox per (dest, tag) under one controller
-        box = self._mail[(self._my_rank(), tag)]
+    def recv(self, source: int, tag: int = 0, dest: int = 0) -> Any:
+        """Drain the mailbox of rank ``dest``.
+
+        Under one controller there is no ambient "my rank", so the receiving
+        rank is an explicit argument (default 0 mirrors the common
+        root-receives pattern).  ``source`` is accepted for MPI-shaped parity
+        but not matched on: messages to one rank form a single FIFO per tag,
+        exactly like MPI_ANY_SOURCE.
+        """
+        del source
+        if not 0 <= dest < self._size:
+            raise ValueError(f"dest {dest} out of range for size {self._size}")
+        box = self._mail[(dest, tag)]
         if not box:
             raise RuntimeError(
-                f"recv_obj: no message pending for tag {tag} "
+                f"recv_obj: no message pending for rank {dest}/tag {tag} "
                 "(single-controller recv must follow the matching send)"
             )
         return loads(box.popleft())
 
     def recv_for(self, dest: int, tag: int = 0) -> Any:
-        box = self._mail[(dest, tag)]
-        if not box:
-            raise RuntimeError(f"recv_obj: no message for rank {dest}/tag {tag}")
-        return loads(box.popleft())
-
-    def _my_rank(self) -> int:
-        return 0
+        return self.recv(source=-1, tag=tag, dest=dest)
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
         del root
@@ -136,13 +149,19 @@ class MultiprocessObjStore:
             client.key_value_set_bytes(f"{key}/{i}", chunk)
         client.key_value_set_bytes(f"{key}/len", str(len(payload)).encode())
 
-    def recv(self, source: int, tag: int = 0) -> Any:
+    def recv(self, source: int, tag: int = 0, dest: int = None) -> Any:
+        if dest is not None and dest != jax.process_index():
+            raise ValueError(
+                f"multi-process recv_obj can only receive for this process "
+                f"(index {jax.process_index()}), got dest={dest}"
+            )
         key = f"cmn_obj/{source}->{jax.process_index()}/{tag}/{self._seq[('r', source, tag)]}"
         self._seq[("r", source, tag)] += 1
         client = self._kv()
-        total = int(client.blocking_key_value_get_bytes(f"{key}/len", 600_000))
+        timeout = _recv_timeout_ms()
+        total = int(client.blocking_key_value_get_bytes(f"{key}/len", timeout))
         payload = b"".join(
-            client.blocking_key_value_get_bytes(f"{key}/{i}", 600_000)
+            client.blocking_key_value_get_bytes(f"{key}/{i}", timeout)
             for i in range(0, max(total, 1), MAX_OBJ_CHUNK_BYTES)
         )
         return loads(payload[:total])

@@ -199,8 +199,18 @@ class CommunicatorBase:
     def send_obj(self, obj: Any, dest: int, tag: int = 0) -> None:
         self._obj_store.send(obj, dest=dest, tag=tag)
 
-    def recv_obj(self, source: int, tag: int = 0) -> Any:
-        return self._obj_store.recv(source=source, tag=tag)
+    def recv_obj(self, source: int, tag: int = 0, dest: Optional[int] = None
+                 ) -> Any:
+        """Receive a pickled message.
+
+        ``dest`` names the receiving rank.  Under MPI that is implicitly the
+        calling process; under a single controller every rank lives here, so
+        it is an explicit argument (default: rank 0 / this process).
+        """
+        if dest is None:
+            dest = 0 if self.process_count == 1 else None
+        kw = {} if dest is None else {"dest": dest}
+        return self._obj_store.recv(source=source, tag=tag, **kw)
 
     def bcast_obj(self, obj: Any, root: int = 0) -> Any:
         return self._obj_store.bcast(obj, root=root)

@@ -134,17 +134,101 @@ class NonCudaAwareCommunicator(XlaCommunicatorBase):
     Parity: ``NonCudaAwareCommunicator`` (non_cuda_aware_communicator.py),
     which staged GPU buffers through pinned host memory for plain MPI.  On
     TPU this path exists only for API parity and as a numerics oracle; it is
-    intentionally the slow tier.
+    intentionally the slow tier.  Its contract is that EVERY collective
+    round-trips through host memory — no XLA collective in the data path —
+    so each op below is a NumPy computation bracketed by device_get/put.
     """
 
-    def allreduce(self, x, op: str = "sum"):
+    def _host(self, x, stacked: bool = True):
         host = np.asarray(jax.device_get(x))
+        if stacked and (host.ndim == 0 or host.shape[0] != self.size):
+            raise ValueError(
+                f"stacked array must have leading axis == size "
+                f"({self.size}); got shape {host.shape}"
+            )
+        return host
+
+    def _replicate(self, arr):
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            jnp.asarray(arr), NamedSharding(self.mesh, PartitionSpec())
+        )
+
+    def allreduce(self, x, op: str = "sum"):
+        host = self._host(x)
         red = {
             "sum": np.sum, "mean": np.mean, "max": np.max,
             "min": np.min, "prod": np.prod,
         }[op](host, axis=0)
-        out = np.broadcast_to(red, host.shape)
-        return self._put(jnp.asarray(out.copy()))
+        return self._put(jnp.asarray(np.broadcast_to(red, host.shape).copy()))
+
+    def bcast(self, x, root: int = 0):
+        host = self._host(x)
+        return self._put(np.broadcast_to(host[root], host.shape).copy())
+
+    def allgather(self, x):
+        return self._replicate(self._host(x).copy())
+
+    def gather(self, x, root: int = 0):
+        return jax.device_put(
+            jnp.asarray(self._host(x).copy()), self.devices[root]
+        )
+
+    def scatter(self, x, root: int = 0):
+        del root
+        return self._put(np.asarray(jax.device_get(x)).copy())
+
+    def alltoall(self, x):
+        host = np.asarray(jax.device_get(x))
+        if host.ndim < 2 or host.shape[0] != self.size or \
+                host.shape[1] != self.size:
+            raise ValueError(
+                f"alltoall expects (size, size, ...); got {host.shape}"
+            )
+        return self._put(np.swapaxes(host, 0, 1).copy())
+
+    def send(self, x, dest: int, source: int):
+        host = self._host(x)
+        out = np.zeros_like(host)
+        out[dest] = host[source]
+        return self._put(out)
+
+    def reduce_scatter(self, x, op: str = "sum"):
+        if op not in ("sum", "mean"):  # match the XLA tier's surface
+            raise ValueError(f"reduce_scatter supports sum/mean, got {op!r}")
+        host = self._host(x)
+        if host.ndim != 2 or host.shape[1] % self.size:
+            raise ValueError(
+                f"reduce_scatter expects (size, k*size); got {host.shape}"
+            )
+        red = np.sum(host, axis=0)
+        if op == "mean":
+            red = red / self.size
+        return self._put(red.reshape(self.size, -1).copy())
+
+    def allreduce_grad(self, grads, *, mean: bool = True):
+        # Host-staged contract AND numerics-oracle contract: with a wire
+        # dtype, accumulation happens in that dtype (cast -> reduce ->
+        # scale -> cast back), matching the XLA tier's fused program —
+        # including its overflow behavior.
+        dt = self._allreduce_grad_dtype
+
+        def one(g):
+            host = self._host(g)
+            if dt is None:
+                red = host.mean(axis=0) if mean else host.sum(axis=0)
+            else:
+                acc = host.astype(dt)
+                red = np.sum(acc, axis=0, dtype=dt)
+                if mean:
+                    red = (red / dt.type(self.size)).astype(dt)
+                red = red.astype(host.dtype)
+            return self._put(
+                jnp.asarray(np.broadcast_to(red, host.shape).copy())
+            )
+
+        return jax.tree_util.tree_map(one, grads)
 
 
 class NaiveCommunicator(CommunicatorBase):

@@ -63,6 +63,7 @@ struct Loader {
   std::vector<float> mean, stddev;
 
   long long batches_per_epoch;
+  int n_threads;
   std::atomic<long long> next_ticket{0};
   long long consume_idx = 0;
   std::atomic<bool> stop{false};
@@ -171,6 +172,7 @@ void* cmn_loader_create(const uint8_t* data, const int32_t* labels, int n,
   L->n = n; L->h = h; L->w = w; L->c = c;
   L->batch = batch; L->crop_h = crop_h; L->crop_w = crop_w;
   L->ring_size = ring_size;
+  L->n_threads = n_threads;
   L->seed = seed;
   L->shuffle = shuffle != 0;
   L->train = train != 0;
@@ -238,6 +240,39 @@ long long cmn_loader_iteration(void* handle) {
 
 long long cmn_loader_batches_per_epoch(void* handle) {
   return static_cast<Loader*>(handle)->batches_per_epoch;
+}
+
+// Reposition the stream so the next acquire returns ticket `iteration`
+// (forwards or backwards), without producing and discarding the skipped
+// batches.  Determinism is keyed on (seed, ticket), so the post-seek
+// stream is bit-identical to a fresh loader consumed to the same point.
+// Quiesces the worker threads, resets the ring, and restarts them —
+// milliseconds, independent of how deep into training the target is.
+int cmn_loader_seek(void* handle, long long iteration) {
+  Loader* L = static_cast<Loader*>(handle);
+  if (!L || iteration < 0) return -1;
+  L->stop.store(true);
+  for (auto& s : L->slots) {
+    s->cv_free.notify_all();
+    s->cv_ready.notify_all();
+  }
+  for (auto& t : L->workers) t.join();
+  L->workers.clear();
+  L->stop.store(false);
+  L->next_ticket.store(iteration);
+  L->consume_idx = iteration;
+  long long r = iteration % L->ring_size;
+  for (int j = 0; j < L->ring_size; ++j) {
+    Slot& s = *L->slots[j];
+    std::lock_guard<std::mutex> lk(s.m);
+    s.ready_batch = -1;
+    s.in_use = false;
+    // first ticket >= iteration that lands in slot j
+    s.next_fill = iteration + ((j - r + L->ring_size) % L->ring_size);
+  }
+  for (int i = 0; i < L->n_threads; ++i)
+    L->workers.emplace_back([L] { L->worker(); });
+  return 0;
 }
 
 void cmn_loader_destroy(void* handle) {

@@ -5,11 +5,16 @@ Reference parity: ``chainermn/extensions/allreduce_persistent.py`` —
 (BatchNorm running mean/var) so ranks agree before snapshot/eval.
 
 TPU-native form: persistent state is the flax ``batch_stats`` collection.
-Under GSPMD these are already replicated global arrays *within* one
-controller; cross-process agreement (multi-controller drift, e.g. from
-non-deterministic host input orders) is restored by a pmean over the mesh
-axes when the stats were computed per-shard, or a host allreduce across
-processes otherwise.
+Three situations, three paths:
+
+* **Replicated global arrays** (the compiled ``build_train_step`` tier,
+  which already pmean-s aux state): nothing to do — identity.
+* **Stacked per-rank stats** (the eager tier: leading axis == comm.size,
+  one slice per rank, exactly the reference's per-rank BN buffers): pass
+  ``stacked=True`` — the reduce is ``comm.allreduce(mean)`` over the mesh
+  axes, riding ICI.
+* **Multi-controller drift** (per-process host state, e.g. from
+  non-deterministic input orders): a host allreduce across processes.
 """
 
 from __future__ import annotations
@@ -23,13 +28,24 @@ class AllreducePersistent:
     trigger = (1, "epoch")
     name = "allreduce_persistent"
 
-    def __init__(self, comm, stats_getter=None, stats_setter=None):
+    def __init__(self, comm, stats_getter=None, stats_setter=None,
+                 stacked: bool = False):
         self._comm = comm
         self._get = stats_getter
         self._set = stats_setter
+        self._stacked = stacked
 
     def reduce(self, stats):
-        """Average a pytree of persistent arrays across processes."""
+        """Average a pytree of persistent arrays so every rank/process
+        agrees (parity: AllreducePersistent.__call__'s allreduce)."""
+        if self._stacked:
+            # per-rank stacked stats -> every slice = mean over ranks, via
+            # the communicator's XLA (ICI) allreduce.  The stacked array is
+            # global over every process's devices, so this path alone
+            # already makes all controllers agree.
+            return jax.tree_util.tree_map(
+                lambda x: self._comm.allreduce(x, op="mean"), stats
+            )
         if self._comm.process_count > 1:
             from jax.experimental import multihost_utils
 
@@ -38,7 +54,7 @@ class AllreducePersistent:
                 return jnp.mean(g, axis=0)
 
             return jax.tree_util.tree_map(mean_across, stats)
-        # Single controller: stats are already globally consistent.
+        # Replicated single-controller state is already consistent.
         return stats
 
     def __call__(self, trainer):

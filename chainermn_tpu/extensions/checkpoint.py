@@ -19,21 +19,13 @@ strictly stronger than the reference's per-rank npz inventory).
 from __future__ import annotations
 
 import os
+import pickle
 import re
 import shutil
 from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
-
-
-def _flatten_state(tree) -> Dict[str, np.ndarray]:
-    flat = {}
-    leaves, treedef = jax.tree_util.tree_flatten_with_path(tree)
-    for path, leaf in leaves:
-        key = "/".join(str(p) for p in path)
-        flat[key] = np.asarray(leaf)
-    return flat
 
 
 class _MultiNodeCheckpointer:
@@ -79,24 +71,66 @@ class _MultiNodeCheckpointer:
         # dir (with no orbax tmp marker) means commit finished.
         return os.path.isdir(path) and not path.endswith(".tmp")
 
+    @property
+    def _is_chief(self) -> bool:
+        return self._comm.process_index == 0
+
+    @property
+    def _multiproc(self) -> bool:
+        return self._comm.process_count > 1
+
     # -- save ----------------------------------------------------------
     def save(self, step: int, state: Dict[str, Any]) -> None:
-        """Snapshot ``state`` (a pytree of global arrays + metadata)."""
+        """Snapshot ``state`` (a pytree of global arrays + metadata).
+
+        Under multi-process this is a collective: every process must call
+        it (orbax writes each process's addressable shards); filesystem
+        mutations of shared directories are chief-only with barriers.
+        """
         target = self._step_dir(step)
-        if os.path.exists(target):
-            shutil.rmtree(target)
-        if self._use_orbax:
-            try:
-                self._orbax().save(os.path.abspath(target), state)
-            except Exception:
-                self._save_np(target, state)
+        if self._multiproc:
+            if not self._use_orbax:
+                raise ValueError(
+                    "use_orbax=False is single-controller only: the npz "
+                    "fallback cannot materialize non-addressable shards "
+                    "of multi-process global arrays"
+                )
+            if self._is_chief and os.path.exists(target):
+                shutil.rmtree(target)
+            self._comm.barrier()
+            self._orbax().save(os.path.abspath(target), state)
+            self._comm.barrier()
         else:
-            self._save_np(target, state)
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            if self._use_orbax:
+                try:
+                    self._orbax().save(os.path.abspath(target), state)
+                except Exception:
+                    # Degraded single-controller path; see _save_np.
+                    self._save_np(target, state)
+            else:
+                self._save_np(target, state)
         self._gc()
 
     def _save_np(self, target: str, state) -> None:
+        """Degraded (orbax-less) backend.
+
+        Must satisfy the same contract as the orbax path: ``resume`` returns
+        the *original pytree structure* so ``restore_trainer`` can index
+        ``state["params"]`` etc.  Leaves are stored as indexed npz entries
+        and the treedef is pickled alongside (treedefs of standard
+        containers and NamedTuples pickle fine).  Single-controller only:
+        leaves are materialized via ``np.asarray``.
+        """
         os.makedirs(target, exist_ok=True)
-        np.savez(os.path.join(target, "state.npz"), **_flatten_state(state))
+        leaves, treedef = jax.tree_util.tree_flatten(state)
+        np.savez(
+            os.path.join(target, "state.npz"),
+            **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
+        )
+        with open(os.path.join(target, "treedef.pkl"), "wb") as f:
+            pickle.dump(treedef, f)
 
     # -- agreement + resume --------------------------------------------
     def newest_common_step(self) -> Optional[int]:
@@ -118,14 +152,35 @@ class _MultiNodeCheckpointer:
         target = self._step_dir(step)
         npz = os.path.join(target, "state.npz")
         if os.path.exists(npz):
+            treedef_path = os.path.join(target, "treedef.pkl")
+            if not os.path.exists(treedef_path):
+                raise RuntimeError(
+                    f"checkpoint {target} uses the pre-0.2 flattened npz "
+                    "format (no treedef.pkl); its tree structure cannot "
+                    "be reconstructed — re-save with the current version"
+                )
             data = np.load(npz, allow_pickle=True)
-            return step, dict(data)
+            with open(treedef_path, "rb") as f:
+                treedef = pickle.load(f)
+            leaves = [data[f"leaf_{i}"] for i in range(treedef.num_leaves)]
+            leaves = [l[()] if l.ndim == 0 and l.dtype == object else l
+                      for l in leaves]
+            return step, jax.tree_util.tree_unflatten(treedef, leaves)
         state = self._orbax().restore(
             os.path.abspath(target), item=like
         )
         return step, state
 
     def _gc(self) -> None:
+        if self._multiproc:
+            # shared-FS deletes are chief-only; peers wait so a stale dir
+            # never reappears in a subsequent scan
+            if self._is_chief:
+                steps = self._available_steps()
+                for s in steps[: -self._keep] if self._keep else []:
+                    shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            self._comm.barrier()
+            return
         steps = self._available_steps()
         for s in steps[: -self._keep] if self._keep else []:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)

@@ -34,10 +34,28 @@ flexible-graph ergonomics instead.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import Any, Callable, List, Optional, Sequence, Tuple, Union
 
 import jax
 import jax.numpy as jnp
+
+
+@dataclasses.dataclass
+class PlacedModule:
+    """A module bundled with its activation routing.
+
+    Produced by factories that mirror reference signatures taking
+    ``(comm, rank_in, rank_out)`` — e.g. ``create_multi_node_n_step_rnn``
+    — so the declared routing actually takes effect when the module is
+    registered: ``chain.add_link(placed)`` reads the edges from here
+    instead of requiring them to be repeated.
+    """
+
+    module: Any
+    rank_in: Any = None  # None | int | list[int]
+    rank_out: Any = None  # None | int | list[int]
+    rank: Optional[int] = None  # explicit placement (default: next free)
 
 
 class _Stage:
@@ -66,6 +84,13 @@ class MultiNodeChainList:
     # -- graph construction -------------------------------------------
     def add_link(self, module, rank_in=None, rank_out=None,
                  rank: Optional[int] = None) -> "MultiNodeChainList":
+        if isinstance(module, PlacedModule):
+            # routing declared at construction (reference-shaped factory);
+            # explicit add_link arguments override it
+            rank_in = rank_in if rank_in is not None else module.rank_in
+            rank_out = rank_out if rank_out is not None else module.rank_out
+            rank = rank if rank is not None else module.rank
+            module = module.module
         st = _Stage(module, rank_in, rank_out, len(self._stages))
         st.rank = rank if rank is not None else (
             len(self._stages) % self._comm.size

@@ -54,13 +54,56 @@ def create_mnbn_model(model: nn.Module, comm, **bn_kwargs) -> nn.Module:
             axis_name=comm.axis_names,
         )
     # Reference parity: create_mnbn_model recursively copies a chain,
-    # replacing BatchNormalization children — a chain with none comes back
-    # unchanged.  Models without the `norm` factory field are treated as
-    # BN-free; warn in case the caller expected a conversion.
+    # replacing BatchNormalization children.  flax submodules declared in
+    # setup/__call__ are invisible from outside, but dataclass *fields*
+    # holding modules are inspectable — if any field subtree contains an
+    # nn.BatchNorm, conversion is needed yet impossible, so refuse rather
+    # than silently keep unsynchronized BN.
+    bn = _find_batchnorm_field(model)
+    if bn is not None:
+        raise TypeError(
+            f"create_mnbn_model: {type(model).__name__} holds a "
+            f"{type(bn).__name__} submodule but exposes no `norm` factory "
+            "field, so it cannot be converted to synchronized BN.  Adopt "
+            "the chainermn_tpu.models convention: accept a `norm` factory "
+            "(norm(size) -> Module) and construct normalization through it."
+        )
     warnings.warn(
         f"create_mnbn_model: {type(model).__name__} exposes no `norm` "
         "factory field (chainermn_tpu.models convention); returning it "
-        "unchanged (BN-free models need no sync-BN)",
+        "unchanged.  No BatchNorm was found among its dataclass fields, "
+        "but submodules constructed inside setup()/__call__() cannot be "
+        "inspected — if the model creates BatchNorm internally it will "
+        "remain UNsynchronized.",
         stacklevel=2,
     )
     return model
+
+
+def _find_batchnorm_field(model: nn.Module, _depth: int = 0):
+    """Best-effort scan of dataclass fields for a BatchNorm descendant."""
+    if _depth > 8:
+        return None
+    for f in dataclasses.fields(model):
+        try:
+            v = getattr(model, f.name, None)
+        except Exception:
+            continue
+        for sub in _iter_modules(v):
+            if isinstance(sub, nn.BatchNorm):
+                return sub
+            found = _find_batchnorm_field(sub, _depth + 1)
+            if found is not None:
+                return found
+    return None
+
+
+def _iter_modules(v):
+    if isinstance(v, nn.Module):
+        yield v
+    elif isinstance(v, (list, tuple)):
+        for x in v:
+            yield from _iter_modules(x)
+    elif isinstance(v, dict):
+        for x in v.values():
+            yield from _iter_modules(x)

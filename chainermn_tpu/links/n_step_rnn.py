@@ -103,11 +103,22 @@ class MultiNodeNStepRNN(nn.Module):
 def create_multi_node_n_step_rnn(hidden_size: int, num_layers: int = 1,
                                  comm=None, rank_in: Optional[int] = None,
                                  rank_out: Optional[int] = None,
-                                 dtype=jnp.float32) -> MultiNodeNStepRNN:
-    """Factory mirroring the reference signature.  ``rank_in``/``rank_out``
-    take effect when the module is registered in a
-    :class:`~chainermn_tpu.link.MultiNodeChainList`, which owns the
-    activation routing; they are accepted here for API familiarity."""
-    del comm, rank_in, rank_out
-    return MultiNodeNStepRNN(hidden_size=hidden_size, num_layers=num_layers,
-                             dtype=dtype)
+                                 dtype=jnp.float32):
+    """Factory mirroring the reference signature
+    (``create_multi_node_n_step_rnn(link, comm, rank_in, rank_out)``).
+
+    When ``rank_in``/``rank_out`` are given, the result is a
+    :class:`~chainermn_tpu.link.PlacedModule` carrying that routing —
+    registering it with ``MultiNodeChainList.add_link(placed)`` applies
+    the declared edges (hidden states stream from ``rank_in``'s stage and
+    toward ``rank_out``'s), so the arguments genuinely take effect.
+    With neither given, returns the bare module.
+    """
+    del comm  # routing needs no communicator handle; kept for parity
+    rnn = MultiNodeNStepRNN(hidden_size=hidden_size, num_layers=num_layers,
+                            dtype=dtype)
+    if rank_in is None and rank_out is None:
+        return rnn
+    from ..link import PlacedModule
+
+    return PlacedModule(rnn, rank_in=rank_in, rank_out=rank_out)

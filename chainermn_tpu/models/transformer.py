@@ -124,10 +124,24 @@ class TransformerLM(nn.Module):
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_len, self.d_model), jnp.float32,
         )
+        # dynamic_slice clamps out-of-range starts, which would silently
+        # reuse positional rows — guard statically instead (shapes and axis
+        # sizes are static under jit).
         offset = 0
         if self.seq_axis is not None:
+            n_shards = lax.axis_size(self.seq_axis)
+            if n_shards * s > self.max_len:
+                raise ValueError(
+                    f"global sequence length {n_shards}*{s} exceeds "
+                    f"max_len={self.max_len}; raise max_len"
+                )
             # Global positions: shard r holds [r*s, (r+1)*s).
             offset = lax.axis_index(self.seq_axis) * s
+        elif s > self.max_len:
+            raise ValueError(
+                f"sequence length {s} exceeds max_len={self.max_len}; "
+                "raise max_len"
+            )
         pos = lax.dynamic_slice_in_dim(pos_table, offset, s, axis=0)
 
         x = (embed(tokens) + pos[None]).astype(self.dtype)

@@ -29,17 +29,29 @@ _LIB_ERR: Optional[str] = None
 
 
 def _source_path() -> str:
+    # csrc/ ships inside the package (see pyproject [tool.setuptools
+    # .package-data]) so installed trees can build the loader too.
     return os.path.join(
-        os.path.dirname(os.path.dirname(os.path.dirname(
-            os.path.abspath(__file__)
-        ))),
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
         "csrc", "loader.cpp",
     )
 
 
 def _build_dir() -> str:
     d = os.path.join(os.path.dirname(_source_path()), "_build")
-    os.makedirs(d, exist_ok=True)
+    try:
+        os.makedirs(d, exist_ok=True)
+        if not os.access(d, os.W_OK):
+            raise OSError
+    except OSError:
+        # Installed into a read-only site-packages: build in a user cache.
+        d = os.path.join(
+            os.environ.get(
+                "XDG_CACHE_HOME", os.path.expanduser("~/.cache")
+            ),
+            "chainermn_tpu",
+        )
+        os.makedirs(d, exist_ok=True)
     return d
 
 
@@ -52,14 +64,30 @@ def _load_library() -> ctypes.CDLL:
         if _LIB_ERR is not None:
             raise RuntimeError(_LIB_ERR)
         src = _source_path()
-        so = os.path.join(_build_dir(), "libcmn_loader.so")
+        # Key the artifact on the source CONTENT, not mtime: packaging can
+        # normalize timestamps, and a stale .so with an older ABI would
+        # fail symbol resolution below.  A new source hash -> new filename.
+        import hashlib
+
+        with open(src, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:12]
+        build = _build_dir()
+        so = os.path.join(build, f"libcmn_loader_{tag}.so")
         try:
-            if (not os.path.exists(so)
-                    or os.path.getmtime(so) < os.path.getmtime(src)):
+            if not os.path.exists(so):
                 cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
                        "-pthread", src, "-o", so]
                 subprocess.run(cmd, check=True, capture_output=True,
                                text=True)
+                # drop artifacts of older source revisions
+                for stale in os.listdir(build):
+                    if (stale.startswith("libcmn_loader")
+                            and stale.endswith(".so")
+                            and stale != os.path.basename(so)):
+                        try:
+                            os.unlink(os.path.join(build, stale))
+                        except OSError:
+                            pass
             lib = ctypes.CDLL(so)
         except (OSError, subprocess.CalledProcessError) as e:
             detail = getattr(e, "stderr", "") or str(e)
@@ -81,6 +109,8 @@ def _load_library() -> ctypes.CDLL:
             ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
         ]
         lib.cmn_loader_release.argtypes = [ctypes.c_void_p, ctypes.c_int]
+        lib.cmn_loader_seek.restype = ctypes.c_int
+        lib.cmn_loader_seek.argtypes = [ctypes.c_void_p, ctypes.c_longlong]
         for f in ("cmn_loader_epoch", "cmn_loader_iteration",
                   "cmn_loader_batches_per_epoch"):
             getattr(lib, f).restype = ctypes.c_longlong
@@ -209,19 +239,16 @@ class NativeImageLoader:
         }
 
     def restore(self, state):
-        """Reposition at ``state['iteration']``.  Determinism in (seed,
-        ticket) means replaying from 0 reproduces the exact stream, so
-        rewinding recreates the native loader and fast-forwards."""
+        """Reposition at ``state['iteration']`` via the native seek.
+
+        Determinism is keyed on (seed, ticket), so seeking re-aims the
+        worker tickets directly — O(1) in the target iteration (no
+        producing/discarding of skipped batches), works forwards and
+        backwards.
+        """
         target = int(state["iteration"])
-        current = int(self._lib.cmn_loader_iteration(self._handle))
-        if target < current:
-            self._lib.cmn_loader_destroy(self._handle)
-            self._handle = None
-            self._create()
-            current = 0
-        for _ in range(target - current):
-            slot, _, _ = self.acquire()
-            self.release(slot)
+        if self._lib.cmn_loader_seek(self._handle, target) != 0:
+            raise ValueError(f"cmn_loader_seek({target}) failed")
 
     def close(self) -> None:
         if getattr(self, "_handle", None):

@@ -27,9 +27,12 @@ import argparse
 import os
 import sys
 
-sys.path.insert(
-    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
-)
+try:  # installed package (pip install -e .)
+    import chainermn_tpu  # noqa: F401
+except ImportError:  # source checkout without installation
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    )
 
 import numpy as np
 
@@ -162,11 +165,21 @@ def main(argv=None):
     train = cmn.scatter_dataset(train, comm, shuffle=True, seed=0)
     val = cmn.scatter_dataset(val, comm, shuffle=False, seed=0)
 
-    batch_per_process = max(
-        args.batchsize // comm.process_count // comm.size * comm.size,
-        comm.size,
-    )
+    # Per-process batch must be a multiple of the *local* shard count (the
+    # chips this process feeds), floored at one row per local chip.
     local_shards = max(comm.size // comm.process_count, 1)
+    batch_per_process = max(
+        args.batchsize // comm.process_count // local_shards * local_shards,
+        local_shards,
+    )
+    effective_global = batch_per_process * comm.process_count
+    if effective_global != args.batchsize and comm.process_index == 0:
+        print(
+            f"note: global batch adjusted {args.batchsize} -> "
+            f"{effective_global} ({batch_per_process}/process x "
+            f"{comm.process_count} processes, multiple of "
+            f"{local_shards} local chips)"
+        )
     if args.native_loader:
         from chainermn_tpu.utils.native_loader import NativeImageLoader
 

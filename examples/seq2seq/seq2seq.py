@@ -18,9 +18,12 @@ import argparse
 import os
 import sys
 
-sys.path.insert(
-    0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
-)
+try:  # installed package (pip install -e .)
+    import chainermn_tpu  # noqa: F401
+except ImportError:  # source checkout without installation
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), "..", ".."))
+    )
 
 import jax
 import jax.numpy as jnp

@@ -148,6 +148,24 @@ class TestObjTransport:
         comm.send_obj({"payload": 42}, dest=0, tag=9)
         assert comm.recv_obj(source=1, tag=9) == {"payload": 42}
 
+    def test_send_recv_obj_nonzero_dest(self, comm):
+        # Regression: LocalObjStore.recv used to drain rank 0's mailbox
+        # regardless of destination, making dest != 0 unreceivable.
+        comm.send_obj("for-three", dest=3, tag=4)
+        comm.send_obj("for-zero", dest=0, tag=4)
+        assert comm.recv_obj(source=0, tag=4, dest=3) == "for-three"
+        assert comm.recv_obj(source=0, tag=4, dest=0) == "for-zero"
+
+    def test_recv_obj_wrong_dest_raises(self, comm):
+        comm.send_obj("x", dest=5, tag=11)
+        with pytest.raises(RuntimeError):
+            comm.recv_obj(source=0, tag=11, dest=2)
+        assert comm.recv_obj(source=0, tag=11, dest=5) == "x"
+
+    def test_recv_obj_dest_out_of_range(self, comm):
+        with pytest.raises(ValueError):
+            comm.recv_obj(source=0, tag=0, dest=comm.size)
+
 
 class TestModelLevel:
     def test_bcast_data_replicates(self, comm):
@@ -183,6 +201,54 @@ class TestDummy:
         comm = create_communicator("dummy", devices=devices8)
         x = jnp.arange(8.0).reshape(8, 1)
         np.testing.assert_allclose(np.asarray(comm.allreduce(x)), np.asarray(x))
+
+
+class TestNonCudaAwareContract:
+    def test_every_collective_stages_through_host(self, devices8,
+                                                  monkeypatch):
+        """The variant's contract: NO XLA collective program in the data
+        path — every op is device_get -> NumPy -> device_put.  Building a
+        shard_map program here would mean an op silently inherited the
+        XLA path (the round-1 bug: only allreduce was host-staged)."""
+        from chainermn_tpu.communicators.variants import (
+            NonCudaAwareCommunicator,
+        )
+
+        comm = create_communicator("non_cuda_aware", devices=devices8)
+
+        def boom(self, *a, **kw):
+            raise AssertionError(
+                "host-staged variant built an XLA collective program"
+            )
+
+        monkeypatch.setattr(NonCudaAwareCommunicator, "_shard", boom)
+        x = _stack(comm, shape=(4,))
+        h = np.asarray(x)
+        np.testing.assert_allclose(
+            np.asarray(comm.allreduce(x))[0], h.sum(0), rtol=1e-5
+        )
+        np.testing.assert_allclose(
+            np.asarray(comm.bcast(x, root=5))[2], h[5], rtol=1e-6
+        )
+        np.testing.assert_allclose(np.asarray(comm.allgather(x)), h)
+        np.testing.assert_allclose(np.asarray(comm.gather(x, root=1)), h)
+        np.testing.assert_allclose(np.asarray(comm.scatter(x)), h)
+        a2a = _stack(comm, shape=(comm.size, 2), seed=4)
+        np.testing.assert_allclose(
+            np.asarray(comm.alltoall(a2a)),
+            np.swapaxes(np.asarray(a2a), 0, 1),
+        )
+        sent = np.asarray(comm.send(x, dest=3, source=6))
+        np.testing.assert_allclose(sent[3], h[6])
+        rs = _stack(comm, shape=(comm.size * 2,), seed=5)
+        out = np.asarray(comm.reduce_scatter(rs))
+        np.testing.assert_allclose(
+            out.reshape(-1), np.asarray(rs).sum(0), rtol=1e-5
+        )
+        grads = comm.allreduce_grad({"g": x})
+        np.testing.assert_allclose(
+            np.asarray(grads["g"])[0], h.mean(0), rtol=1e-5
+        )
 
 
 class TestSingleNodeAssert:

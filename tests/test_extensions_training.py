@@ -133,6 +133,48 @@ class TestCheckpointer:
         )
         assert ckpt.resume() == (None, None)
 
+    def test_npz_fallback_roundtrips_tree_structure(self, comm, tmp_path,
+                                                    monkeypatch):
+        # Force the degraded (orbax-less) backend and verify resume()
+        # returns the original nested structure — the restore_trainer
+        # contract — not a flattened dict.
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t4", comm, path=str(tmp_path)
+        )
+
+        class BrokenOrbax:
+            def save(self, *a, **kw):
+                raise OSError("orbax unavailable")
+
+        monkeypatch.setattr(ckpt, "_orbax", lambda: BrokenOrbax())
+        state = {
+            "params": {"w": jnp.arange(4.0), "b": jnp.ones((2,))},
+            "opt_state": (jnp.zeros((3,)), {"count": jnp.asarray(5)}),
+            "trainer": {"iteration": 7, "epoch": 1},
+        }
+        ckpt.save(7, state)
+        step, restored = ckpt.resume(like=state)
+        assert step == 7
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), np.arange(4.0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(restored["opt_state"][0]), np.zeros((3,))
+        )
+        assert int(restored["opt_state"][1]["count"]) == 5
+        assert int(restored["trainer"]["iteration"]) == 7
+
+    def test_npz_fallback_explicit(self, comm, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t5", comm, path=str(tmp_path), use_orbax=False
+        )
+        state = {"params": {"w": jnp.full((2, 2), 3.0)}, "meta": [1, 2]}
+        ckpt.save(1, state)
+        step, restored = ckpt.resume()
+        assert step == 1
+        np.testing.assert_allclose(np.asarray(restored["params"]["w"]), 3.0)
+        assert list(restored["meta"]) == [1, 2]
+
 
 class TestAllreducePersistent:
     def test_single_controller_identity(self, comm):
@@ -140,6 +182,18 @@ class TestAllreducePersistent:
         stats = {"mean": jnp.arange(3.0)}
         out = arp.reduce(stats)
         np.testing.assert_allclose(np.asarray(out["mean"]), np.arange(3.0))
+
+    def test_stacked_per_rank_stats_averaged_in_mesh(self, comm):
+        # Eager tier: BN running stats are stacked per-rank; reduce must
+        # make every rank's slice the mean over ranks (the reference's
+        # allreduce of persistent arrays), via the XLA allreduce.
+        arp = AllreducePersistent(comm, stacked=True)
+        per_rank = jnp.stack(
+            [jnp.full((3,), float(r)) for r in range(comm.size)]
+        )
+        out = arp.reduce({"running_mean": per_rank})["running_mean"]
+        want = np.full((comm.size, 3), np.mean(np.arange(comm.size)))
+        np.testing.assert_allclose(np.asarray(out), want, rtol=1e-6)
 
 
 class TestGlobalExceptHook:

@@ -114,6 +114,33 @@ class TestMnbnFactory:
         m = mn.norm(8)
         assert isinstance(m, MultiNodeBatchNormalization)
 
+    def test_foreign_model_with_batchnorm_field_rejected(self, comm):
+        import flax.linen as nn
+
+        class Foreign(nn.Module):
+            bn: nn.Module = None
+
+            @nn.compact
+            def __call__(self, x):
+                return self.bn(x)
+
+        model = Foreign(bn=nn.BatchNorm(use_running_average=False))
+        with pytest.raises(TypeError, match="cannot be converted"):
+            create_mnbn_model(model, comm)
+
+    def test_foreign_bn_free_model_warns_and_passes_through(self, comm):
+        import flax.linen as nn
+
+        class Plain(nn.Module):
+            @nn.compact
+            def __call__(self, x):
+                return nn.Dense(4)(x)
+
+        model = Plain()
+        with pytest.warns(UserWarning, match="UNsynchronized"):
+            out = create_mnbn_model(model, comm)
+        assert out is model
+
 
 class TestNStepRNN:
     def test_forward_shapes_and_state_handoff(self):
@@ -134,3 +161,26 @@ class TestNStepRNN:
         _, ys = rnn.apply(v, x)
         # outputs at different timesteps must differ (state evolves)
         assert not np.allclose(np.asarray(ys[:, 0]), np.asarray(ys[:, -1]))
+
+    def test_factory_routing_takes_effect(self, comm):
+        # Regression: rank_in/rank_out used to be `del`-ed decoration.
+        from chainermn_tpu.link import MultiNodeChainList, PlacedModule
+
+        placed = create_multi_node_n_step_rnn(
+            hidden_size=4, comm=comm, rank_in=0, rank_out=None
+        )
+        assert isinstance(placed, PlacedModule)
+        assert placed.rank_in == 0 and placed.rank_out is None
+
+        chain = MultiNodeChainList(comm)
+        chain.add_link(
+            create_multi_node_n_step_rnn(
+                hidden_size=4, comm=comm, rank_in=None, rank_out=1
+            ),
+        )
+        chain.add_link(placed)
+        assert chain._stages[0].rank_out == 1
+        assert chain._stages[1].rank_in == 0
+        # bare-module behavior unchanged when no routing is declared
+        bare = create_multi_node_n_step_rnn(hidden_size=4)
+        assert not isinstance(bare, PlacedModule)

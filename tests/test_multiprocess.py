@@ -1,0 +1,157 @@
+"""True multi-process distributed tests.
+
+Parity: the reference's execution model ``mpiexec -n 2 pytest tests/``
+(SURVEY.md section 4) — no mocks, a real distributed runtime.  Here each
+test spawns N fresh Python processes that rendezvous through
+``jax.distributed.initialize`` on a local coordinator, with virtual CPU
+devices standing in for per-host chips; scenarios live in
+``tests/mp_worker.py``.
+
+These are the only tests that execute the multi-host-only code paths:
+``MultiprocessObjStore`` (KV-store send/recv, host-collective bcast/
+gather), ``broadcast_one_to_all`` in ``bcast_data``, the
+``make_array_from_process_local_data`` branch of ``_place_batch``,
+checkpoint save/agree/resume across processes, ``barrier``, and the
+global except hook's distributed shutdown.
+
+Run just these:   pytest -m multiprocess tests/
+Skip them:        pytest -m "not multiprocess" tests/
+"""
+
+import json
+import os
+import socket
+import subprocess
+import sys
+
+import pytest
+
+pytestmark = pytest.mark.multiprocess
+
+_WORKER = os.path.join(os.path.dirname(os.path.abspath(__file__)), "mp_worker.py")
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def run_world(scenario, n_procs=2, local_devices=1, tmpdir="/tmp",
+              timeout=240, extra_env=None):
+    """Spawn ``n_procs`` workers; return list of (returncode, stdout)."""
+    port = _free_port()
+    env = dict(os.environ)
+    # the ambient env may point JAX at the (single-claim) TPU tunnel;
+    # workers must build their own CPU world
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = (
+        f"--xla_force_host_platform_device_count={local_devices}"
+    )
+    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.update(extra_env or {})
+    procs = [
+        subprocess.Popen(
+            [sys.executable, _WORKER, scenario, str(port), str(i),
+             str(n_procs), str(tmpdir)],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True,
+        )
+        for i in range(n_procs)
+    ]
+    results = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=timeout)
+            results.append((p.returncode, out))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    return results
+
+
+def _assert_ok(results, scenario):
+    payloads = []
+    for i, (rc, out) in enumerate(results):
+        assert rc == 0, (
+            f"{scenario}: process {i} exited {rc}\n--- output ---\n{out[-4000:]}"
+        )
+        line = [l for l in out.splitlines() if l.startswith("RESULT ")]
+        assert line, f"{scenario}: process {i} printed no RESULT\n{out[-2000:]}"
+        payloads.append(json.loads(line[-1][len("RESULT "):]))
+    return payloads
+
+
+class TestObjTransport:
+    def test_two_processes(self, tmp_path):
+        res = run_world("obj_transport", n_procs=2, tmpdir=tmp_path)
+        payloads = _assert_ok(res, "obj_transport")
+        assert all(p["size"] == 2 for p in payloads)
+
+    def test_four_processes(self, tmp_path):
+        res = run_world("obj_transport", n_procs=4, tmpdir=tmp_path)
+        payloads = _assert_ok(res, "obj_transport")
+        assert all(p["size"] == 4 for p in payloads)
+
+
+class TestBcastData:
+    def test_bit_identity_across_processes(self, tmp_path):
+        res = run_world("bcast_data", n_procs=2, local_devices=2,
+                        tmpdir=tmp_path)
+        _assert_ok(res, "bcast_data")
+
+
+class TestTrainStep:
+    def test_per_process_batch_placement_and_sync(self, tmp_path):
+        # 2 processes x 2 local devices = 4-chip world
+        res = run_world("train_step", n_procs=2, local_devices=2,
+                        tmpdir=tmp_path)
+        payloads = _assert_ok(res, "train_step")
+        # both controllers hold the same replicated params
+        assert payloads[0]["final_w"] == pytest.approx(
+            payloads[1]["final_w"]
+        )
+
+
+class TestCheckpoint:
+    def test_save_agree_resume(self, tmp_path):
+        res = run_world("checkpoint", n_procs=2, local_devices=2,
+                        tmpdir=tmp_path)
+        payloads = _assert_ok(res, "checkpoint")
+        assert all(p["resumed_step"] == 7 for p in payloads)
+
+
+class TestAllreducePersistent:
+    def test_cross_process_mean(self, tmp_path):
+        res = run_world("allreduce_persistent", n_procs=2, tmpdir=tmp_path)
+        _assert_ok(res, "allreduce_persistent")
+
+
+class TestBarrier:
+    def test_barrier_rendezvous(self, tmp_path):
+        res = run_world("barrier", n_procs=2, tmpdir=tmp_path)
+        payloads = _assert_ok(res, "barrier")
+        assert payloads[0]["waited"] >= 1.0
+
+
+class TestExceptHook:
+    def test_crash_contained_not_hung(self, tmp_path):
+        # process 1 raises; its hook shuts the distributed client down;
+        # process 0 (blocked in recv_obj with a 15s bound) must ALSO die
+        # promptly instead of hanging for the full 10-minute default.
+        res = run_world(
+            "except_hook", n_procs=2, tmpdir=tmp_path, timeout=120,
+            extra_env={"CHAINERMN_TPU_OBJ_TIMEOUT_MS": "15000"},
+        )
+        rc0, out0 = res[0]
+        rc1, out1 = res[1]
+        assert rc1 != 0, f"raising process exited 0\n{out1[-2000:]}"
+        assert "injected failure" in out1
+        assert "aborting the distributed job" in out1
+        assert rc0 != 0, (
+            f"peer process survived a dead-peer recv\n{out0[-2000:]}"
+        )

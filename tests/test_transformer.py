@@ -52,6 +52,30 @@ class TestForward:
         assert logits.shape == (2, 64, VOCAB)
         assert logits.dtype == jnp.float32
 
+    def test_sequence_longer_than_max_len_rejected(self):
+        model, _ = _models()
+        toks = _tokens(b=1, s=MAXLEN + 8)
+        with pytest.raises(ValueError, match="exceeds max_len"):
+            model.init(jax.random.PRNGKey(0), toks)
+
+    def test_sp_global_sequence_longer_than_max_len_rejected(self, mesh8):
+        # 8 shards x 32 = 256 > MAXLEN=128: each shard's slice is in range
+        # but the *global* sequence is not — must raise, not clamp.
+        _, sp = _models()
+        toks = _tokens(b=1, s=8 * 32)
+        params = None
+
+        def fwd(t):
+            return sp.init(jax.random.PRNGKey(0), t)
+
+        with pytest.raises(ValueError, match="exceeds"):
+            jax.jit(
+                jax.shard_map(
+                    fwd, mesh=mesh8, in_specs=P(None, "mn"),
+                    out_specs=P(), check_vma=False,
+                )
+            )(toks)
+
     def test_causality(self):
         # Changing a future token must not change past logits.
         model, _ = _models()

@@ -22,6 +22,7 @@ from .variants import (
     DummyCommunicator,
     FlatCommunicator,
     HierarchicalCommunicator,
+    HybridCommunicator,
     NaiveCommunicator,
     NonCudaAwareCommunicator,
     SingleNodeCommunicator,
@@ -41,6 +42,8 @@ _COMMUNICATORS = {
     "naive": NaiveCommunicator,
     "non_cuda_aware": NonCudaAwareCommunicator,
     "dummy": DummyCommunicator,
+    # beyond the reference: 2-D data x model mesh for hybrid DP x TP
+    "hybrid": HybridCommunicator,
 }
 
 
@@ -48,16 +51,18 @@ def create_communicator(
     communicator_name: str = "tpu",
     devices: Optional[Sequence] = None,
     allreduce_grad_dtype=None,
+    **kwargs,
 ) -> CommunicatorBase:
     """Create a communicator by name.
 
     Args:
       communicator_name: one of ``tpu``, ``pure_nccl``, ``flat``,
         ``hierarchical``, ``two_dimensional``, ``single_node``, ``naive``,
-        ``non_cuda_aware``, ``dummy``.
+        ``non_cuda_aware``, ``dummy``, ``hybrid``.
       devices: devices to span (default: all of ``jax.devices()``).
       allreduce_grad_dtype: optional reduced precision (e.g. ``bfloat16`` /
         ``float16``) for gradient allreduce, as in PureNcclCommunicator.
+      **kwargs: variant-specific options (e.g. ``tp_size`` for ``hybrid``).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -66,7 +71,8 @@ def create_communicator(
             f"unknown communicator {communicator_name!r}; available: "
             f"{sorted(_COMMUNICATORS)}"
         ) from None
-    return cls(devices=devices, allreduce_grad_dtype=allreduce_grad_dtype)
+    return cls(devices=devices, allreduce_grad_dtype=allreduce_grad_dtype,
+               **kwargs)
 
 
 __all__ = [
@@ -77,6 +83,7 @@ __all__ = [
     "TpuCommunicator",
     "FlatCommunicator",
     "HierarchicalCommunicator",
+    "HybridCommunicator",
     "TwoDimensionalCommunicator",
     "SingleNodeCommunicator",
     "NaiveCommunicator",

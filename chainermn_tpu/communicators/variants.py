@@ -128,6 +128,80 @@ class TwoDimensionalCommunicator(XlaCommunicatorBase):
         return Mesh(grid, ("mn_x", "mn_y"))
 
 
+class HybridCommunicator(XlaCommunicatorBase):
+    """2-D (data x model) mesh for hybrid DP x TP training.
+
+    Parity: the reference's dual-parallelism story is
+    ``CommunicatorBase.split`` building sub-communicators over a 2-D
+    process grid (SURVEY.md section 2, "Hybrid DP x MP").  TPU-native
+    form: ONE mesh with a ``mn_data`` and a ``mn_model`` axis — the batch
+    shards over ``mn_data``, tensor-parallel layers shard and psum over
+    ``mn_model``, and ``build_train_step(param_specs=...)`` compiles both
+    into a single program (collectives ride ICI on both axes).
+
+    ``tp_size`` sets the model-axis width; ``size`` must divide by it.
+    ``mesh_utils.create_device_mesh`` lays both axes onto physical torus
+    rings where possible.
+    """
+
+    def __init__(self, devices=None, allreduce_grad_dtype=None,
+                 tp_size: int = 2, **kw):
+        self._tp_size = int(tp_size)
+        super().__init__(devices, allreduce_grad_dtype, **kw)
+
+    def _build_mesh(self) -> Mesh:
+        n, tp = self.size, self._tp_size
+        if tp < 1 or n % tp:
+            raise ValueError(
+                f"tp_size {tp} must divide the chip count {n}"
+            )
+        dp = n // tp
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh(
+                (dp, tp), devices=list(self.devices)
+            )
+        except Exception:
+            grid = np.array(self.devices, dtype=object).reshape(dp, tp)
+        return Mesh(grid, ("mn_data", "mn_model"))
+
+    @property
+    def data_axis_names(self) -> tuple:
+        return ("mn_data",)
+
+    @property
+    def model_axis_names(self) -> tuple:
+        return ("mn_model",)
+
+    @property
+    def dp_size(self) -> int:
+        return self.size // self._tp_size
+
+    @property
+    def tp_size(self) -> int:
+        return self._tp_size
+
+    def _mesh_coords(self):
+        """(data, model) mesh coordinate of each rank's device (the mesh
+        layout may permute devices relative to rank order)."""
+        coord = {
+            d: ij for ij, d in np.ndenumerate(self._mesh.devices)
+        }
+        return [coord[d] for d in self.devices]
+
+    def dp_groups(self):
+        """Split into per-TP-coordinate data-parallel sub-communicators —
+        the reference's ``split(color=model_coord)`` pattern.  Group ``m``
+        contains the chips whose model coordinate is ``m`` (a DP group of
+        ``dp_size`` chips)."""
+        return self.split([m for _, m in self._mesh_coords()])
+
+    def tp_groups(self):
+        """Split into per-data-coordinate tensor-parallel groups."""
+        return self.split([d for d, _ in self._mesh_coords()])
+
+
 class NonCudaAwareCommunicator(XlaCommunicatorBase):
     """Host-staged collectives (device -> host -> reduce -> device).
 

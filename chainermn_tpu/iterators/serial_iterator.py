@@ -72,8 +72,6 @@ class SerialIterator:
             self._order = self._new_order()
         else:
             self.is_new_epoch = False
-        if not self._repeat and self.epoch > 0 and self._pos == 0 and self.epoch > 1:
-            raise StopIteration
         idx = self._order[self._pos : self._pos + self.batch_size]
         self._pos += self.batch_size
         return _collate([self.dataset[int(i)] for i in idx])

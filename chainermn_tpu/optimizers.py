@@ -62,15 +62,21 @@ def _axes_bound(axis_names) -> bool:
         return False
 
 
-def _sync_grads(grads, comm, comm_dtype=None):
-    """pmean gradients over the communicator's mesh axes (compiled path)."""
-    axes = comm.axis_names
+def _sync_grads(grads, comm, comm_dtype=None, axes=None):
+    """pmean gradients over mesh axes (compiled path).
+
+    ``axes`` defaults to the communicator's full axis set; hybrid DP x TP
+    steps pass the data axes only.
+    """
+    axes = comm.axis_names if axes is None else tuple(axes)
+    n = 1
+    shape = dict(comm.mesh.shape)
+    for a in axes:
+        n *= shape[a]
 
     def one(g):
         if comm_dtype is not None:
-            return (lax.psum(g.astype(comm_dtype), axes) / comm.size).astype(
-                g.dtype
-            )
+            return (lax.psum(g.astype(comm_dtype), axes) / n).astype(g.dtype)
         return lax.pmean(g, axes)
 
     return jax.tree_util.tree_map(one, grads)
@@ -108,10 +114,16 @@ class _MultiNodeOptimizer:
             inner_state=self._opt.init(params), step=jnp.zeros((), jnp.int32)
         )
 
-    def update(self, grads, state, params=None):
+    def update(self, grads, state, params=None, sync_axes=None):
+        """``sync_axes``: mesh axes to average gradients over.  ``None``
+        means the communicator's full axis set; ``()`` skips the sync
+        (hybrid steps whose autodiff already produced global grads)."""
         comm = self._comm
-        if _axes_bound(comm.axis_names):
-            grads = _sync_grads(grads, comm, comm.allreduce_grad_dtype)
+        axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
+        if axes and _axes_bound(axes):
+            grads = _sync_grads(
+                grads, comm, comm.allreduce_grad_dtype, axes=axes
+            )
         updates, inner = self._opt.update(grads, state.inner_state, params)
         return updates, MultiNodeOptimizerState(inner, state.step + 1)
 
@@ -144,11 +156,14 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
             prev_grads=zeros,
         )
 
-    def update(self, grads, state, params=None):
+    def update(self, grads, state, params=None, sync_axes=None):
         comm = self._comm
         prev = state.prev_grads
-        if _axes_bound(comm.axis_names):
-            prev = _sync_grads(prev, comm, comm.allreduce_grad_dtype)
+        axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
+        if axes and _axes_bound(axes):
+            prev = _sync_grads(
+                prev, comm, comm.allreduce_grad_dtype, axes=axes
+            )
         updates, inner = self._opt.update(prev, state.inner_state, params)
         return updates, DoubleBufferingState(inner, state.step + 1, grads)
 
@@ -293,6 +308,7 @@ def build_train_step(
     optimizer,
     *,
     data_axes: Optional[tuple] = None,
+    param_specs=None,
     donate: bool = True,
     use_shard_map: bool = True,
     has_aux: bool = False,
@@ -324,6 +340,25 @@ def build_train_step(
     reduced aux is folded back into the returned params *after* the
     optimizer update (so optimizer updates to non-trainable state are
     overwritten, never accumulated).
+
+    Hybrid DP x TP (``param_specs``): on a 2-D mesh (e.g.
+    ``HybridCommunicator``'s ``('mn_data', 'mn_model')``), pass
+    ``data_axes=comm.data_axis_names`` and a ``param_specs`` pytree (or
+    ``fn(params) -> pytree``) of PartitionSpecs declaring each parameter's
+    layout — tensor-parallel kernels sharded over the model axis,
+    everything else ``P()``.  The step then runs under vma-checked
+    ``shard_map``: autodiff itself inserts every needed collective (psum
+    of replicated-param cotangents over the model axis, data-axis
+    reduction through the in-loss ``pmean``), so gradients are globally
+    correct for sharded AND replicated parameters with no manual sync —
+    the Megatron recipe as generated code.  Optimizer state follows the
+    parameter layout automatically (Adam moments of a TP kernel are
+    sharded like the kernel).  ``loss_fn`` may use the model axis freely
+    (e.g. ColumnParallelDense/RowParallelDense); its returned loss must
+    be model-axis-invariant (end TP blocks with their row-parallel psum).
+    Not combinable with ``zero_redundancy`` optimizers or
+    ``allreduce_grad_dtype`` wire compression (sync happens inside
+    autodiff at full precision).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -334,27 +369,109 @@ def build_train_step(
     batch_sharding = NamedSharding(mesh, batch_spec)
 
     is_mn = isinstance(optimizer, _MultiNodeOptimizer)
+    hybrid = param_specs is not None
+    if hybrid and isinstance(optimizer, _ZeroRedundancyOptimizer):
+        raise ValueError(
+            "param_specs (hybrid DP x TP) cannot be combined with a "
+            "zero_redundancy optimizer: ZeRO blocks shard over the full "
+            "communicator, which would mix tensor-parallel kernel blocks"
+        )
+    if hybrid and getattr(comm, "allreduce_grad_dtype", None) is not None:
+        raise ValueError(
+            "param_specs (hybrid DP x TP) cannot honor "
+            "allreduce_grad_dtype: gradient reduction happens inside "
+            "vma-checked autodiff at full precision; create the hybrid "
+            "communicator without a wire dtype"
+        )
+
+    def _param_spec_tree(params):
+        return param_specs(params) if callable(param_specs) else param_specs
 
     # ZeRO-style optimizers declare per-leaf state sharding; the concrete
     # spec tree depends on the state's structure, so the program is built
     # lazily at first call and cached by state treedef.
     state_spec_fn = getattr(optimizer, "state_partition_spec", None)
 
-    def _state_specs(opt_state):
+    def _state_specs(opt_state, params=None):
+        if hybrid:
+            # optimizer state mirrors the parameter layout: every
+            # param-shaped leaf (Adam moments etc.) inherits its
+            # parameter's spec, the rest (counts) replicate
+            pspecs = _param_spec_tree(params)
+            return optax.tree_map_params(
+                optimizer,
+                lambda _leaf, spec: spec,
+                opt_state,
+                pspecs,
+                transform_non_params=lambda _leaf: P(),
+            )
         if state_spec_fn is None:
             return P()
         return state_spec_fn(opt_state)
 
-    def _state_shardings(opt_state):
-        if state_spec_fn is None:
-            return rep
+    def _spec_to_sharding(specs):
+        if isinstance(specs, P):
+            return NamedSharding(mesh, specs)
         return jax.tree_util.tree_map(
             lambda s: NamedSharding(mesh, s),
-            state_spec_fn(opt_state),
+            specs,
             is_leaf=lambda x: isinstance(x, P),
         )
 
-    if use_shard_map:
+    def _state_shardings(opt_state, params=None):
+        if not hybrid and state_spec_fn is None:
+            return rep
+        return _spec_to_sharding(_state_specs(opt_state, params))
+
+    if use_shard_map and hybrid:
+        def _step(params, opt_state, batch):
+            # Differentiate the GLOBAL loss (pmean over the data axes is
+            # part of the objective); vma-checked shard_map autodiff then
+            # emits every collective the mixed replicated/sharded layout
+            # needs — no manual gradient sync anywhere.
+            def global_loss(p, b):
+                out = loss_fn(p, b)
+                if has_aux:
+                    l, aux = out
+                    return lax.pmean(l, axes), aux
+                return lax.pmean(out, axes)
+
+            loss, grads = jax.value_and_grad(
+                global_loss, has_aux=has_aux
+            )(params, batch)
+            aux = None
+            if has_aux:
+                loss, aux = loss
+                aux = jax.tree_util.tree_map(
+                    lambda a: lax.pmean(a, axes)
+                    if jnp.issubdtype(jnp.asarray(a).dtype, jnp.inexact)
+                    else a,
+                    aux,
+                )
+            if is_mn:
+                updates, opt_state = optimizer.update(
+                    grads, opt_state, params, sync_axes=()
+                )
+            else:
+                updates, opt_state = optimizer.update(
+                    grads, opt_state, params
+                )
+            params = optax.apply_updates(params, updates)
+            if aux is not None and merge_aux is not None:
+                params = merge_aux(params, aux)
+            return params, opt_state, {"loss": loss}
+
+        def _build(state_specs, pspecs):
+            sharded = jax.shard_map(
+                _step,
+                mesh=mesh,
+                in_specs=(pspecs, state_specs, batch_spec),
+                out_specs=(pspecs, state_specs, P()),
+                # vma checking ON: it is what makes the autodiff insert
+                # the replication-correct psums
+            )
+            return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+    elif use_shard_map:
         def _step(params, opt_state, batch):
             loss, grads = jax.value_and_grad(loss_fn, has_aux=has_aux)(
                 params, batch
@@ -379,7 +496,8 @@ def build_train_step(
             loss = lax.pmean(loss, axes)
             return params, opt_state, {"loss": loss}
 
-        def _build(state_specs):
+        def _build(state_specs, pspecs=None):
+            del pspecs
             sharded = jax.shard_map(
                 _step,
                 mesh=mesh,
@@ -402,12 +520,13 @@ def build_train_step(
                 params = merge_aux(params, aux)
             return params, opt_state, {"loss": loss}
 
-        def _build(state_shardings):
+        def _build(state_shardings, pshardings=None):
+            pshardings = rep if pshardings is None else pshardings
             return jax.jit(
                 _step,
                 donate_argnums=(0, 1) if donate else (),
-                in_shardings=(rep, state_shardings, batch_sharding),
-                out_shardings=(rep, state_shardings, rep),
+                in_shardings=(pshardings, state_shardings, batch_sharding),
+                out_shardings=(pshardings, state_shardings, rep),
             )
 
     n_shards = 1
@@ -455,28 +574,42 @@ def build_train_step(
 
     compiled: dict = {}
 
-    def _get_step(opt_state):
-        key = jax.tree_util.tree_structure(opt_state)
+    def _get_step(params, opt_state):
+        key = (
+            jax.tree_util.tree_structure(params),
+            jax.tree_util.tree_structure(opt_state),
+        )
         if key not in compiled:
-            arg = (
-                _state_specs(opt_state)
-                if use_shard_map
-                else _state_shardings(opt_state)
-            )
-            compiled[key] = _build(arg)
+            if use_shard_map:
+                state_arg = _state_specs(opt_state, params)
+                param_arg = _param_spec_tree(params) if hybrid else None
+            else:
+                state_arg = _state_shardings(opt_state, params)
+                param_arg = (
+                    _spec_to_sharding(_param_spec_tree(params))
+                    if hybrid
+                    else None
+                )
+            compiled[key] = _build(state_arg, param_arg)
         return compiled[key]
 
     def checked_step(params, opt_state, batch):
         if not _is_placed(batch):
             batch = _place_batch(batch)
-        return _get_step(opt_state)(params, opt_state, batch)
+        return _get_step(params, opt_state)(params, opt_state, batch)
 
     def place(params, opt_state=None, batch=None):
-        """Device-put helper: replicate params, lay out optimizer state per
-        its partition spec (sharded for ZeRO), shard a batch."""
-        out = [jax.device_put(params, rep)]
+        """Device-put helper: lay out params per their partition specs
+        (replicated unless hybrid), optimizer state per its spec (sharded
+        for ZeRO / hybrid), shard a batch."""
+        pshard = (
+            _spec_to_sharding(_param_spec_tree(params)) if hybrid else rep
+        )
+        out = [jax.device_put(params, pshard)]
         if opt_state is not None:
-            out.append(jax.device_put(opt_state, _state_shardings(opt_state)))
+            out.append(
+                jax.device_put(opt_state, _state_shardings(opt_state, params))
+            )
         if batch is not None:
             out.append(_place_batch(batch))
         return out[0] if len(out) == 1 else tuple(out)

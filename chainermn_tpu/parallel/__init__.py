@@ -1,7 +1,11 @@
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
 from .pipeline import gpipe  # noqa: F401
-from .tensor_parallel import ColumnParallelDense, RowParallelDense  # noqa: F401
+from .tensor_parallel import (  # noqa: F401
+    ColumnParallelDense,
+    RowParallelDense,
+    megatron_param_specs,
+)
 from .expert_parallel import (  # noqa: F401
     expert_parallel_moe,
     mlp_experts,
@@ -16,6 +20,7 @@ __all__ = [
     "gpipe",
     "ColumnParallelDense",
     "RowParallelDense",
+    "megatron_param_specs",
     "expert_parallel_moe",
     "mlp_experts",
     "top_k_routing",

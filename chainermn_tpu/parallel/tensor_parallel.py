@@ -96,6 +96,43 @@ class RowParallelDense(nn.Module):
         return y
 
 
+def megatron_param_specs(params, model_axis: str = "tp"):
+    """Derive the ``param_specs`` pytree for ``build_train_step``'s hybrid
+    DP x TP mode from a parameter tree containing Column/RowParallelDense
+    modules (recognized by their auto-generated flax path names).
+
+    Column kernels shard their output features (``P(None, axis)``, bias
+    ``P(axis)``); Row kernels shard their input features
+    (``P(axis, None)``, bias replicated); everything else replicates.
+    For custom-named modules, build the spec tree by hand — it is plain
+    data.
+    """
+    from jax.sharding import PartitionSpec as P
+    import jax.tree_util as jtu
+
+    def leaf_spec(path, leaf):
+        keys = [
+            getattr(k, "key", getattr(k, "name", None)) for k in path
+        ]
+        keys = [k for k in keys if isinstance(k, str)]
+        owner = next(
+            (
+                k
+                for k in reversed(keys)
+                if "ColumnParallel" in k or "RowParallel" in k
+            ),
+            None,
+        )
+        last = keys[-1] if keys else ""
+        if owner and "ColumnParallel" in owner:
+            return P(None, model_axis) if last == "kernel" else P(model_axis)
+        if owner and "RowParallel" in owner:
+            return P(model_axis, None) if last == "kernel" else P()
+        return P()
+
+    return jtu.tree_map_with_path(leaf_spec, params)
+
+
 def _sharded_init(init: Callable, axis_name: str) -> Callable:
     """Make an initializer draw a different block per chip (fold the axis
     index into the key) while staying deterministic per chip."""

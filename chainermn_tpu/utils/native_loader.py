@@ -75,10 +75,17 @@ def _load_library() -> ctypes.CDLL:
         so = os.path.join(build, f"libcmn_loader_{tag}.so")
         try:
             if not os.path.exists(so):
+                # Compile to a per-process temp name, then atomically
+                # rename: concurrent processes (jax.distributed workers)
+                # may race to build the same artifact, and dlopen of a
+                # half-written file would poison _LIB_ERR for the
+                # process lifetime.
+                tmp = f"{so}.tmp{os.getpid()}"
                 cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC",
-                       "-pthread", src, "-o", so]
+                       "-pthread", src, "-o", tmp]
                 subprocess.run(cmd, check=True, capture_output=True,
                                text=True)
+                os.replace(tmp, so)
                 # drop artifacts of older source revisions
                 for stale in os.listdir(build):
                     if (stale.startswith("libcmn_loader")

@@ -1,0 +1,156 @@
+#!/usr/bin/env python
+"""Pipeline schedule A/B: microbatched GPipe vs MultiNodeChainList.
+
+Measures full training steps (fwd+bwd+update) of the same 8-stage model
+on an 8-device virtual CPU mesh:
+
+* ``chain``    — MultiNodeChainList: the reference's fill-drain shape
+  (one stage computes at a time; per-stage jitted programs + host-driven
+  activation hops).
+* ``gpipe``    — build_pipeline_train_step: one compiled program, n_micro
+  microbatches streaming through every stage concurrently.
+
+Absolute numbers are CPU-host numbers; the point is the *schedule* ratio
+(the same two programs on TPU keep the shape: the chain tier serializes
+stages, the pipeline tier overlaps them with a bubble fraction of
+(S-1)/(n_micro+S-1)).  Results are recorded in docs/performance.md.
+
+Run:  python benchmarks/pipeline_bench.py [--steps 20]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "")
+    + " --xla_force_host_platform_device_count=8"
+)
+
+try:  # installed package (pip install -e .)
+    import chainermn_tpu  # noqa: F401
+except ImportError:  # source checkout
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    )
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+import optax
+from flax import linen as nn
+
+import chainermn_tpu as cmn
+from chainermn_tpu.link import MultiNodeChainList
+from chainermn_tpu.parallel import build_pipeline_train_step
+
+D = 256
+N_STAGE = 8
+
+
+def bench_gpipe(comm, n_micro, mb, steps, warmup):
+    rng = np.random.RandomState(0)
+    Ws = jnp.asarray(
+        rng.randn(N_STAGE, D, D), jnp.float32
+    ) / np.sqrt(D)
+    x = jnp.asarray(rng.randn(n_micro, mb, D), jnp.float32)
+    t = jnp.asarray(rng.randn(n_micro, mb, D), jnp.float32)
+
+    stage_fn = lambda W, h: jnp.tanh(h @ W)
+    loss_fn = lambda y, tt: jnp.mean((y - tt) ** 2)
+    opt = optax.sgd(0.01)
+    step = build_pipeline_train_step(
+        comm, stage_fn, loss_fn, opt, n_micro=n_micro, remat=False,
+        donate=False,
+    )
+    params, opt_state = step.place(Ws, opt.init(Ws))
+    batch = step.place(Ws, batch=(x, t))[1]
+
+    for _ in range(warmup):
+        params, opt_state, m = step(params, opt_state, batch)
+    jax.block_until_ready(m["loss"])
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt_state, m = step(params, opt_state, batch)
+    jax.block_until_ready(m["loss"])
+    dt = time.perf_counter() - t0
+    return n_micro * mb * steps / dt, float(m["loss"])
+
+
+class Stage(nn.Module):
+    @nn.compact
+    def __call__(self, h):
+        W = self.param(
+            "W", nn.initializers.normal(1.0 / np.sqrt(D)), (D, D)
+        )
+        return jnp.tanh(h @ W)
+
+
+def bench_chain(comm, rows, steps, warmup):
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(rows, D), jnp.float32)
+    t = jnp.asarray(rng.randn(rows, D), jnp.float32)
+
+    chain = MultiNodeChainList(comm)
+    for s in range(N_STAGE):
+        chain.add_link(
+            Stage(),
+            rank_in=None if s == 0 else s - 1,
+            rank_out=None if s == N_STAGE - 1 else s + 1,
+        )
+    params = chain.init(jax.random.PRNGKey(0), x)
+    vag = chain.value_and_grad(lambda y, tt: jnp.mean((y - tt) ** 2))
+    opt = chain.optimizer(optax.sgd(0.01))
+    state = opt.init(params)
+
+    def one_step(params, state):
+        loss, grads = vag(params, x, t)
+        params, state = opt.update(grads, state, params)
+        return params, state, loss
+
+    for _ in range(warmup):
+        params, state, loss = one_step(params, state)
+    jax.block_until_ready(loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, state, loss = one_step(params, state)
+    jax.block_until_ready(loss)
+    dt = time.perf_counter() - t0
+    return rows * steps / dt, float(loss)
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--n-micro", type=int, default=8)
+    p.add_argument("--mb", type=int, default=16)
+    args = p.parse_args()
+
+    comm = cmn.create_communicator("tpu", devices=jax.devices("cpu")[:8])
+    rows = args.n_micro * args.mb
+
+    chain_rps, _ = bench_chain(comm, rows, args.steps, args.warmup)
+    gpipe_rps, _ = bench_gpipe(
+        comm, args.n_micro, args.mb, args.steps, args.warmup
+    )
+    bubble = (N_STAGE - 1) / (args.n_micro + N_STAGE - 1)
+    print(json.dumps({
+        "metric": "pipeline_rows_per_sec",
+        "chain_fill_drain": round(chain_rps, 1),
+        "gpipe_microbatched": round(gpipe_rps, 1),
+        "speedup": round(gpipe_rps / chain_rps, 2),
+        "n_stage": N_STAGE,
+        "n_micro": args.n_micro,
+        "gpipe_bubble_fraction": round(bubble, 3),
+        "unit": "rows/sec (8-device virtual CPU mesh)",
+    }))
+
+
+if __name__ == "__main__":
+    main()

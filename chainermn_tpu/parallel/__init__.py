@@ -1,6 +1,6 @@
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
-from .pipeline import gpipe  # noqa: F401
+from .pipeline import build_pipeline_train_step, gpipe  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense,
     RowParallelDense,
@@ -18,6 +18,7 @@ __all__ = [
     "ring_attention",
     "ulysses_attention",
     "gpipe",
+    "build_pipeline_train_step",
     "ColumnParallelDense",
     "RowParallelDense",
     "megatron_param_specs",

@@ -21,7 +21,7 @@ XLA's scheduling rather than hand-written phases).
 
 from __future__ import annotations
 
-from typing import Any, Callable
+from typing import Any, Callable, Optional
 
 import jax
 import jax.numpy as jnp
@@ -33,6 +33,8 @@ def gpipe(
     stage_params: Any,
     x_microbatches: jnp.ndarray,
     axis_name: str,
+    *,
+    remat: bool = False,
 ) -> jnp.ndarray:
     """Run a homogeneous-stage pipeline under ``shard_map``.
 
@@ -45,6 +47,11 @@ def gpipe(
         stream; only stage 0 actually consumes it (other chips receive
         activations from their neighbor).
       axis_name: the pipeline mesh axis.
+      remat: rematerialize each stage's forward during backward
+        (``jax.checkpoint``).  The scan carries one activation per tick;
+        with remat the saved residuals per tick shrink to the stage
+        boundary values — the standard memory/FLOPs trade for deep
+        pipelines.
 
     Returns:
       (n_micro, micro_batch, ...) — the final stage's outputs for every
@@ -52,6 +59,8 @@ def gpipe(
       typically ``functions.bcast`` or compute loss on the last stage and
       ``psum``).
     """
+    if remat:
+        stage_fn = jax.checkpoint(stage_fn)
     n_stage = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     n_micro = x_microbatches.shape[0]
@@ -93,3 +102,159 @@ def gpipe(
         tick, (incoming0, outputs0), jnp.arange(total_ticks)
     )
     return outputs
+
+
+def build_pipeline_train_step(
+    comm,
+    stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
+    loss_fn: Callable[[jnp.ndarray, Any], jnp.ndarray],
+    optimizer,
+    *,
+    n_micro: int,
+    remat: bool = True,
+    donate: bool = True,
+):
+    """Build a jitted microbatched pipeline-parallel training step.
+
+    The performance tier over ``MultiNodeChainList`` (which runs one
+    stage at a time — reference fill-drain semantics): every chip holds
+    one stage, ``n_micro`` microbatches stream through the GPipe
+    schedule, the loss forms on the last stage and is ``psum``-broadcast,
+    and the generated backward runs the transposed schedule.  One XLA
+    program per step; no host round trips.
+
+    Args:
+      comm: a flat (single-axis) communicator; chip ``s`` = stage ``s``.
+      stage_fn: ``(stage_params, h) -> h`` — one homogeneous stage.
+      loss_fn: ``(outputs, targets) -> scalar`` where ``outputs`` is
+        the last stage's ``(n_micro, micro_batch, ...)`` block.
+      optimizer: a plain optax transformation.  Stage gradients are
+        per-chip local (no cross-stage sync exists in pipeline
+        parallelism), so multi-node wrappers are rejected — their psum
+        would corrupt distinct stages' gradients.
+      n_micro: microbatches per step; the bubble fraction is
+        ``(n_stage - 1) / (n_micro + n_stage - 1)``.
+      remat: rematerialize stage forwards in backward (memory tier).
+
+    Layout: ``init_stage_params`` must produce a pytree whose leaves are
+    stacked over stages (leading axis ``n_stage``); the returned
+    ``step.place`` shards them one stage per chip.  ``step(params,
+    opt_state, (x_micro, targets))`` expects ``x_micro`` of shape
+    ``(n_micro, micro_batch, ...)`` and broadcast targets; both are
+    replicated to every chip (only stage 0 consumes the inputs, only the
+    last stage the targets).
+    """
+    import optax
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # late import to avoid a cycle (optimizers imports nothing from here)
+    from ..optimizers import _MultiNodeOptimizer
+
+    if isinstance(optimizer, _MultiNodeOptimizer):
+        raise ValueError(
+            "build_pipeline_train_step takes a plain optax optimizer: "
+            "stage gradients are per-chip local and a multi-node "
+            "wrapper's cross-chip psum would mix different stages' "
+            "gradients"
+        )
+    if len(comm.axis_names) != 1:
+        raise ValueError(
+            "pipeline parallelism needs a flat (single-axis) "
+            f"communicator; got axes {comm.axis_names}"
+        )
+    ax = comm.axis_names[0]
+    n_stage = comm.size
+    mesh = comm.mesh
+    stage_sharding = NamedSharding(mesh, P(ax))
+    rep = NamedSharding(mesh, P())
+
+    def _squeeze_params(tree):
+        return jax.tree_util.tree_map(lambda p: jnp.squeeze(p, 0), tree)
+
+    def _unsqueeze_params(tree):
+        return jax.tree_util.tree_map(lambda p: p[None], tree)
+
+    def _squeeze_state(state):
+        return optax.tree_map_params(
+            optimizer, lambda s: jnp.squeeze(s, 0), state
+        )
+
+    def _unsqueeze_state(state):
+        return optax.tree_map_params(optimizer, lambda s: s[None], state)
+
+    def _state_specs(opt_state):
+        return optax.tree_map_params(
+            optimizer,
+            lambda _s: P(ax),
+            opt_state,
+            transform_non_params=lambda _s: P(),
+        )
+
+    def _step(params, opt_state, batch):
+        x_micro, targets = batch
+        if x_micro.shape[0] != n_micro:
+            raise ValueError(
+                f"batch carries {x_micro.shape[0]} microbatches but the "
+                f"step was built with n_micro={n_micro}; the schedule's "
+                "bubble fraction depends on it — pass matching data"
+            )
+        local = _squeeze_params(params)
+
+        def pipeline_loss(lp):
+            y = gpipe(stage_fn, lp, x_micro, ax, remat=remat)
+            l = loss_fn(y, targets)
+            is_last = lax.axis_index(ax) == n_stage - 1
+            # loss exists on the last stage; psum replicates it (and
+            # routes cotangents back into the pipeline's backward)
+            return lax.psum(jnp.where(is_last, l, 0.0), ax)
+
+        loss, grads = jax.value_and_grad(pipeline_loss)(local)
+        lstate = _squeeze_state(opt_state)
+        updates, lstate = optimizer.update(grads, lstate, local)
+        local = optax.apply_updates(local, updates)
+        return (
+            _unsqueeze_params(local),
+            _unsqueeze_state(lstate),
+            {"loss": loss},
+        )
+
+    compiled: dict = {}
+
+    def _get(opt_state):
+        key = jax.tree_util.tree_structure(opt_state)
+        if key not in compiled:
+            sspecs = _state_specs(opt_state)
+            sharded = jax.shard_map(
+                _step,
+                mesh=mesh,
+                in_specs=(P(ax), sspecs, (P(), P())),
+                out_specs=(P(ax), sspecs, P()),
+                check_vma=False,
+            )
+            compiled[key] = jax.jit(
+                sharded, donate_argnums=(0, 1) if donate else ()
+            )
+        return compiled[key]
+
+    def step(params, opt_state, batch):
+        return _get(opt_state)(params, opt_state, batch)
+
+    def place(params, opt_state=None, batch=None):
+        out = [jax.device_put(params, stage_sharding)]
+        if opt_state is not None:
+            shardings = jax.tree_util.tree_map(
+                lambda s: NamedSharding(mesh, s),
+                _state_specs(opt_state),
+                is_leaf=lambda x: isinstance(x, P),
+            )
+            out.append(jax.device_put(opt_state, shardings))
+        if batch is not None:
+            out.append(jax.device_put(batch, rep))
+        return out[0] if len(out) == 1 else tuple(out)
+
+    step.place = place
+    step.stage_sharding = stage_sharding
+    step.n_stage = n_stage
+    step.n_micro = n_micro
+    return step

@@ -128,6 +128,80 @@ class ProgressBar:
         self._stream.flush()
 
 
+class Profile:
+    """Capture a ``jax.profiler`` trace over a window of iterations.
+
+    SURVEY.md section 5.1: the reference shipped no in-package profiler
+    (users fell back to Chainer hooks + nvprof); the TPU rebuild makes
+    step-window tracing a first-class trainer extension.  The trace
+    covers iterations ``[start, stop)`` and lands in ``logdir`` in the
+    TensorBoard profile-plugin format:
+
+        trainer.extend(T.Profile(start=10, stop=13, comm=comm))
+        ...
+        tensorboard --logdir profile/   # -> Profile tab: timeline,
+                                        #    op stats, memory viewer
+
+    Only the chief process traces by default (every process writes its
+    own device's timeline under multi-controller when
+    ``all_processes=True``).  See docs/performance.md for the workflow,
+    including communication-overhead-by-subtraction with the ``dummy``
+    communicator.
+    """
+
+    priority = 170  # before Throughput so the trace brackets real work
+    trigger = (1, "iteration")
+    name = "profile"
+
+    def __init__(self, start: int = 10, stop: int = 13,
+                 logdir: str = "profile", comm=None,
+                 all_processes: bool = False):
+        if stop <= start:
+            raise ValueError(f"need start < stop, got [{start}, {stop})")
+        self._start = start
+        self._stop = stop
+        self._logdir = logdir
+        self._comm = comm
+        self._all = all_processes
+        self._active = False
+        self.done = False
+
+    def _should_trace(self) -> bool:
+        return self._all or _is_chief(self._comm)
+
+    def __call__(self, trainer):
+        import jax
+
+        if self.done or not self._should_trace():
+            return
+        # Extensions run AFTER the update increments trainer.iteration,
+        # so to trace updates [start, stop) the trace must open once
+        # update (start-1) has completed and close once update (stop-1)
+        # has.  (start=0 is unreachable this way; the first traceable
+        # update is 1.)
+        if not self._active and trainer.iteration >= self._start - 1:
+            jax.profiler.start_trace(self._logdir)
+            self._active = True
+        elif self._active and trainer.iteration >= self._stop - 1:
+            # make async dispatches land inside the trace window
+            for v in trainer.observation.values():
+                try:
+                    jax.block_until_ready(v)
+                except Exception:
+                    pass
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+    def finalize(self, trainer=None):
+        if self._active:
+            import jax
+
+            jax.profiler.stop_trace()
+            self._active = False
+            self.done = True
+
+
 class Throughput:
     """Reports global and per-chip samples/sec into the observation."""
 

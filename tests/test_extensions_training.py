@@ -209,6 +209,44 @@ class TestGlobalExceptHook:
         assert sys.excepthook is sys.__excepthook__
 
 
+class TestProfileExtension:
+    def test_trace_window_produces_profile(self, comm, tmp_path):
+        model, it, step, params, opt_state = _make_training(comm)
+        trainer = Trainer(
+            Updater(it, step, params, opt_state),
+            stop_trigger=(6, "iteration"),
+        )
+        logdir = str(tmp_path / "prof")
+        prof = T.Profile(start=2, stop=4, logdir=logdir, comm=comm)
+        trainer.extend(prof, trigger=(1, "iteration"))
+        trainer.run()
+        assert prof.done
+        # TensorBoard profile-plugin layout: plugins/profile/<run>/...
+        plugin_dir = os.path.join(logdir, "plugins", "profile")
+        assert os.path.isdir(plugin_dir)
+        runs = os.listdir(plugin_dir)
+        assert runs, "no profile run captured"
+        files = os.listdir(os.path.join(plugin_dir, runs[0]))
+        assert any("trace" in f for f in files), files
+
+    def test_finalize_closes_open_trace(self, comm, tmp_path):
+        model, it, step, params, opt_state = _make_training(comm)
+        trainer = Trainer(
+            Updater(it, step, params, opt_state),
+            stop_trigger=(3, "iteration"),  # stops inside the window
+        )
+        prof = T.Profile(start=1, stop=10, logdir=str(tmp_path / "p2"),
+                         comm=comm)
+        trainer.extend(prof, trigger=(1, "iteration"))
+        trainer.run()
+        prof.finalize()
+        assert prof.done
+
+    def test_invalid_window_rejected(self):
+        with pytest.raises(ValueError):
+            T.Profile(start=5, stop=5)
+
+
 class TestThroughputExtension:
     def test_reports_after_warmup(self, comm):
         model, it, step, params, opt_state = _make_training(comm)

@@ -181,7 +181,7 @@ class TestGPipe:
         def run(Ws, x):
             W = jnp.squeeze(Ws, 0)  # this chip's stage weight
             out = gpipe(stage_fn, W, x, "mn")
-            # выход valid on last stage; sum-broadcast to all for checking
+            # output valid on last stage; sum-broadcast to all for checking
             return lax.psum(out, "mn")
 
         f = jax.jit(
@@ -221,3 +221,125 @@ class TestGPipe:
         assert g.shape == (8, d, d)
         assert np.isfinite(g).all()
         assert np.abs(g).sum() > 0
+
+
+class TestPipelineTrainStep:
+    """build_pipeline_train_step: the microbatched performance tier over
+    MultiNodeChainList (one compiled GPipe program per training step)."""
+
+    D, MB, NMICRO = 6, 2, 4
+
+    def _stage_fn(self, W, h):
+        return jnp.tanh(h @ W)
+
+    def _loss_fn(self, y, t):
+        return jnp.mean((y - t) ** 2)
+
+    def _data(self):
+        rng = np.random.RandomState(3)
+        Ws = jnp.asarray(rng.randn(8, self.D, self.D), jnp.float32) * 0.4
+        x = jnp.asarray(
+            rng.randn(self.NMICRO, self.MB, self.D), jnp.float32
+        )
+        t = jnp.asarray(
+            rng.randn(self.NMICRO, self.MB, self.D), jnp.float32
+        )
+        return Ws, x, t
+
+    def _run_pipeline(self, devices8, remat, n_steps=3):
+        import optax
+        import chainermn_tpu as cmn
+        from chainermn_tpu.parallel import build_pipeline_train_step
+
+        comm = cmn.create_communicator("tpu", devices=devices8)
+        Ws, x, t = self._data()
+        opt = optax.adam(0.05)
+        step = build_pipeline_train_step(
+            comm, self._stage_fn, self._loss_fn, opt,
+            n_micro=self.NMICRO, remat=remat, donate=False,
+        )
+        params, opt_state = step.place(Ws, opt.init(Ws))
+        batch = step.place(Ws, batch=(x, t))[1]
+        losses = []
+        for _ in range(n_steps):
+            params, opt_state, m = step(params, opt_state, batch)
+            losses.append(float(m["loss"]))
+        return np.asarray(params), losses
+
+    def _run_sequential_oracle(self, n_steps=3):
+        import optax
+
+        Ws, x, t = self._data()
+
+        def seq_loss(Ws):
+            h = x
+            for s in range(8):
+                h = self._stage_fn(Ws[s], h)
+            return self._loss_fn(h, t)
+
+        opt = optax.adam(0.05)
+        state = opt.init(Ws)
+        losses = []
+        for _ in range(n_steps):
+            loss, g = jax.value_and_grad(seq_loss)(Ws)
+            upd, state = opt.update(g, state, Ws)
+            Ws = optax.apply_updates(Ws, upd)
+            losses.append(float(loss))
+        return np.asarray(Ws), losses
+
+    def test_matches_sequential_oracle(self, devices8):
+        p_pipe, l_pipe = self._run_pipeline(devices8, remat=False)
+        p_seq, l_seq = self._run_sequential_oracle()
+        np.testing.assert_allclose(l_pipe, l_seq, rtol=1e-5)
+        np.testing.assert_allclose(p_pipe, p_seq, rtol=1e-4, atol=1e-6)
+
+    def test_remat_matches_plain(self, devices8):
+        p_remat, l_remat = self._run_pipeline(devices8, remat=True)
+        p_plain, l_plain = self._run_pipeline(devices8, remat=False)
+        np.testing.assert_allclose(l_remat, l_plain, rtol=1e-6)
+        np.testing.assert_allclose(p_remat, p_plain, rtol=1e-5, atol=1e-7)
+
+    def test_loss_decreases(self, devices8):
+        _, losses = self._run_pipeline(devices8, remat=True, n_steps=6)
+        assert losses[-1] < losses[0]
+
+    def test_n_micro_mismatch_rejected(self, devices8):
+        import optax
+        import chainermn_tpu as cmn
+        from chainermn_tpu.parallel import build_pipeline_train_step
+
+        comm = cmn.create_communicator("tpu", devices=devices8)
+        Ws, x, t = self._data()
+        opt = optax.adam(0.05)
+        step = build_pipeline_train_step(
+            comm, self._stage_fn, self._loss_fn, opt,
+            n_micro=self.NMICRO * 2, donate=False,
+        )
+        params, opt_state = step.place(Ws, opt.init(Ws))
+        batch = step.place(Ws, batch=(x, t))[1]  # only NMICRO microbatches
+        with pytest.raises(ValueError, match="n_micro"):
+            step(params, opt_state, batch)
+
+    def test_multi_node_optimizer_rejected(self, devices8):
+        import optax
+        import chainermn_tpu as cmn
+        from chainermn_tpu.parallel import build_pipeline_train_step
+
+        comm = cmn.create_communicator("tpu", devices=devices8)
+        mn_opt = cmn.create_multi_node_optimizer(optax.adam(0.1), comm)
+        with pytest.raises(ValueError, match="plain optax"):
+            build_pipeline_train_step(
+                comm, self._stage_fn, self._loss_fn, mn_opt, n_micro=4
+            )
+
+    def test_multi_axis_communicator_rejected(self, devices8):
+        import optax
+        import chainermn_tpu as cmn
+        from chainermn_tpu.parallel import build_pipeline_train_step
+
+        comm = cmn.create_communicator("two_dimensional", devices=devices8)
+        with pytest.raises(ValueError, match="flat"):
+            build_pipeline_train_step(
+                comm, self._stage_fn, self._loss_fn, optax.adam(0.1),
+                n_micro=4,
+            )

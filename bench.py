@@ -78,46 +78,10 @@ def _flops_of(jitted, *args):
         return None
 
 
-def _force(out):
-    """Force completion with a host readback of the (scalar) output.
-
-    ``jax.block_until_ready`` does not actually wait on some remote /
-    tunneled backends; a value readback provably serializes behind every
-    queued step (the step chain is the readback's data dependency).
-    """
-    import numpy as np
-
-    return float(np.asarray(out).ravel()[0])
-
-
-def _time_steps(run_fn, steps, warmup):
-    """Per-step time via paired runs of k and 2k steps, each closed by a
-    readback: step_time = (t_2k - t_k) / k.  The difference cancels the
-    readback round-trip (which can dwarf a step over a tunneled link)
-    and any constant per-call overhead.
-
-    At least one warmup step always runs (it absorbs compilation and
-    produces the value the pre-timing readback synchronizes on).
-    """
-    steps = max(int(steps), 1)
-    out = None
-    for _ in range(max(int(warmup), 1)):
-        out = run_fn()
-    _force(out)
-
-    def timed(k):
-        t0 = time.perf_counter()
-        for _ in range(k):
-            out = run_fn()
-        _force(out)
-        return time.perf_counter() - t0
-
-    t1 = timed(steps)
-    t2 = timed(2 * steps)
-    dt = (t2 - t1) / steps
-    if dt <= 0:  # noise floor: fall back to the long run's average
-        dt = t2 / (2 * steps)
-    return dt
+from chainermn_tpu.utils.benchmarking import (  # noqa: E402
+    force_completion as _force,
+    time_steps as _time_steps,
+)
 
 
 def _train_setup(comm, model, image, batch, n_classes, mutable_bn,
@@ -438,6 +402,74 @@ def config_resnet50_mnbn():
     return out
 
 
+def config_transformer_lm():
+    """Beyond the reference's workloads: decoder-only LM with the Pallas
+    flash-attention kernel — the matmul-heavy config where MFU should
+    approach the chip's practical ceiling."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    comm = cmn.create_communicator("tpu")
+    vocab = 2048 if SMOKE else 32768
+    d_model = 128 if SMOKE else 1024
+    n_layers = 2 if SMOKE else 8
+    seq = 128 if SMOKE else 2048
+    batch = _env("BENCH_LM_BATCH", 2 if SMOKE else 8) * comm.size
+    steps = _env("BENCH_STEPS", 3 if SMOKE else 10)
+
+    model = TransformerLM(
+        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, max_len=seq,
+        attention_fn=None if SMOKE else flash_attention_fn(),
+    )
+    toks0 = jnp.zeros((1, seq), jnp.int32)
+    params = comm.bcast_data(model.init(jax.random.PRNGKey(0), toks0))
+    opt = cmn.create_multi_node_optimizer(
+        optax.adamw(3e-4, weight_decay=0.01), comm
+    )
+
+    def loss_fn(p, batch):
+        return lm_loss(model.apply(p, batch), batch)
+
+    step = cmn.build_train_step(comm, loss_fn, opt)
+    params, opt_state = step.place(params, opt.init(params))
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, vocab, (batch, seq)), jnp.int32
+    )
+    bt = jax.device_put(toks, step.batch_sharding)
+    state = {"p": params, "o": opt_state}
+
+    def run():
+        state["p"], state["o"], m = step(state["p"], state["o"], bt)
+        return m["loss"]
+
+    step_time = _time_steps(run, steps, 2)
+    tokens = batch * seq
+    flops = _flops_of(step.get_jitted(params, opt_state), params, opt_state,
+                      bt)
+    peak = _peak_flops(comm.devices[0])
+    out = {
+        "metric": "transformer_lm_tokens_per_sec_per_chip",
+        "value": round(tokens / step_time / comm.size, 1),
+        "unit": "tokens/sec/chip (flash attention, bf16)",
+        "step_time_ms": round(step_time * 1e3, 2),
+        "seq_len": seq,
+        "d_model": d_model,
+        "n_layers": n_layers,
+    }
+    if flops:
+        out["model_tflops_per_step"] = round(flops / 1e12, 2)
+        if peak:
+            out["mfu"] = round(flops / step_time / (peak * comm.size), 4)
+    return out
+
+
 def config_seq2seq_mp():
     import jax
     import jax.numpy as jnp
@@ -520,6 +552,7 @@ def main():
         ("mnist", config_mnist_flat),
         ("vgg16_db", config_vgg16_double_buffering),
         ("resnet50_mnbn", config_resnet50_mnbn),
+        ("transformer_lm", config_transformer_lm),
         ("seq2seq_mp", config_seq2seq_mp),
         ("resnet50_native_input", config_resnet50_native_input),
     ]

@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Flash-attention kernel vs XLA's fused attention, honestly timed.
+
+Compares `ops.flash_attention` (Pallas, blocked online-softmax — no S x S
+matrix in HBM) against `ops.multi_head_attention` (the plain jnp
+formulation XLA fuses itself) on the attached chip, forward and
+fwd+bwd, across sequence lengths.  Timing uses the k/2k paired-readback
+method (`jax.block_until_ready` does not wait on some remote backends —
+see docs/performance.md).
+
+Run:  python benchmarks/attention_bench.py [--seqs 1024 2048 4096]
+"""
+
+import argparse
+import json
+import os
+import sys
+
+try:  # installed package (pip install -e .)
+    import chainermn_tpu  # noqa: F401
+except ImportError:  # source checkout
+    sys.path.insert(
+        0, os.path.abspath(os.path.join(os.path.dirname(__file__), ".."))
+    )
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.ops.attention import multi_head_attention
+from chainermn_tpu.ops.pallas_attention import flash_attention
+from chainermn_tpu.utils.benchmarking import time_steps
+
+
+def _time(fn, *args, steps=20):
+    return time_steps(lambda: fn(*args), steps, warmup=1)
+
+
+def bench_seq(seq, batch, heads, dim, causal, steps):
+    rng = np.random.RandomState(0)
+    shape = (batch, seq, heads, dim)
+    q = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
+    k = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
+    v = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
+
+    flash_f = jax.jit(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal).sum()
+    )
+    xla_f = jax.jit(
+        lambda q, k, v: multi_head_attention(q, k, v, causal=causal).sum()
+    )
+
+    def full_grad(attn):
+        # grads w.r.t. ALL of q, k, v, folded to one scalar INSIDE the
+        # jit so no part of the backward can be dead-code-eliminated
+        def loss(q, k, v):
+            return attn(q, k, v).astype(jnp.float32).sum()
+
+        g = jax.grad(loss, argnums=(0, 1, 2))
+
+        @jax.jit
+        def run(q, k, v):
+            dq, dk, dv = g(q, k, v)
+            return (
+                dq.astype(jnp.float32).ravel()[0]
+                + dk.astype(jnp.float32).ravel()[0]
+                + dv.astype(jnp.float32).ravel()[0]
+            )
+
+        return run
+
+    flash_g = full_grad(
+        lambda q, k, v: flash_attention(q, k, v, causal=causal)
+    )
+    xla_g = full_grad(
+        lambda q, k, v: multi_head_attention(q, k, v, causal=causal)
+    )
+
+    res = {}
+    variants = {
+        "fwd_flash_ms": (flash_f, (q, k, v)),
+        "fwd_xla_ms": (xla_f, (q, k, v)),
+        "bwd_flash_ms": (flash_g, (q, k, v)),
+        "bwd_xla_ms": (xla_g, (q, k, v)),
+    }
+    for name, (fn, fargs) in variants.items():
+        try:
+            res[name] = _time(fn, *fargs, steps=steps) * 1e3
+        except Exception as e:
+            msg = str(e)
+            res[name] = (
+                "OOM" if ("memory" in msg or "hbm" in msg.lower())
+                else f"error: {type(e).__name__}"
+            )
+    return res
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--seqs", type=int, nargs="+",
+                   default=[1024, 2048, 4096])
+    p.add_argument("--batch", type=int, default=4)
+    p.add_argument("--heads", type=int, default=8)
+    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--causal", action=argparse.BooleanOptionalAction,
+                   default=True)
+    args = p.parse_args()
+
+    dev = jax.devices()[0]
+
+    def fmt(v):
+        return round(v, 3) if isinstance(v, float) else v
+
+    def ratio(a, b):
+        if isinstance(a, float) and isinstance(b, float):
+            return round(a / b, 2)
+        return None
+
+    for seq in args.seqs:
+        r = bench_seq(seq, args.batch, args.heads, args.dim,
+                      args.causal, args.steps)
+        print(json.dumps({
+            "metric": "flash_attention_vs_xla",
+            "device": dev.device_kind,
+            "seq": seq,
+            "batch": args.batch, "heads": args.heads, "dim": args.dim,
+            "causal": args.causal,
+            "fwd_flash_ms": fmt(r["fwd_flash_ms"]),
+            "fwd_xla_ms": fmt(r["fwd_xla_ms"]),
+            "fwd_speedup": ratio(r["fwd_xla_ms"], r["fwd_flash_ms"]),
+            "bwd_flash_ms": fmt(r["bwd_flash_ms"]),
+            "bwd_xla_ms": fmt(r["bwd_xla_ms"]),
+            "bwd_speedup": ratio(r["bwd_xla_ms"], r["bwd_flash_ms"]),
+        }), flush=True)
+
+
+if __name__ == "__main__":
+    main()

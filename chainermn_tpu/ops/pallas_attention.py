@@ -12,10 +12,13 @@ matrix never exists in HBM; MXU matmuls, fp32 accumulation).
 (``ring_attention`` has its own online-merge core and takes no hook).
 
 Kernels run compiled on TPU and fall back to interpret mode elsewhere
-(tests exercise them on CPU via ``interpret=True``).  The backward pass is
-a *blocked recompute* in plain JAX — chunked over queries (for dq) and
-keys (for dk/dv) with ``lax.map``, so training memory stays O(s * chunk),
-not O(s^2); XLA fuses each chunk's matmuls on its own.
+(tests exercise them on CPU via ``interpret=True``).  The backward pass
+is a pair of Pallas kernels in the FlashAttention-2 shape: the forward
+saves the per-row log-sum-exp, the dq kernel sweeps key blocks, the
+dk/dv kernel sweeps query blocks, each recomputing its score tile in
+VMEM — training memory stays O(s), never O(s^2), and causally-dead
+blocks are skipped entirely.  Tiny compiled shapes (< one 128 lane tile)
+take a dense-recompute fallback instead.
 """
 
 from __future__ import annotations
@@ -48,11 +51,22 @@ def _round_up(x: int, m: int) -> int:
     return (x + m - 1) // m * m
 
 
+def _effective_q_block(block_q: int, s_q: int, interpret: bool) -> int:
+    """Clamp the q block for the lse layout: its blocks put bq in the
+    lane position, which compiled TPU requires to be a multiple of 128
+    OR the full (padded) axis — so for long sequences the q block floors
+    at 128 regardless of the requested size."""
+    bq = min(block_q, _round_up(s_q, 8))
+    if not interpret and _round_up(s_q, 8) >= 128:
+        bq = max(bq, 128)
+    return bq
+
+
 # ----------------------------------------------------------------------
 # Flash attention — forward kernel
 # ----------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
-                      *, s_k: int, causal: bool, scale: float,
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, s_k: int, causal: bool, scale: float,
                       block_q: int, block_k: int):
     """Grid (batch*head, q_blocks, k_blocks); the k dimension is innermost
     and sequential on TPU, so the fp32 accumulator / running max /
@@ -112,6 +126,16 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
         o_ref[0] = (
             acc_ref[:] / jnp.maximum(l_ref[:, 0:1], 1e-30)
         ).astype(o_ref.dtype)
+        # Per-row log-sum-exp of the (scaled) scores — the backward's
+        # softmax statistic.  Stored broadcast over 8 sublanes because a
+        # TPU block's second-to-last dim must be a multiple of 8.
+        # Garbage on padded rows; the backward masks those by q index.
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))[
+                None, :
+            ],
+            lse_ref.shape[1:],
+        )
 
 
 @functools.partial(
@@ -121,7 +145,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, acc_ref, m_ref, l_ref,
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    bq = min(block_q, _round_up(s_q, 8))
+    bq = _effective_q_block(block_q, s_q, interpret)
     bk = min(block_k, _round_up(s_k, 8))
 
     def to_bh(x, s, blk):
@@ -137,19 +161,25 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     s_qp, s_kp = qb.shape[1], kb_.shape[1]
 
     grid = (b * h, s_qp // bq, s_kp // bk)
-    out = pl.pallas_call(
+    out, lse = pl.pallas_call(
         functools.partial(
             _flash_fwd_kernel, s_k=s_k, causal=causal, scale=scale,
             block_q=bq, block_k=bk,
         ),
-        out_shape=jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
+            jax.ShapeDtypeStruct((b * h, 8, s_qp), jnp.float32),
+        ],
         grid=grid,
         in_specs=[
             pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
             pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),
         ],
-        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        out_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+            pl.BlockSpec((1, 8, bq), lambda i, j, kb: (i, 0, j)),
+        ],
         scratch_shapes=[
             pltpu.VMEM((bq, d), jnp.float32),    # acc
             pltpu.VMEM((bq, 128), jnp.float32),  # running max (col 0)
@@ -158,99 +188,213 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
         interpret=interpret,
     )(qb, kb_, vb)
     out = out[:, :s_q].reshape(b, h, s_q, d)
-    return jnp.moveaxis(out, 1, 2)  # (b, s, h, d)
+    return jnp.moveaxis(out, 1, 2), lse[:, 0, :s_q]  # (b,s,h,d), (bh,s_q)
 
 
 # ----------------------------------------------------------------------
-# Flash attention — blocked recompute backward (plain JAX, O(s * chunk))
+# Flash attention — backward kernels (FlashAttention-2 shape)
 # ----------------------------------------------------------------------
-def _chunked(x, chunk, axis=1):
-    """Pad axis to a chunk multiple and reshape into (n_chunks, chunk)."""
-    s = x.shape[axis]
-    pad = _round_up(s, chunk) - s
-    if pad:
-        widths = [(0, 0)] * x.ndim
-        widths[axis] = (0, pad)
-        x = jnp.pad(x, widths)
-    new_shape = (
-        x.shape[:axis] + (x.shape[axis] // chunk, chunk)
-        + x.shape[axis + 1:]
-    )
-    return x.reshape(new_shape)
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, s_q: int, s_k: int,
+                         causal: bool, scale: float, block_q: int,
+                         block_k: int):
+    """Grid (batch*head, q_blocks, k_blocks); k innermost/sequential.
+    Recomputes the (bq, bk) probability tile from q, k and the saved
+    row log-sum-exp, accumulates dq in VMEM."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
 
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
 
-def _blocked_attention_grads(q, k, v, o, do, causal, scale, chunk):
-    """dq, dk, dv without materializing the (s_q, s_k) score matrix.
+    first_q = j * block_q
+    first_k = kb * block_k
+    live = (first_k <= first_q + block_q - 1) if causal else True
 
-    All inputs (bh, s, d) fp32.  Two passes of ``lax.map`` over chunks:
-    queries for dq (scores are (chunk, s_k) — linear in s), keys for
-    dk/dv (scores are (s_q, chunk)).  The softmax statistics (lse) are
-    recomputed in the first pass and reused in the second.
-    """
-    bh, s_q, d = q.shape
-    s_k = k.shape[1]
-    D = jnp.sum(do * o, axis=-1)  # (bh, s_q)
-
-    q_pos = jnp.arange(s_q)
-    k_pos = jnp.arange(s_k)
-
-    def mask_bias(qi, kj):
-        m = jnp.ones((qi.shape[0], kj.shape[0]), bool)
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        q_idx = first_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = first_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_idx < s_k) & (q_idx < s_q)
         if causal:
-            m = qi[:, None] >= kj[None, :]
-        return jnp.where(m, 0.0, _NEG_INF)
+            mask = mask & (k_idx <= q_idx)
+        # p from the saved statistic; explicit zeroing (padded rows carry
+        # garbage lse, so exp(s - lse) alone is not safe there)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dq_acc[:] += lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
 
-    # -- pass 1: dq and lse, chunked over queries ----------------------
-    qc = _chunked(q, chunk)            # (bh, nq, c, d)
-    doc = _chunked(do, chunk)
-    Dc = _chunked(D, chunk)            # (bh, nq, c)
-    qic = _chunked(q_pos[None], chunk, axis=1)[0]  # (nq, c)
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
-    def one_q_chunk(args):
-        qc_i, do_i, D_i, qi = args  # (bh, c, d), (bh, c, d), (bh, c), (c,)
-        s = jnp.einsum("bcd,bkd->bck", qc_i, k) * scale
-        s = s + mask_bias(qi, k_pos)[None]
-        m = jnp.max(s, axis=-1, keepdims=True)
-        p = jnp.exp(s - m)
-        l = jnp.sum(p, axis=-1, keepdims=True)
-        lse = (m + jnp.log(jnp.maximum(l, 1e-30)))[..., 0]  # (bh, c)
-        p = p / jnp.maximum(l, 1e-30)
-        dp = jnp.einsum("bcd,bkd->bck", do_i, v)
-        ds = p * (dp - D_i[..., None])
-        dq_i = jnp.einsum("bck,bkd->bcd", ds, k) * scale
-        return dq_i, lse
 
-    dq_c, lse_c = lax.map(
-        one_q_chunk,
-        (jnp.moveaxis(qc, 1, 0), jnp.moveaxis(doc, 1, 0),
-         jnp.moveaxis(Dc, 1, 0), qic),
-    )  # (nq, bh, c, d), (nq, bh, c)
-    dq = jnp.moveaxis(dq_c, 0, 1).reshape(bh, -1, d)[:, :s_q]
-    lse = jnp.moveaxis(lse_c, 0, 1).reshape(bh, -1)[:, :s_q]
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, s_q: int,
+                          s_k: int, causal: bool, scale: float,
+                          block_q: int, block_k: int):
+    """Grid (batch*head, k_blocks, q_blocks); q innermost/sequential.
+    Accumulates dk and dv for one key block across all query blocks."""
+    kb = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
 
-    # -- pass 2: dk / dv, chunked over keys ----------------------------
-    kc = _chunked(k, chunk)            # (bh, nk, c, d)
-    vc = _chunked(v, chunk)
-    kjc = _chunked(k_pos[None], chunk, axis=1)[0]  # (nk, c)
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
 
-    def one_k_chunk(args):
-        k_j, v_j, kj = args  # (bh, c, d), (bh, c, d), (c,)
-        s = jnp.einsum("bqd,bcd->bqc", q, k_j) * scale
-        s = s + mask_bias(q_pos, kj)[None]
-        p = jnp.exp(s - lse[..., None])  # normalized via saved lse
-        dv_j = jnp.einsum("bqc,bqd->bcd", p, do)
-        dp = jnp.einsum("bqd,bcd->bqc", do, v_j)
-        ds = p * (dp - D[..., None])
-        dk_j = jnp.einsum("bqc,bqd->bcd", ds, q) * scale
-        return dk_j, dv_j
+    first_q = j * block_q
+    first_k = kb * block_k
+    live = (first_q + block_q - 1 >= first_k) if causal else True
 
-    dk_c, dv_c = lax.map(
-        one_k_chunk,
-        (jnp.moveaxis(kc, 1, 0), jnp.moveaxis(vc, 1, 0), kjc),
-    )
-    dk = jnp.moveaxis(dk_c, 0, 1).reshape(bh, -1, d)[:, :s_k]
-    dv = jnp.moveaxis(dv_c, 0, 1).reshape(bh, -1, d)[:, :s_k]
-    return dq, dk, dv
+    @pl.when(live)
+    def _accum():
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        q_idx = first_q + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 0
+        )
+        k_idx = first_k + lax.broadcasted_iota(
+            jnp.int32, (block_q, block_k), 1
+        )
+        mask = (k_idx < s_k) & (q_idx < s_q)
+        if causal:
+            mask = mask & (k_idx <= q_idx)
+        p = jnp.where(mask, jnp.exp(s - lse_ref[0, 0][:, None]), 0.0)
+        dv_acc[:] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_acc[:] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+)
+def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
+                    interpret):
+    """(b, s, h, d)-layout backward via the two kernels above."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    bq = _effective_q_block(block_q, s_q, interpret)
+    bk = min(block_k, _round_up(s_k, 8))
+
+    def to_bh(x, s, blk):
+        x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
+        pad = _round_up(s, blk) - s
+        if pad:
+            x = jnp.pad(x, ((0, 0), (0, pad), (0, 0)))
+        return x
+
+    qb = to_bh(q, s_q, bq)
+    dob = to_bh(g, s_q, bq)
+    ob = to_bh(out, s_q, bq)
+    kb_, vb = to_bh(k, s_k, bk), to_bh(v, s_k, bk)
+    s_qp, s_kp = qb.shape[1], kb_.shape[1]
+
+    delta = jnp.sum(
+        dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1
+    )  # (bh, s_qp)
+    pad_q = s_qp - s_q
+    lse_p = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
+    # 8-sublane broadcast layout (TPU blocks need sublane-dim % 8 == 0)
+    bh = b * h
+    delta = jnp.broadcast_to(delta[:, None], (bh, 8, s_qp))
+    lse_p = jnp.broadcast_to(lse_p[:, None], (bh, 8, s_qp))
+
+    n_q, n_k = s_qp // bq, s_kp // bk
+    kwargs = dict(s_q=s_q, s_k=s_k, causal=causal, scale=scale,
+                  block_q=bq, block_k=bk)
+
+    dq = pl.pallas_call(
+        functools.partial(_flash_bwd_dq_kernel, **kwargs),
+        out_shape=jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
+        grid=(b * h, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda i, j, kb: (i, kb, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),   # do
+            pl.BlockSpec((1, 8, bq), lambda i, j, kb: (i, 0, j)),   # lse
+            pl.BlockSpec((1, 8, bq), lambda i, j, kb: (i, 0, j)),   # delta
+        ],
+        out_specs=pl.BlockSpec((1, bq, d), lambda i, j, kb: (i, j, 0)),
+        scratch_shapes=[pltpu.VMEM((bq, d), jnp.float32)],
+        interpret=interpret,
+    )(qb, kb_, vb, dob, lse_p, delta)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_flash_bwd_dkv_kernel, **kwargs),
+        out_shape=[
+            jax.ShapeDtypeStruct((b * h, s_kp, d), k.dtype),
+            jax.ShapeDtypeStruct((b * h, s_kp, d), v.dtype),
+        ],
+        grid=(b * h, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, d), lambda i, kb, j: (i, j, 0)),   # q
+            pl.BlockSpec((1, bk, d), lambda i, kb, j: (i, kb, 0)),  # k
+            pl.BlockSpec((1, bk, d), lambda i, kb, j: (i, kb, 0)),  # v
+            pl.BlockSpec((1, bq, d), lambda i, kb, j: (i, j, 0)),   # do
+            pl.BlockSpec((1, 8, bq), lambda i, kb, j: (i, 0, j)),   # lse
+            pl.BlockSpec((1, 8, bq), lambda i, kb, j: (i, 0, j)),   # delta
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, d), lambda i, kb, j: (i, kb, 0)),
+            pl.BlockSpec((1, bk, d), lambda i, kb, j: (i, kb, 0)),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((bk, d), jnp.float32),
+            pltpu.VMEM((bk, d), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qb, kb_, vb, dob, lse_p, delta)
+
+    def from_bh(x, s):
+        return jnp.moveaxis(x[:, :s].reshape(b, h, s, d), 1, 2)
+
+    return from_bh(dq, s_q), from_bh(dk, s_k), from_bh(dv, s_k)
 
 
 # ----------------------------------------------------------------------
@@ -258,7 +402,7 @@ def _blocked_attention_grads(q, k, v, o, do, causal, scale, chunk):
 # ----------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=128, block_k=128, interpret=None):
+                    block_q=256, block_k=512, interpret=None):
     """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
 
     Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
@@ -272,45 +416,47 @@ def flash_attention(q, k, v, causal=False, scale=None,
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    return _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                          _should_interpret(interpret))
+    out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                            _should_interpret(interpret))
+    return out
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
-    out = flash_attention(q, k, v, causal, scale, block_q, block_k,
-                          interpret)
-    return out, (q, k, v, out)
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
+                              _should_interpret(interpret))
+    return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
                     residuals, g):
-    q, k, v, out = residuals
+    q, k, v, out, lse = residuals
     if scale is None:
         scale = q.shape[-1] ** -0.5
-    b, s_q, h, d = q.shape
+    interp = _should_interpret(interpret)
+    if not interp and (q.shape[1] < 128 or k.shape[1] < 128):
+        # Compiled path with sub-lane-tile sequences (explicit small
+        # *blocks* are clamped by _effective_q_block, but a sequence
+        # shorter than a lane tile cannot be): a dense recompute is both
+        # safe and cheap at these sizes.
+        from .attention import multi_head_attention
 
-    def to_bh(x):
-        return jnp.moveaxis(x, 2, 1).reshape(
-            b * h, x.shape[1], d
-        ).astype(jnp.float32)
-
-    chunk = max(block_q, 128)
-    dq, dk, dv = _blocked_attention_grads(
-        to_bh(q), to_bh(k), to_bh(v), to_bh(out), to_bh(g),
-        causal, scale, chunk,
-    )
-
-    def from_bh(x, s, dt):
-        return jnp.moveaxis(x.reshape(b, h, s, d), 1, 2).astype(dt)
-
-    return (from_bh(dq, s_q, q.dtype), from_bh(dk, k.shape[1], k.dtype),
-            from_bh(dv, v.shape[1], v.dtype))
+        _, vjp = jax.vjp(
+            lambda q, k, v: multi_head_attention(
+                q, k, v, causal=causal, scale=scale
+            ),
+            q, k, v,
+        )
+        return vjp(g)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
+                           block_k, interp)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
 
 
-def flash_attention_fn(block_q: int = 128, block_k: int = 128,
+def flash_attention_fn(block_q: int = 256, block_k: int = 512,
                        interpret: Optional[bool] = None):
     """Adapter producing the ``attention_fn`` signature used by
     ``ulysses_attention``: ``(q, k, v, causal, scale)``."""

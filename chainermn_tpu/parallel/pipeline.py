@@ -21,7 +21,7 @@ XLA's scheduling rather than hand-written phases).
 
 from __future__ import annotations
 
-from typing import Any, Callable, Optional
+from typing import Any, Callable
 
 import jax
 import jax.numpy as jnp

@@ -134,8 +134,10 @@ class Profile:
     SURVEY.md section 5.1: the reference shipped no in-package profiler
     (users fell back to Chainer hooks + nvprof); the TPU rebuild makes
     step-window tracing a first-class trainer extension.  The trace
-    covers iterations ``[start, stop)`` and lands in ``logdir`` in the
-    TensorBoard profile-plugin format:
+    covers updates ``[start, stop)`` and lands in ``logdir`` in the
+    TensorBoard profile-plugin format.  Extensions only run *between*
+    updates, so the earliest capturable update is 2 (any ``start <= 2``
+    opens the trace at the same point, after update 1):
 
         trainer.extend(T.Profile(start=10, stop=13, comm=comm))
         ...
@@ -177,8 +179,8 @@ class Profile:
         # Extensions run AFTER the update increments trainer.iteration,
         # so to trace updates [start, stop) the trace must open once
         # update (start-1) has completed and close once update (stop-1)
-        # has.  (start=0 is unreachable this way; the first traceable
-        # update is 1.)
+        # has.  (The first traceable update is 2: the extension's first
+        # chance to open the trace is after update 1.)
         if not self._active and trainer.iteration >= self._start - 1:
             jax.profiler.start_trace(self._logdir)
             self._active = True

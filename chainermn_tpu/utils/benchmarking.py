@@ -1,0 +1,51 @@
+"""Measurement helpers that survive non-blocking backends.
+
+On some remote/tunneled device backends ``jax.block_until_ready``
+returns without waiting, so naive wall-clock timing measures dispatch,
+not execution.  These helpers force completion with a host *value
+readback* (which cannot return early — it needs the bytes) and time
+paired k/2k runs whose difference cancels the readback round-trip and
+any constant per-call overhead.  Used by ``bench.py`` and the scripts
+under ``benchmarks/``.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+
+def force_completion(x) -> float:
+    """Block until ``x`` is computed by reading one element back."""
+    return float(np.asarray(x).ravel()[0])
+
+
+def time_steps(run_fn, steps: int, warmup: int = 1) -> float:
+    """Seconds per step of ``run_fn`` via paired k / 2k timed runs.
+
+    ``run_fn()`` must return an array whose value depends on the step's
+    full computation (chain steps through a carried state so the final
+    readback transitively waits on every one).  At least one warmup call
+    always runs — it absorbs compilation and produces the value the
+    pre-timing readback synchronizes on.
+    """
+    steps = max(int(steps), 1)
+    out = None
+    for _ in range(max(int(warmup), 1)):
+        out = run_fn()
+    force_completion(out)
+
+    def timed(k):
+        t0 = time.perf_counter()
+        for _ in range(k):
+            out = run_fn()
+        force_completion(out)
+        return time.perf_counter() - t0
+
+    t1 = timed(steps)
+    t2 = timed(2 * steps)
+    dt = (t2 - t1) / steps
+    if dt <= 0:  # noise floor: fall back to the long run's average
+        dt = t2 / (2 * steps)
+    return dt

@@ -94,6 +94,72 @@ class TestFlashAttention:
             np.asarray(got), np.asarray(want), rtol=2e-4, atol=2e-5
         )
 
+    @pytest.mark.parametrize("causal", [False, True])
+    def test_gradients_ragged_lengths(self, causal):
+        # seq not a multiple of the block: padded rows/cols must not
+        # contribute to dq/dk/dv (the bwd kernels mask by q AND k index)
+        q, k, v = _qkv(s=23)
+
+        def f_ref(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v, causal=causal) ** 2)
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, causal, None, 16, 16, True) ** 2
+            )
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_flash):
+            assert np.isfinite(np.asarray(b)).all()
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-4
+            )
+
+    def test_gradients_cross_attention(self):
+        rng = np.random.RandomState(3)
+        q = jnp.asarray(rng.randn(2, 16, 2, 8), jnp.float32) * 0.3
+        k = jnp.asarray(rng.randn(2, 40, 2, 8), jnp.float32) * 0.3
+        v = jnp.asarray(rng.randn(2, 40, 2, 8), jnp.float32) * 0.3
+
+        def f_ref(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v) ** 2)
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, False, None, 16, 16, True) ** 2
+            )
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        g_flash = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_flash):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-4
+            )
+
+    def test_gradients_bf16(self):
+        q, k, v = _qkv(s=32)
+        qb, kb, vb = (t.astype(jnp.bfloat16) for t in (q, k, v))
+
+        def f_flash(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 16, 16, True)
+                .astype(jnp.float32) ** 2
+            )
+
+        g = jax.grad(f_flash, argnums=(0, 1, 2))(qb, kb, vb)
+
+        def f_ref(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v, causal=True) ** 2)
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g):
+            assert b.dtype == jnp.bfloat16
+            np.testing.assert_allclose(
+                np.asarray(b, np.float32), np.asarray(a),
+                rtol=1e-1, atol=5e-2,
+            )
+
     def test_bf16_inputs(self):
         q, k, v = _qkv()
         got = flash_attention(

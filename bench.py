@@ -29,7 +29,6 @@ BENCH_SMOKE=1 (tiny shapes, CPU-friendly smoke run).
 import json
 import os
 import sys
-import time
 
 try:  # installed package (pip install -e .)
     import chainermn_tpu  # noqa: F401

@@ -23,6 +23,7 @@ from .variants import (
     FlatCommunicator,
     HierarchicalCommunicator,
     HybridCommunicator,
+    MeshCommunicator,
     NaiveCommunicator,
     NonCudaAwareCommunicator,
     SingleNodeCommunicator,
@@ -44,6 +45,9 @@ _COMMUNICATORS = {
     "dummy": DummyCommunicator,
     # beyond the reference: 2-D data x model mesh for hybrid DP x TP
     "hybrid": HybridCommunicator,
+    # beyond the reference: 3-D data x seq x model mesh composing
+    # DP + SP (ring attention) + TP/EP in one program
+    "mesh": MeshCommunicator,
 }
 
 
@@ -58,11 +62,12 @@ def create_communicator(
     Args:
       communicator_name: one of ``tpu``, ``pure_nccl``, ``flat``,
         ``hierarchical``, ``two_dimensional``, ``single_node``, ``naive``,
-        ``non_cuda_aware``, ``dummy``, ``hybrid``.
+        ``non_cuda_aware``, ``dummy``, ``hybrid``, ``mesh``.
       devices: devices to span (default: all of ``jax.devices()``).
       allreduce_grad_dtype: optional reduced precision (e.g. ``bfloat16`` /
         ``float16``) for gradient allreduce, as in PureNcclCommunicator.
-      **kwargs: variant-specific options (e.g. ``tp_size`` for ``hybrid``).
+      **kwargs: variant-specific options (e.g. ``tp_size`` for ``hybrid``,
+        ``sp_size``/``tp_size`` for ``mesh``).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]
@@ -84,6 +89,7 @@ __all__ = [
     "FlatCommunicator",
     "HierarchicalCommunicator",
     "HybridCommunicator",
+    "MeshCommunicator",
     "TwoDimensionalCommunicator",
     "SingleNodeCommunicator",
     "NaiveCommunicator",

@@ -202,6 +202,78 @@ class HybridCommunicator(XlaCommunicatorBase):
         return self.split([d for d, _ in self._mesh_coords()])
 
 
+class MeshCommunicator(XlaCommunicatorBase):
+    """3-D (data x seq x model) mesh for fully composed parallelism.
+
+    The general form of :class:`HybridCommunicator`: one mesh whose axes
+    carry every parallelism family the framework offers at once —
+
+    * ``mn_data``  — batch sharding + gradient psum (DP; reference's
+      allreduce communicators, SURVEY.md section 2 #5-12),
+    * ``mn_seq``   — sequence/context parallelism: ring attention's
+      ppermute ring and sp_lm_loss's boundary exchange ride this axis
+      (SURVEY.md section 5.7 — the capability the reference's p2p layer
+      points at),
+    * ``mn_model`` — tensor-parallel column/row collectives AND the
+      expert-parallel all_to_all (Megatron TP + MoE EP share the axis;
+      attention/MLP shard over it, MoE layers split tokens over it).
+
+    ``size`` must equal ``dp * sp * tp``; ``dp`` is inferred.  Axes of
+    width 1 are legal (a (n,1,1) mesh is plain DP), so a single code path
+    covers every factorization — which is also how the mesh-factorization
+    oracle tests work: the SAME composed model run on ``(n,1,1)`` and
+    ``(a,b,c)`` meshes must produce identical numerics.
+    """
+
+    def __init__(self, devices=None, allreduce_grad_dtype=None,
+                 sp_size: int = 1, tp_size: int = 1, **kw):
+        self._sp_size = int(sp_size)
+        self._tp_size = int(tp_size)
+        super().__init__(devices, allreduce_grad_dtype, **kw)
+
+    def _build_mesh(self) -> Mesh:
+        n, sp, tp = self.size, self._sp_size, self._tp_size
+        if sp < 1 or tp < 1 or n % (sp * tp):
+            raise ValueError(
+                f"sp_size*tp_size ({sp}*{tp}) must divide the chip "
+                f"count {n}"
+            )
+        dp = n // (sp * tp)
+        try:
+            from jax.experimental import mesh_utils
+
+            grid = mesh_utils.create_device_mesh(
+                (dp, sp, tp), devices=list(self.devices)
+            )
+        except Exception:
+            grid = np.array(self.devices, dtype=object).reshape(dp, sp, tp)
+        return Mesh(grid, ("mn_data", "mn_seq", "mn_model"))
+
+    @property
+    def data_axis_names(self) -> tuple:
+        return ("mn_data",)
+
+    @property
+    def seq_axis_name(self) -> str:
+        return "mn_seq"
+
+    @property
+    def model_axis_name(self) -> str:
+        return "mn_model"
+
+    @property
+    def dp_size(self) -> int:
+        return self.size // (self._sp_size * self._tp_size)
+
+    @property
+    def sp_size(self) -> int:
+        return self._sp_size
+
+    @property
+    def tp_size(self) -> int:
+        return self._tp_size
+
+
 class NonCudaAwareCommunicator(XlaCommunicatorBase):
     """Host-staged collectives (device -> host -> reduce -> device).
 

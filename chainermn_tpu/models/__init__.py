@@ -35,4 +35,9 @@ def __getattr__(name):
         from . import transformer
 
         return getattr(transformer, name)
+    if name in ("MoeTransformerLM", "MoeTransformerBlock", "MoeMlp",
+                "moe_lm_loss", "moe_param_specs"):
+        from . import moe_transformer
+
+        return getattr(moe_transformer, name)
     raise AttributeError(name)

@@ -22,6 +22,7 @@ Design:
 
 from __future__ import annotations
 
+import functools
 from typing import Any, Callable, Optional
 
 import jax
@@ -43,9 +44,16 @@ class MlpBlock(nn.Module):
 
 
 class SelfAttention(nn.Module):
+    """Causal self-attention; optionally tensor-parallel over ``tp_axis``
+    (heads sharded Megatron-style: column-parallel q/k/v projections, one
+    row-parallel psum on the output projection) and/or sequence-parallel
+    over ``seq_axis`` (ring attention).  The two compose: each chip then
+    holds its head shard of its sequence shard."""
+
     n_heads: int
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -54,11 +62,36 @@ class SelfAttention(nn.Module):
         if d % self.n_heads:
             raise ValueError(f"d_model ({d}) % n_heads ({self.n_heads})")
         dh = d // self.n_heads
-        qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, s, self.n_heads, dh)
-        k = k.reshape(b, s, self.n_heads, dh)
-        v = v.reshape(b, s, self.n_heads, dh)
+        heads = self.n_heads
+        if self.tp_axis is not None:
+            from chainermn_tpu.parallel import (
+                ColumnParallelDense,
+                RowParallelDense,
+            )
+
+            ntp = lax.axis_size(self.tp_axis)
+            if heads % ntp:
+                raise ValueError(
+                    f"n_heads ({heads}) not divisible by the "
+                    f"'{self.tp_axis}' axis size ({ntp})"
+                )
+            heads = heads // ntp  # local heads
+            # Auto-generated module names (ColumnParallelDense_0/1/2 =
+            # q/k/v) keep the param tree spec-derivable without name
+            # markers that could collide with user modules.
+            col = functools.partial(
+                ColumnParallelDense, axis_name=self.tp_axis,
+                use_bias=False, dtype=self.dtype,
+            )
+            q = col(d)(x)
+            k = col(d)(x)
+            v = col(d)(x)
+        else:
+            qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, heads, dh)
+        k = k.reshape(b, s, heads, dh)
+        v = v.reshape(b, s, heads, dh)
         if self.seq_axis is not None:
             from chainermn_tpu.parallel import ring_attention
 
@@ -69,8 +102,38 @@ class SelfAttention(nn.Module):
             from chainermn_tpu.ops import multi_head_attention
 
             out = multi_head_attention(q, k, v, causal=causal)
-        out = out.reshape(b, s, d)
+        out = out.reshape(b, s, heads * dh)
+        if self.tp_axis is not None:
+            return RowParallelDense(
+                d, axis_name=self.tp_axis, use_bias=False,
+                dtype=self.dtype,
+            )(out)
         return nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
+
+
+class TpMlpBlock(nn.Module):
+    """Megatron MLP: column-parallel up-projection -> gelu ->
+    row-parallel down-projection — exactly one psum per block."""
+
+    d_ff: int
+    tp_axis: str = "mn_model"
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x):
+        from chainermn_tpu.parallel import (
+            ColumnParallelDense,
+            RowParallelDense,
+        )
+
+        d = x.shape[-1]
+        h = ColumnParallelDense(
+            self.d_ff, axis_name=self.tp_axis, dtype=self.dtype,
+        )(x)
+        h = nn.gelu(h)
+        return RowParallelDense(
+            d, axis_name=self.tp_axis, dtype=self.dtype,
+        )(h)
 
 
 class TransformerBlock(nn.Module):
@@ -78,6 +141,7 @@ class TransformerBlock(nn.Module):
     d_ff: int
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -85,11 +149,14 @@ class TransformerBlock(nn.Module):
         ln = lambda: nn.LayerNorm(dtype=jnp.float32)
         x = x + SelfAttention(
             self.n_heads, dtype=self.dtype, seq_axis=self.seq_axis,
-            attention_fn=self.attention_fn,
+            tp_axis=self.tp_axis, attention_fn=self.attention_fn,
         )(ln()(x).astype(self.dtype))
-        x = x + MlpBlock(self.d_ff, dtype=self.dtype)(
-            ln()(x).astype(self.dtype)
-        )
+        if self.tp_axis is not None:
+            mlp = TpMlpBlock(self.d_ff, tp_axis=self.tp_axis,
+                             dtype=self.dtype)
+        else:
+            mlp = MlpBlock(self.d_ff, dtype=self.dtype)
+        x = x + mlp(ln()(x).astype(self.dtype))
         return x
 
 
@@ -109,6 +176,7 @@ class TransformerLM(nn.Module):
     max_len: int = 2048
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
+    tp_axis: Optional[str] = None
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -148,7 +216,8 @@ class TransformerLM(nn.Module):
         for _ in range(self.n_layers):
             x = TransformerBlock(
                 self.n_heads, d_ff, dtype=self.dtype,
-                seq_axis=self.seq_axis, attention_fn=self.attention_fn,
+                seq_axis=self.seq_axis, tp_axis=self.tp_axis,
+                attention_fn=self.attention_fn,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Weight-tied head.

@@ -309,6 +309,7 @@ def build_train_step(
     *,
     data_axes: Optional[tuple] = None,
     param_specs=None,
+    batch_specs=None,
     donate: bool = True,
     use_shard_map: bool = True,
     has_aux: bool = False,
@@ -359,12 +360,19 @@ def build_train_step(
     Not combinable with ``zero_redundancy`` optimizers or
     ``allreduce_grad_dtype`` wire compression (sync happens inside
     autodiff at full precision).
+
+    ``batch_specs``: override the default leading-axis-over-data-axes
+    batch layout with an explicit PartitionSpec (applied to every batch
+    leaf).  The composed-parallelism case: a sequence-parallel LM on a
+    ``MeshCommunicator`` shards tokens ``(batch, seq)`` as
+    ``P('mn_data', 'mn_seq')`` — batch rows over the data axis AND
+    sequence positions over the seq axis.
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     mesh = comm.mesh
     axes = tuple(data_axes or comm.axis_names)
-    batch_spec = P(axes)
+    batch_spec = P(axes) if batch_specs is None else batch_specs
     rep = NamedSharding(mesh, P())
     batch_sharding = NamedSharding(mesh, batch_spec)
 
@@ -529,9 +537,20 @@ def build_train_step(
                 out_shardings=(pshardings, state_shardings, rep),
             )
 
-    n_shards = 1
-    for a in axes:
-        n_shards *= dict(mesh.shape)[a]
+    def _axis_prod(names):
+        if names is None:
+            return 1
+        if isinstance(names, str):
+            names = (names,)
+        n = 1
+        for a in names:
+            n *= dict(mesh.shape)[a]
+        return n
+
+    if batch_specs is None:
+        n_shards = _axis_prod(axes)
+    else:  # leading-dim divisibility is set by the spec's first entry
+        n_shards = _axis_prod(batch_spec[0] if len(batch_spec) else None)
     n_procs = comm.process_count
     local_shards = max(n_shards // n_procs, 1)
 

@@ -5,6 +5,7 @@ from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense,
     RowParallelDense,
     megatron_param_specs,
+    sharded_init,
 )
 from .expert_parallel import (  # noqa: F401
     expert_parallel_moe,
@@ -22,6 +23,7 @@ __all__ = [
     "ColumnParallelDense",
     "RowParallelDense",
     "megatron_param_specs",
+    "sharded_init",
     "expert_parallel_moe",
     "mlp_experts",
     "top_k_routing",

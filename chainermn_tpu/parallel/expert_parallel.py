@@ -86,7 +86,8 @@ def top_k_routing(
 
 
 def load_balancing_loss(probs: jnp.ndarray,
-                        raw_routes: jnp.ndarray) -> jnp.ndarray:
+                        raw_routes: jnp.ndarray,
+                        axes=None) -> jnp.ndarray:
     """Switch-style auxiliary loss: num_experts * <fraction routed to e> ·
     <mean router prob of e>, minimized at uniform routing.
 
@@ -94,11 +95,24 @@ def load_balancing_loss(probs: jnp.ndarray,
     :func:`top_k_routing`: counting only surviving dispatches would make a
     collapsed router score *better* once its queue overflows (dropped
     claims would vanish from the fraction).
+
+    ``axes``: mesh axes to average the *statistics* (per-expert routed
+    fraction and mean router probability) over before forming the
+    product.  Averaging statistics — not per-slice losses — makes the
+    result exactly the whole-population loss, i.e. invariant to how
+    tokens are split across those axes (a mean of per-slice products
+    would not be).  Token counts per shard must be equal (they are, on a
+    mesh).  ``None`` computes the local-slice loss.
     """
     e = probs.shape[-1]
-    k = jnp.maximum(jnp.sum(raw_routes) / raw_routes.shape[0], 1.0)
-    frac = jnp.mean(raw_routes, axis=0) / k
+    routes_per_tok = jnp.sum(raw_routes) / raw_routes.shape[0]
+    frac_raw = jnp.mean(raw_routes, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
+    if axes:
+        routes_per_tok = lax.pmean(routes_per_tok, axes)
+        frac_raw = lax.pmean(frac_raw, axes)
+        mean_prob = lax.pmean(mean_prob, axes)
+    frac = frac_raw / jnp.maximum(routes_per_tok, 1.0)
     return e * jnp.sum(frac * mean_prob)
 
 
@@ -112,6 +126,7 @@ def expert_parallel_moe(
     k: int = 2,
     capacity_factor: float = 1.25,
     capacity: Optional[int] = None,
+    aux_stat_axes=None,
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One expert-parallel MoE layer.  Call inside ``shard_map``.
 
@@ -122,10 +137,16 @@ def expert_parallel_moe(
         this chip's experts to their gathered queues (vmapped MLP etc.).
       axis_name: mesh axis the experts are sharded over.
       num_experts: total experts; divisible by the axis size.
+      aux_stat_axes: mesh axes over which the load-balancing *statistics*
+        are averaged before forming the loss (see
+        :func:`load_balancing_loss`).  Defaults to ``(axis_name,)``;
+        pass every token-splitting axis (data/seq/expert) to make the
+        aux loss exactly the global-batch value, invariant to mesh
+        factorization.
     Returns:
       (y, aux_loss): y (tokens, d) combined expert outputs (dropped tokens
       get zeros — add the residual outside); aux_loss the load-balancing
-      scalar (pmean'd over the axis).
+      scalar (identical on every chip of the stat axes).
     """
     n = lax.axis_size(axis_name)
     if num_experts % n:
@@ -144,7 +165,10 @@ def expert_parallel_moe(
         axis=-1,
     )
     dispatch, combine, raw_routes = top_k_routing(probs, k, cap)
-    aux = lax.pmean(load_balancing_loss(probs, raw_routes), axis_name)
+    stat_axes = (axis_name,) if aux_stat_axes is None else tuple(
+        aux_stat_axes
+    )
+    aux = load_balancing_loss(probs, raw_routes, axes=stat_axes)
 
     # Local queues: (num_experts, cap, d)
     dispatched = jnp.einsum("td,tec->ecd", x, dispatch.astype(x.dtype))

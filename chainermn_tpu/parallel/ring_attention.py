@@ -99,9 +99,13 @@ def ring_attention(
         vb = lax.ppermute(vb, axis_name, perm)
         return (kb, vb, num, den, new_m), None
 
-    num0 = jnp.zeros((b, s_q, h, d), q.dtype)
-    den0 = jnp.zeros((b, h, s_q, 1), q.dtype)
-    m0 = jnp.full((b, h, s_q, 1), neg, q.dtype)
+    # Derive the initial carries from q (x*0 keeps the varying-manual-axes
+    # marking that fresh zeros would lack — required by vma-checked
+    # shard_map, whose scan demands carry-in/carry-out vma equality).
+    num0 = q * 0
+    col0 = jnp.swapaxes(q[..., :1] * 0, 1, 2)  # (b, h, s_q, 1), q's vma
+    den0 = col0
+    m0 = col0 + neg
     (_, _, num, den, _), _ = lax.scan(
         body, (k, v, num0, den0, m0), jnp.arange(n)
     )

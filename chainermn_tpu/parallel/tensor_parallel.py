@@ -96,10 +96,39 @@ class RowParallelDense(nn.Module):
         return y
 
 
+# Extra markers the spec-derivation helpers recognize as column-/row-
+# parallel owners, matched against a flax path segment EXACTLY.
+# "ColumnParallel"/"RowParallel" always match as substrings (covering
+# every auto-generated name like "ColumnParallelDense_0" — which is why
+# the in-repo transformer modules deliberately do NOT rename their TP
+# projections).  Users who do pass ``name=`` can register those names
+# here; exact matching keeps an unrelated module named e.g. "audio_proj"
+# from being silently mis-sharded.  Or build the spec tree by hand — it
+# is plain data.
+COLUMN_PARALLEL_NAMES: tuple = ()
+ROW_PARALLEL_NAMES: tuple = ()
+
+
+def _path_keys(path):
+    keys = [getattr(k, "key", getattr(k, "name", None)) for k in path]
+    return [k for k in keys if isinstance(k, str)]
+
+
+def _tp_owner_kind(keys) -> Optional[str]:
+    """'col' / 'row' / None for a flax param path, innermost match wins."""
+    for k in reversed(keys):
+        if "ColumnParallel" in k or k in COLUMN_PARALLEL_NAMES:
+            return "col"
+        if "RowParallel" in k or k in ROW_PARALLEL_NAMES:
+            return "row"
+    return None
+
+
 def megatron_param_specs(params, model_axis: str = "tp"):
     """Derive the ``param_specs`` pytree for ``build_train_step``'s hybrid
     DP x TP mode from a parameter tree containing Column/RowParallelDense
-    modules (recognized by their auto-generated flax path names).
+    modules (auto-generated names matched by substring, plus the exact
+    path segments in ``COLUMN_PARALLEL_NAMES`` / ``ROW_PARALLEL_NAMES``).
 
     Column kernels shard their output features (``P(None, axis)``, bias
     ``P(axis)``); Row kernels shard their input features
@@ -111,26 +140,50 @@ def megatron_param_specs(params, model_axis: str = "tp"):
     import jax.tree_util as jtu
 
     def leaf_spec(path, leaf):
-        keys = [
-            getattr(k, "key", getattr(k, "name", None)) for k in path
-        ]
-        keys = [k for k in keys if isinstance(k, str)]
-        owner = next(
-            (
-                k
-                for k in reversed(keys)
-                if "ColumnParallel" in k or "RowParallel" in k
-            ),
-            None,
-        )
+        keys = _path_keys(path)
         last = keys[-1] if keys else ""
-        if owner and "ColumnParallel" in owner:
+        kind = _tp_owner_kind(keys)
+        if kind == "col":
             return P(None, model_axis) if last == "kernel" else P(model_axis)
-        if owner and "RowParallel" in owner:
+        if kind == "row":
             return P(model_axis, None) if last == "kernel" else P()
         return P()
 
     return jtu.tree_map_with_path(leaf_spec, params)
+
+
+def sharded_init(init_fn: Callable, mesh, in_specs, param_specs_fn,
+                 *args):
+    """Initialize a model whose parameters live sharded on ``mesh``.
+
+    Runs ``init_fn(*args) -> params`` per-shard under ``shard_map`` twice:
+    once abstractly (``eval_shape``) to discover the parameter tree, once
+    for real with ``out_specs = param_specs_fn(abstract_params)`` so
+    sharded leaves (TP kernels, expert blocks) assemble into global arrays
+    while replicated leaves stay replicated.  Returns ``(params, specs)``
+    — feed both to ``build_train_step(param_specs=specs)``.
+
+    ``in_specs``: PartitionSpec(s) for ``*args`` (e.g. the sample batch's
+    layout).  ``init_fn`` typically closes over the module and RNG key:
+    ``lambda x: model.init(jax.random.PRNGKey(0), x)``.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    abstract = jax.eval_shape(
+        jax.shard_map(
+            init_fn, mesh=mesh, in_specs=in_specs, out_specs=P(),
+            check_vma=False,
+        ),
+        *args,
+    )
+    specs = param_specs_fn(abstract)
+    params = jax.jit(
+        jax.shard_map(
+            init_fn, mesh=mesh, in_specs=in_specs, out_specs=specs,
+            check_vma=False,
+        )
+    )(*args)
+    return params, specs
 
 
 def _sharded_init(init: Callable, axis_name: str) -> Callable:

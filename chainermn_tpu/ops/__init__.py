@@ -2,13 +2,14 @@ from .losses import softmax_cross_entropy, accuracy  # noqa: F401
 from .attention import multi_head_attention  # noqa: F401
 
 __all__ = ["softmax_cross_entropy", "accuracy", "multi_head_attention",
-           "flash_attention", "flash_attention_fn", "fused_cast_scale"]
+           "flash_attention", "flash_attention_with_lse",
+           "flash_attention_fn", "fused_cast_scale"]
 
 
 def __getattr__(name):
     # Pallas kernels load lazily (experimental namespace).
-    if name in ("flash_attention", "flash_attention_fn",
-                "fused_cast_scale"):
+    if name in ("flash_attention", "flash_attention_with_lse",
+                "flash_attention_fn", "fused_cast_scale"):
         from . import pallas_attention
 
         return getattr(pallas_attention, name)

@@ -314,8 +314,15 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
     static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
 )
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret):
-    """(b, s, h, d)-layout backward via the two kernels above."""
+                    interpret, g_lse=None):
+    """(b, s, h, d)-layout backward via the two kernels above.
+
+    ``g_lse``: optional (b*h, s_q) cotangent of the log-sum-exp output
+    (for :func:`flash_attention_with_lse`).  Since
+    ``d lse_i / d s_ij = p_ij``, the lse cotangent enters the score
+    gradient as ``ds += p * g_lse`` — algebraically identical to
+    replacing ``delta`` with ``delta - g_lse``, so the kernels are
+    reused unchanged."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     bq = _effective_q_block(block_q, s_q, interpret)
@@ -337,6 +344,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     delta = jnp.sum(
         dob.astype(jnp.float32) * ob.astype(jnp.float32), axis=-1
     )  # (bh, s_qp)
+    if g_lse is not None:
+        pad_d = s_qp - s_q
+        gl = jnp.pad(g_lse, ((0, 0), (0, pad_d))) if pad_d else g_lse
+        delta = delta - gl.astype(jnp.float32)
     pad_q = s_qp - s_q
     lse_p = jnp.pad(lse, ((0, 0), (0, pad_q))) if pad_q else lse
     # 8-sublane broadcast layout (TPU blocks need sublane-dim % 8 == 0)
@@ -454,6 +465,90 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
+
+
+def _dense_attention_with_lse(q, k, v, causal, scale):
+    """Plain-JAX (out, lse) attention — the differentiable small-shape
+    fallback for :func:`flash_attention_with_lse` (fp32 softmax)."""
+    b, s_q, h, d = q.shape
+    s_k = k.shape[1]
+    s = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k.astype(jnp.float32)
+    ) * scale
+    if causal:
+        qi = lax.broadcasted_iota(jnp.int32, (s_q, s_k), 0)
+        kj = lax.broadcasted_iota(jnp.int32, (s_q, s_k), 1)
+        s = jnp.where((kj <= qi)[None, None], s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    den = jnp.sum(p, axis=-1, keepdims=True)
+    out = jnp.einsum("bhqk,bkhd->bqhd", p / den, v.astype(jnp.float32))
+    lse = (m + jnp.log(den))[..., 0]  # (b, h, s_q)
+    return out.astype(q.dtype), jnp.moveaxis(lse, 1, 2)  # lse (b, s_q, h)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def flash_attention_with_lse(q, k, v, causal=False, scale=None,
+                             block_q=256, block_k=512, interpret=None):
+    """Flash attention returning ``(out, lse)`` with BOTH outputs
+    differentiable — ``lse`` is the per-row log-sum-exp of the scaled
+    scores, shaped (b, s_q, h).
+
+    This is the building block for blockwise/ring composition
+    (:func:`chainermn_tpu.parallel.ring_attention` with
+    ``use_flash=True``): partial outputs over K/V blocks merge exactly
+    via their lse, and gradients flow through the merge weights because
+    the lse VJP is folded into the same backward kernels (see
+    ``_flash_backward``'s ``g_lse``)."""
+    out, lse = _flash_with_lse_fwd_rule(
+        q, k, v, causal, scale, block_q, block_k, interpret
+    )[0]
+    return out, lse
+
+
+def _flash_with_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k,
+                             interpret):
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    interp = _should_interpret(interpret)
+    if not PALLAS_AVAILABLE or (
+        not interp and (q.shape[1] < 128 or k.shape[1] < 128)
+    ):
+        # Sub-lane-tile compiled shapes: dense path for value AND grads.
+        out, lse = _dense_attention_with_lse(q, k, v, causal, scale)
+        return (out, lse), (q, k, v, None, None)
+    out, lse_bh = _flash_forward(q, k, v, causal, scale, block_q,
+                                 block_k, interp)
+    b, s_q, h, _ = q.shape
+    lse = jnp.moveaxis(lse_bh.reshape(b, h, s_q), 1, 2)  # (b, s_q, h)
+    return (out, lse), (q, k, v, out, lse_bh)
+
+
+def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
+                             residuals, g):
+    q, k, v, out, lse_bh = residuals
+    g_out, g_lse = g
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    if out is None:  # dense fallback residuals
+        _, vjp = jax.vjp(
+            lambda q, k, v: _dense_attention_with_lse(
+                q, k, v, causal, scale
+            ),
+            q, k, v,
+        )
+        return vjp((g_out, g_lse))
+    b, s_q, h, _ = q.shape
+    g_lse_bh = jnp.moveaxis(g_lse, 1, 2).reshape(b * h, s_q)
+    return _flash_backward(
+        q, k, v, out, lse_bh, g_out, causal, scale, block_q, block_k,
+        _should_interpret(interpret), g_lse=g_lse_bh,
+    )
+
+
+flash_attention_with_lse.defvjp(
+    _flash_with_lse_fwd_rule, _flash_with_lse_bwd_rule
+)
 
 
 def flash_attention_fn(block_q: int = 256, block_k: int = 512,

@@ -40,6 +40,80 @@ def _block_attend(q, k, v, bias, scale):
     return num, den, m
 
 
+_BLOCK_NEG = -1e30  # finite "minus infinity": exp() underflows cleanly
+
+
+def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
+                interpret):
+    """Ring attention with the Pallas flash kernel as the per-block core.
+
+    Each ring step runs :func:`flash_attention_with_lse` on the resident
+    queries against the circulating K/V block; partial outputs merge
+    exactly via their log-sum-exp.  Causality at block granularity: the
+    diagonal block (owner == self) runs the kernel's causal mode, blocks
+    entirely in the past run full attention, blocks entirely in the
+    future are skipped (a runtime branch — each chip takes its own).
+    Gradients flow through the merge weights because the lse output is
+    differentiable (its VJP rides the same backward kernels).
+    """
+    from chainermn_tpu.ops.pallas_attention import flash_attention_with_lse
+
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
+
+    def block(kb, vb, blk_causal):
+        return flash_attention_with_lse(
+            q, kb, vb, blk_causal, scale, block_q, block_k, interpret
+        )
+
+    def step_out(kb, vb, owner):
+        if not causal:
+            return block(kb, vb, False)
+
+        def diag(args):
+            return block(*args, True)
+
+        def full(args):
+            return block(*args, False)
+
+        def skip(args):
+            del args
+            o = (q * 0).astype(q.dtype)
+            # (b, s, h) in the kernel's fp32 lse dtype, q's vma
+            lse = (q[..., 0] * 0).astype(jnp.float32) + _BLOCK_NEG
+            return o, lse
+
+        return lax.cond(
+            owner == my, diag,
+            lambda a: lax.cond(owner < my, full, skip, a),
+            (kb, vb),
+        )
+
+    def body(carry, step):
+        kb, vb, o, lse = carry
+        owner = (my - step) % n
+        o_b, lse_b = step_out(kb, vb, owner)
+        # exact two-way online-softmax merge via log-sum-exp
+        m = jnp.maximum(lse, lse_b)
+        w = jnp.exp(lse - m)
+        w_b = jnp.exp(lse_b - m)
+        den = w + w_b
+        o = (
+            o * (w / den)[..., None]
+            + o_b.astype(jnp.float32) * (w_b / den)[..., None]
+        )
+        lse = m + jnp.log(den)
+        perm = [(i, (i + 1) % n) for i in range(n)]
+        kb = lax.ppermute(kb, axis_name, perm)
+        vb = lax.ppermute(vb, axis_name, perm)
+        return (kb, vb, o, lse), None
+
+    o0 = (q * 0).astype(jnp.float32)
+    lse0 = (q[..., 0] * 0).astype(jnp.float32) + _BLOCK_NEG
+    (_, _, o, _), _ = lax.scan(body, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype)
+
+
 def ring_attention(
     q: jnp.ndarray,
     k: jnp.ndarray,
@@ -48,6 +122,10 @@ def ring_attention(
     *,
     causal: bool = False,
     scale: Optional[float] = None,
+    use_flash: Optional[bool] = None,
+    block_q: int = 256,
+    block_k: int = 512,
+    interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded along ``axis_name``.
 
@@ -57,15 +135,35 @@ def ring_attention(
         sequence axis bound to ``axis_name``.
       causal: apply a causal mask consistent with the *global* sequence
         order (shard r holds positions [r*S, (r+1)*S)).
+      use_flash: run each per-block attention through the Pallas flash
+        kernel (:func:`~chainermn_tpu.ops.flash_attention_with_lse`),
+        merging blocks via their log-sum-exp — the long-context
+        performance tier.  ``None`` auto-selects: flash on a TPU backend
+        when the local sequence shard fills a lane tile (>= 128).
+        ``block_q``/``block_k``/``interpret`` configure the kernel.
     Returns:
       (batch, seq_shard, heads, head_dim) attention output for the local
       queries, numerically identical to full attention over the gathered
       sequence.
     """
-    n = lax.axis_size(axis_name)
-    my = lax.axis_index(axis_name)
+    if use_flash is None:
+        try:
+            from chainermn_tpu.ops.pallas_attention import PALLAS_AVAILABLE
+        except ImportError:  # pragma: no cover
+            PALLAS_AVAILABLE = False
+        use_flash = (
+            PALLAS_AVAILABLE
+            and jax.default_backend() == "tpu"
+            and q.shape[1] >= 128
+            and k.shape[1] >= 128
+        )
     if scale is None:
         scale = q.shape[-1] ** -0.5
+    if use_flash:
+        return _ring_flash(q, k, v, axis_name, causal, scale, block_q,
+                           block_k, interpret)
+    n = lax.axis_size(axis_name)
+    my = lax.axis_index(axis_name)
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
 

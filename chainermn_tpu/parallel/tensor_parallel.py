@@ -124,6 +124,22 @@ def _tp_owner_kind(keys) -> Optional[str]:
     return None
 
 
+def _tp_leaf_spec(keys, model_axis):
+    """The Megatron sharding convention for one flax param path, or None
+    when the leaf belongs to no column/row-parallel owner: column kernels
+    shard output features (``P(None, axis)``, bias ``P(axis)``), row
+    kernels shard input features (``P(axis, None)``, bias replicated)."""
+    from jax.sharding import PartitionSpec as P
+
+    last = keys[-1] if keys else ""
+    kind = _tp_owner_kind(keys)
+    if kind == "col":
+        return P(None, model_axis) if last == "kernel" else P(model_axis)
+    if kind == "row":
+        return P(model_axis, None) if last == "kernel" else P()
+    return None
+
+
 def megatron_param_specs(params, model_axis: str = "tp"):
     """Derive the ``param_specs`` pytree for ``build_train_step``'s hybrid
     DP x TP mode from a parameter tree containing Column/RowParallelDense
@@ -140,14 +156,8 @@ def megatron_param_specs(params, model_axis: str = "tp"):
     import jax.tree_util as jtu
 
     def leaf_spec(path, leaf):
-        keys = _path_keys(path)
-        last = keys[-1] if keys else ""
-        kind = _tp_owner_kind(keys)
-        if kind == "col":
-            return P(None, model_axis) if last == "kernel" else P(model_axis)
-        if kind == "row":
-            return P(model_axis, None) if last == "kernel" else P()
-        return P()
+        spec = _tp_leaf_spec(_path_keys(path), model_axis)
+        return P() if spec is None else spec
 
     return jtu.tree_map_with_path(leaf_spec, params)
 

@@ -401,41 +401,24 @@ def config_resnet50_mnbn():
     return out
 
 
-def config_transformer_lm():
-    """Beyond the reference's workloads: decoder-only LM with the Pallas
-    flash-attention kernel — the matmul-heavy config where MFU should
-    approach the chip's practical ceiling."""
+def _bench_lm(model, loss_fn, comm, *, batch, seq, vocab,
+              with_flops=False):
+    """Shared LM-config scaffold: init + broadcast, adamw multi-node
+    step, resident token batch, honest paired-run timing.  Returns
+    (tokens_per_sec_per_chip, step_time_s, flops_dict)."""
     import jax
     import jax.numpy as jnp
     import numpy as np
     import optax
 
     import chainermn_tpu as cmn
-    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
-    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
 
-    comm = cmn.create_communicator("tpu")
-    vocab = 2048 if SMOKE else 32768
-    d_model = 128 if SMOKE else 1024
-    n_layers = 2 if SMOKE else 8
-    seq = 128 if SMOKE else 2048
-    batch = _env("BENCH_LM_BATCH", 2 if SMOKE else 8) * comm.size
     steps = _env("BENCH_STEPS", 3 if SMOKE else 10)
-
-    model = TransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
-        n_layers=n_layers, max_len=seq,
-        attention_fn=None if SMOKE else flash_attention_fn(),
-    )
     toks0 = jnp.zeros((1, seq), jnp.int32)
     params = comm.bcast_data(model.init(jax.random.PRNGKey(0), toks0))
     opt = cmn.create_multi_node_optimizer(
         optax.adamw(3e-4, weight_decay=0.01), comm
     )
-
-    def loss_fn(p, batch):
-        return lm_loss(model.apply(p, batch), batch)
-
     step = cmn.build_train_step(comm, loss_fn, opt)
     params, opt_state = step.place(params, opt.init(params))
     toks = jnp.asarray(
@@ -449,24 +432,127 @@ def config_transformer_lm():
         return m["loss"]
 
     step_time = _time_steps(run, steps, 2)
-    tokens = batch * seq
-    flops = _flops_of(step.get_jitted(params, opt_state), params, opt_state,
-                      bt)
-    peak = _peak_flops(comm.devices[0])
-    out = {
+    extra = {}
+    if with_flops:
+        flops = _flops_of(
+            step.get_jitted(params, opt_state), params, opt_state, bt
+        )
+        peak = _peak_flops(comm.devices[0])
+        if flops:
+            extra["model_tflops_per_step"] = round(flops / 1e12, 2)
+            if peak:
+                extra["mfu"] = round(
+                    flops / step_time / (peak * comm.size), 4
+                )
+    tps = batch * seq / step_time / comm.size
+    return tps, step_time, extra
+
+
+def _lm_dims():
+    vocab = 2048 if SMOKE else 32768
+    d_model = 128 if SMOKE else 1024
+    n_layers = 2 if SMOKE else 8
+    return vocab, d_model, n_layers
+
+
+def config_transformer_lm():
+    """Beyond the reference's workloads: decoder-only LM with the Pallas
+    flash-attention kernel — the matmul-heavy config where MFU should
+    approach the chip's practical ceiling."""
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    comm = cmn.create_communicator("tpu")
+    vocab, d_model, n_layers = _lm_dims()
+    seq = 128 if SMOKE else 2048
+    batch = _env("BENCH_LM_BATCH", 2 if SMOKE else 8) * comm.size
+    model = TransformerLM(
+        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, max_len=seq,
+        attention_fn=None if SMOKE else flash_attention_fn(),
+    )
+    tps, step_time, extra = _bench_lm(
+        model, lambda p, b: lm_loss(model.apply(p, b), b), comm,
+        batch=batch, seq=seq, vocab=vocab, with_flops=True,
+    )
+    return {
         "metric": "transformer_lm_tokens_per_sec_per_chip",
-        "value": round(tokens / step_time / comm.size, 1),
+        "value": round(tps, 1),
         "unit": "tokens/sec/chip (flash attention, bf16)",
         "step_time_ms": round(step_time * 1e3, 2),
         "seq_len": seq,
         "d_model": d_model,
         "n_layers": n_layers,
+        **extra,
     }
-    if flops:
-        out["model_tflops_per_step"] = round(flops / 1e12, 2)
-        if peak:
-            out["mfu"] = round(flops / step_time / (peak * comm.size), 4)
-    return out
+
+
+def config_transformer_lm_long():
+    """Long-context tier: seq 8192 where XLA's fused attention OOMs on
+    this chip — the flash kernel is what makes the config exist at all
+    (docs/performance.md).  Batch 1, same 8L/1024d model."""
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    comm = cmn.create_communicator("tpu")
+    vocab, d_model, n_layers = _lm_dims()
+    seq = 256 if SMOKE else 8192
+    batch = _env("BENCH_LM_LONG_BATCH", 1) * comm.size
+    model = TransformerLM(
+        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, max_len=seq,
+        attention_fn=None if SMOKE else flash_attention_fn(),
+    )
+    tps, step_time, _ = _bench_lm(
+        model, lambda p, b: lm_loss(model.apply(p, b), b), comm,
+        batch=batch, seq=seq, vocab=vocab,
+    )
+    return {
+        "metric": "transformer_lm_seq8192_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip (flash attention, bf16, seq 8192)",
+        "step_time_ms": round(step_time * 1e3, 2),
+        "seq_len": seq,
+    }
+
+
+def config_moe_lm():
+    """MoE tier: GShard-style top-2 routed experts every other block
+    (models/moe_transformer.py) — measures the routing + expert-compute
+    machinery; on one chip the expert exchange degenerates (the EP
+    all_to_all path is exercised by tests and dryrun_multichip)."""
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.moe_transformer import (
+        MoeTransformerLM,
+        moe_lm_loss,
+    )
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    comm = cmn.create_communicator("tpu")
+    vocab, d_model, n_layers = _lm_dims()
+    n_experts = 4 if SMOKE else 8
+    seq = 128 if SMOKE else 2048
+    batch = _env("BENCH_MOE_BATCH", 2) * comm.size
+    model = MoeTransformerLM(
+        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        n_layers=n_layers, n_experts=n_experts, moe_every=2, k=2,
+        max_len=seq,
+        attention_fn=None if SMOKE else flash_attention_fn(),
+    )
+    tps, step_time, _ = _bench_lm(
+        model,
+        lambda p, b: moe_lm_loss(model.apply(p, b), b, aux_coef=1e-2),
+        comm, batch=batch, seq=seq, vocab=vocab,
+    )
+    return {
+        "metric": "moe_lm_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": "tokens/sec/chip (top-2 MoE every other block)",
+        "step_time_ms": round(step_time * 1e3, 2),
+        "n_experts": n_experts,
+    }
 
 
 def config_seq2seq_mp():
@@ -552,6 +638,8 @@ def main():
         ("vgg16_db", config_vgg16_double_buffering),
         ("resnet50_mnbn", config_resnet50_mnbn),
         ("transformer_lm", config_transformer_lm),
+        ("transformer_lm_long", config_transformer_lm_long),
+        ("moe_lm", config_moe_lm),
         ("seq2seq_mp", config_seq2seq_mp),
         ("resnet50_native_input", config_resnet50_native_input),
     ]

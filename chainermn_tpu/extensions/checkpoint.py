@@ -166,8 +166,21 @@ class _MultiNodeCheckpointer:
             leaves = [l[()] if l.ndim == 0 and l.dtype == object else l
                       for l in leaves]
             return step, jax.tree_util.tree_unflatten(treedef, leaves)
+        restore_kwargs = {}
+        if like is not None:
+            try:
+                # Restore each leaf directly onto its devices with the
+                # template's sharding (mesh-sharded TP kernels / expert
+                # blocks / ZeRO state land sharded, no host round-trip).
+                import orbax.checkpoint as ocp
+
+                restore_kwargs["restore_args"] = (
+                    ocp.checkpoint_utils.construct_restore_args(like)
+                )
+            except Exception:
+                pass  # template not array-like throughout; orbax defaults
         state = self._orbax().restore(
-            os.path.abspath(target), item=like
+            os.path.abspath(target), item=like, **restore_kwargs
         )
         return step, state
 

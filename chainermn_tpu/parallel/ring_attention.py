@@ -58,6 +58,15 @@ def _ring_flash(q, k, v, axis_name, causal, scale, block_q, block_k,
     """
     from chainermn_tpu.ops.pallas_attention import flash_attention_with_lse
 
+    if causal and q.shape[1] != k.shape[1]:
+        # Block-granular causality classifies whole blocks by owner
+        # index, which is only a global-position mask when q and k
+        # shards are the same length; the plain path masks by global
+        # position and handles the ragged case.
+        raise ValueError(
+            f"ring flash with causal=True needs equal q/k shard lengths "
+            f"(got {q.shape[1]} vs {k.shape[1]}); use use_flash=False"
+        )
     n = lax.axis_size(axis_name)
     my = lax.axis_index(axis_name)
 
@@ -156,6 +165,7 @@ def ring_attention(
             and jax.default_backend() == "tpu"
             and q.shape[1] >= 128
             and k.shape[1] >= 128
+            and (not causal or q.shape[1] == k.shape[1])
         )
     if scale is None:
         scale = q.shape[-1] ** -0.5

@@ -177,8 +177,19 @@ class _MultiNodeCheckpointer:
                 restore_kwargs["restore_args"] = (
                     ocp.checkpoint_utils.construct_restore_args(like)
                 )
-            except Exception:
-                pass  # template not array-like throughout; orbax defaults
+            except Exception as e:
+                # Non-array template leaves (or an orbax API change):
+                # restore still works via orbax defaults, but sharded
+                # leaves then land replicated — say so rather than
+                # silently degrading a large-model restore.
+                import warnings
+
+                warnings.warn(
+                    "could not build sharded restore args from the "
+                    f"template ({type(e).__name__}: {e}); restoring "
+                    "with orbax defaults (leaves may come back "
+                    "host-replicated — re-place with step.place)"
+                )
         state = self._orbax().restore(
             os.path.abspath(target), item=like, **restore_kwargs
         )

@@ -11,6 +11,12 @@ from .expert_parallel import (  # noqa: F401
     expert_parallel_moe,
     mlp_experts,
     top_k_routing,
+    route_plan,
+    scatter_dispatch,
+    scatter_combine,
+    dispatch_to_queues,
+    combine_from_queues,
+    resolve_dispatch_impl,
     compute_capacity,
     load_balancing_loss,
 )
@@ -27,6 +33,12 @@ __all__ = [
     "expert_parallel_moe",
     "mlp_experts",
     "top_k_routing",
+    "route_plan",
+    "scatter_dispatch",
+    "scatter_combine",
+    "dispatch_to_queues",
+    "combine_from_queues",
+    "resolve_dispatch_impl",
     "compute_capacity",
     "load_balancing_loss",
 ]

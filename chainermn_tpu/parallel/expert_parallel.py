@@ -24,7 +24,7 @@ learns through the combine weights exactly as in GShard/Switch.
 from __future__ import annotations
 
 import math
-from typing import Callable, Optional, Tuple
+from typing import Callable, NamedTuple, Optional, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -37,15 +37,71 @@ def compute_capacity(tokens: int, num_experts: int, k: int,
     return max(int(math.ceil(tokens * k * capacity_factor / num_experts)), 1)
 
 
+class RoutePlan(NamedTuple):
+    """Static-shape routing decision shared by both dispatch backends.
+
+    chosen/gates/slot/keep are (tokens, k): expert index, re-normalized
+    gate, queue position within that expert, and survives-capacity flag
+    for each of a token's k routes; raw_routes is the (tokens,
+    num_experts) pre-capacity indicator for the balance loss.
+    """
+
+    chosen: jnp.ndarray
+    gates: jnp.ndarray
+    slot: jnp.ndarray
+    keep: jnp.ndarray
+    raw_routes: jnp.ndarray
+
+
+def route_plan(probs: jnp.ndarray, k: int, capacity: int) -> RoutePlan:
+    """Top-k routing with static per-expert capacity.
+
+    Queue positions count earlier claims on the same expert in
+    route-major then token order (all first choices before all second
+    choices — GShard's ordering, so a token's secondary route is dropped
+    before any primary route).
+    """
+    t, e = probs.shape
+    if k > e:
+        raise ValueError(f"k ({k}) cannot exceed num_experts ({e})")
+    # lax.top_k guarantees k distinct indices with values read from the
+    # original row — no hand-rolled argmax-and-mask loop needed.
+    gate_arr, chosen_arr = lax.top_k(probs, k)
+    onehots = [
+        jax.nn.one_hot(chosen_arr[:, j], e, dtype=jnp.int32)
+        for j in range(k)
+    ]
+    gate_sum = jnp.sum(gate_arr, axis=1, keepdims=True) if k > 1 else None
+    gates = (
+        gate_arr / (gate_sum + 1e-9) if gate_sum is not None else gate_arr
+    )
+    slots, keeps = [], []
+    prior = jnp.zeros((e,), jnp.int32)
+    for oh in onehots:
+        pos = jnp.cumsum(oh, axis=0) - oh  # earlier tokens, this route
+        pos = pos + prior[None, :]  # plus all earlier routes
+        prior = prior + jnp.sum(oh, axis=0)
+        slot = jnp.sum(pos * oh, axis=-1)  # (tokens,)
+        slots.append(slot)
+        keeps.append(slot < capacity)
+    raw_routes = sum(oh.astype(probs.dtype) for oh in onehots)
+    return RoutePlan(
+        chosen=chosen_arr,
+        gates=gates.astype(probs.dtype),
+        slot=jnp.stack(slots, axis=1),
+        keep=jnp.stack(keeps, axis=1),
+        raw_routes=raw_routes,
+    )
+
+
 def top_k_routing(
     probs: jnp.ndarray, k: int, capacity: int
-) -> Tuple[jnp.ndarray, jnp.ndarray]:
-    """Build dispatch mask and combine weights from router probabilities.
+) -> Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]:
+    """Build DENSE dispatch mask and combine weights from router
+    probabilities — the einsum backend's (tokens, num_experts, capacity)
+    tensors.  O(t*e*cap) memory; prefer :func:`route_plan` +
+    :func:`scatter_dispatch` at scale.
 
-    Args:
-      probs: (tokens, num_experts) router softmax.
-      k: routes per token (1 = Switch, 2 = GShard).
-      capacity: per-expert queue length (static).
     Returns:
       dispatch: (tokens, num_experts, capacity) one-hot {0,1} — token t
         occupies slot c of expert e's queue.
@@ -55,34 +111,67 @@ def top_k_routing(
         of the k choice one-hots) — feed this, not dispatch, to
         :func:`load_balancing_loss` so dropped claims still count.
     """
-    t, e = probs.shape
-    if k > e:
-        raise ValueError(f"k ({k}) cannot exceed num_experts ({e})")
-    # lax.top_k guarantees k distinct indices with values read from the
-    # original row — no hand-rolled argmax-and-mask loop needed.
-    gate_arr, chosen_arr = lax.top_k(probs, k)
-    chosen = [chosen_arr[:, i] for i in range(k)]
-    gates = [gate_arr[:, i] for i in range(k)]
-    # Queue positions: cumulative count of earlier claims on the same
-    # expert, counting all routes in route-major then token order.
-    onehots = [jax.nn.one_hot(c, e, dtype=jnp.int32) for c in chosen]
-    dispatch = jnp.zeros((t, e, capacity), probs.dtype)
-    combine = jnp.zeros((t, e, capacity), probs.dtype)
-    gate_sum = sum(gates) if k > 1 else None
-    prior = jnp.zeros((e,), jnp.int32)
-    for oh, c_idx, gate in zip(onehots, chosen, gates):
-        pos = jnp.cumsum(oh, axis=0) - oh  # earlier tokens, this route
-        pos = pos + prior[None, :]  # plus all earlier routes
-        prior = prior + jnp.sum(oh, axis=0)
-        slot = jnp.sum(pos * oh, axis=-1)  # (tokens,)
-        keep = (slot < capacity).astype(probs.dtype)
-        g = gate / (gate_sum + 1e-9) if gate_sum is not None else gate
-        oh_slot = jax.nn.one_hot(slot, capacity, dtype=probs.dtype)
-        d = oh.astype(probs.dtype)[:, :, None] * oh_slot[:, None, :]
+    plan = route_plan(probs, k, capacity)
+    dispatch, combine = _dense_masks(plan, probs.shape[1], capacity,
+                                     probs.dtype)
+    return dispatch, combine, plan.raw_routes
+
+
+def _dense_masks(plan: RoutePlan, e: int, capacity: int, dtype):
+    t, k = plan.chosen.shape
+    dispatch = jnp.zeros((t, e, capacity), dtype)
+    combine = jnp.zeros((t, e, capacity), dtype)
+    for j in range(k):
+        oh = jax.nn.one_hot(plan.chosen[:, j], e, dtype=dtype)
+        oh_slot = jax.nn.one_hot(plan.slot[:, j], capacity, dtype=dtype)
+        d = oh[:, :, None] * oh_slot[:, None, :]
+        keep = plan.keep[:, j].astype(dtype)
         dispatch = dispatch + d * keep[:, None, None]
-        combine = combine + d * (g * keep)[:, None, None]
-    raw_routes = sum(oh.astype(probs.dtype) for oh in onehots)
-    return dispatch, combine, raw_routes
+        combine = combine + d * (plan.gates[:, j] * keep)[:, None, None]
+    return dispatch, combine
+
+
+def scatter_dispatch(x: jnp.ndarray, plan: RoutePlan, num_experts: int,
+                     capacity: int) -> jnp.ndarray:
+    """Token rows into per-expert queues via scatter — O(t*k*d) work.
+
+    The einsum backend builds the same (num_experts, capacity, d) queues
+    as ``einsum('td,tec->ecd', x, dispatch)``, which costs
+    t*e*cap*d FLOPs (a full matmul against a one-hot operand); at LM
+    scale that rivals the model's own FLOPs.  Queue slots are unique by
+    construction (the cumulative-position assignment), so this is a
+    collision-free scatter.
+    """
+    t, d = x.shape
+    k = plan.chosen.shape[1]
+    dump = num_experts * capacity  # dropped routes land here
+    dest = jnp.where(
+        plan.keep, plan.chosen * capacity + plan.slot, dump
+    )  # (t, k)
+    queues = jnp.zeros((num_experts * capacity + 1, d), x.dtype)
+    # route-major flattening pairs dest[:, j] with the token rows
+    queues = queues.at[dest.T.reshape(-1)].set(
+        jnp.tile(x, (k, 1)), mode="drop"
+    )
+    return queues[:-1].reshape(num_experts, capacity, d)
+
+
+def scatter_combine(out: jnp.ndarray, plan: RoutePlan,
+                    capacity: int) -> jnp.ndarray:
+    """Gather each token's surviving expert outputs and gate-sum them —
+    the transpose of :func:`scatter_dispatch` (O(t*k*d))."""
+    e, cap, d = out.shape
+    flat = jnp.concatenate(
+        [out.reshape(e * cap, d), jnp.zeros((1, d), out.dtype)]
+    )
+    dump = e * cap
+    dest = jnp.where(plan.keep, plan.chosen * capacity + plan.slot, dump)
+    y = jnp.zeros((plan.chosen.shape[0], d), out.dtype)
+    for j in range(plan.chosen.shape[1]):
+        rows = flat[dest[:, j]]
+        w = (plan.gates[:, j] * plan.keep[:, j]).astype(out.dtype)
+        y = y + rows * w[:, None]
+    return y
 
 
 def load_balancing_loss(probs: jnp.ndarray,
@@ -127,6 +216,7 @@ def expert_parallel_moe(
     capacity_factor: float = 1.25,
     capacity: Optional[int] = None,
     aux_stat_axes=None,
+    dispatch_impl: str = "auto",
 ) -> Tuple[jnp.ndarray, jnp.ndarray]:
     """One expert-parallel MoE layer.  Call inside ``shard_map``.
 
@@ -143,6 +233,10 @@ def expert_parallel_moe(
         pass every token-splitting axis (data/seq/expert) to make the
         aux loss exactly the global-batch value, invariant to mesh
         factorization.
+      dispatch_impl: 'einsum' (dense one-hot masks, exact GShard
+        formulation), 'scatter' (collision-free scatter/gather,
+        O(t*k*d) instead of O(t*e*cap*d) — identical numerics), or
+        'auto' (scatter once the dense masks would be large).
     Returns:
       (y, aux_loss): y (tokens, d) combined expert outputs (dropped tokens
       get zeros — add the residual outside); aux_loss the load-balancing
@@ -164,14 +258,15 @@ def expert_parallel_moe(
         jnp.asarray(x, jnp.float32) @ jnp.asarray(router_w, jnp.float32),
         axis=-1,
     )
-    dispatch, combine, raw_routes = top_k_routing(probs, k, cap)
+    plan = route_plan(probs, k, cap)
     stat_axes = (axis_name,) if aux_stat_axes is None else tuple(
         aux_stat_axes
     )
-    aux = load_balancing_loss(probs, raw_routes, axes=stat_axes)
+    aux = load_balancing_loss(probs, plan.raw_routes, axes=stat_axes)
 
+    impl = resolve_dispatch_impl(dispatch_impl, t, num_experts, cap)
     # Local queues: (num_experts, cap, d)
-    dispatched = jnp.einsum("td,tec->ecd", x, dispatch.astype(x.dtype))
+    dispatched = dispatch_to_queues(x, plan, num_experts, cap, impl)
     # To expert owners: split expert dim over chips, gather token sources.
     # (n, local_e, cap, d) -all_to_all-> every chip: its experts' queues
     # from all chips, concatenated along a new source axis.
@@ -188,8 +283,45 @@ def expert_parallel_moe(
     returned = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0,
                               tiled=False)
     returned = returned.reshape(num_experts, cap, d)
-    y = jnp.einsum("ecd,tec->td", returned, combine.astype(returned.dtype))
+    y = combine_from_queues(returned, plan, num_experts, cap, impl)
     return y.astype(x.dtype), aux
+
+
+def resolve_dispatch_impl(impl: str, t: int, num_experts: int,
+                          cap: int) -> str:
+    """'auto' picks scatter once the dense one-hot dispatch would cost
+    more than ~1M mask elements per feature (t*e*cap) — past that the
+    einsum against a one-hot operand dominates the layer's FLOPs."""
+    if impl == "auto":
+        return "scatter" if t * num_experts * cap >= (1 << 20) else "einsum"
+    if impl not in ("einsum", "scatter"):
+        raise ValueError(
+            f"dispatch_impl must be 'auto', 'einsum' or 'scatter'; "
+            f"got {impl!r}"
+        )
+    return impl
+
+
+def dispatch_to_queues(x: jnp.ndarray, plan: RoutePlan, num_experts: int,
+                       capacity: int, impl: str) -> jnp.ndarray:
+    """(tokens, d) -> (num_experts, capacity, d) queues via the resolved
+    backend ('einsum' | 'scatter' — see :func:`resolve_dispatch_impl`)."""
+    if impl == "einsum":
+        dispatch, _ = _dense_masks(plan, num_experts, capacity, x.dtype)
+        return jnp.einsum("td,tec->ecd", x, dispatch)
+    return scatter_dispatch(x, plan, num_experts, capacity)
+
+
+def combine_from_queues(out: jnp.ndarray, plan: RoutePlan,
+                        num_experts: int, capacity: int,
+                        impl: str) -> jnp.ndarray:
+    """(num_experts, capacity, d) expert outputs -> (tokens, d)
+    gate-weighted combination, transpose of :func:`dispatch_to_queues`
+    (the einsum branch's masks CSE with the dispatch side's)."""
+    if impl == "einsum":
+        _, combine = _dense_masks(plan, num_experts, capacity, out.dtype)
+        return jnp.einsum("ecd,tec->td", out, combine)
+    return scatter_combine(out, plan, capacity)
 
 
 def mlp_experts(w1: jnp.ndarray, w2: jnp.ndarray,

@@ -111,8 +111,9 @@ class TestRouting:
 
 
 class TestExpertParallelMoE:
+    @pytest.mark.parametrize("impl", ["einsum", "scatter"])
     @pytest.mark.parametrize("k", [1, 2])
-    def test_matches_dense_oracle_when_no_drops(self, mesh8, k):
+    def test_matches_dense_oracle_when_no_drops(self, mesh8, k, impl):
         x, rw, w1, w2 = _problem()
         oracle = _dense_oracle(x, rw, w1, w2, k=k)
 
@@ -121,6 +122,7 @@ class TestExpertParallelMoE:
                 lambda x, rw, w1, w2: expert_parallel_moe(
                     x, rw, mlp_experts(w1, w2), "mn", E, k=k,
                     capacity=T_LOCAL,  # roomy: no token dropped
+                    dispatch_impl=impl,
                 ),
                 mesh=mesh8,
                 in_specs=(P("mn"), P(), P("mn"), P("mn")),
@@ -134,6 +136,51 @@ class TestExpertParallelMoE:
             np.asarray(y), oracle, rtol=2e-4, atol=2e-5
         )
         assert float(aux) > 0.0
+
+    def test_scatter_matches_einsum_with_drops_and_grads(self, mesh8):
+        """The two dispatch backends are numerically interchangeable —
+        including dropped routes (tight capacity) and gradients through
+        gates, router, and expert weights."""
+        x, rw, w1, w2 = _problem(seed=7)
+        results = {}
+        for impl in ("einsum", "scatter"):
+            def loss(x, rw, w1, w2, impl=impl):
+                y, aux = expert_parallel_moe(
+                    x, rw, mlp_experts(w1, w2), "mn", E, k=2,
+                    capacity=3,  # tight: real drops
+                    dispatch_impl=impl,
+                )
+                return lax.pmean(jnp.sum(y**2), "mn") + 0.01 * aux
+
+            fwd = jax.jit(
+                jax.shard_map(
+                    lambda x, rw, w1, w2, impl=impl: expert_parallel_moe(
+                        x, rw, mlp_experts(w1, w2), "mn", E, k=2,
+                        capacity=3, dispatch_impl=impl,
+                    )[0],
+                    mesh=mesh8,
+                    in_specs=(P("mn"), P(), P("mn"), P("mn")),
+                    out_specs=P("mn"), check_vma=False,
+                )
+            )
+            grad = jax.jit(
+                jax.shard_map(
+                    jax.grad(loss, argnums=(1, 2, 3)), mesh=mesh8,
+                    in_specs=(P("mn"), P(), P("mn"), P("mn")),
+                    out_specs=(P(), P("mn"), P("mn")), check_vma=False,
+                )
+            )
+            xs = jax.device_put(x, NamedSharding(mesh8, P("mn")))
+            results[impl] = (
+                np.asarray(fwd(xs, rw, w1, w2)),
+                [np.asarray(g) for g in grad(xs, rw, w1, w2)],
+            )
+        np.testing.assert_allclose(
+            results["scatter"][0], results["einsum"][0],
+            rtol=1e-5, atol=1e-6,
+        )
+        for gs, ge in zip(results["scatter"][1], results["einsum"][1]):
+            np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
 
     def test_differentiable_through_router_and_experts(self, mesh8):
         x, rw, w1, w2 = _problem(seed=3)

@@ -127,7 +127,11 @@ def _dense_masks(plan: RoutePlan, e: int, capacity: int, dtype):
         d = oh[:, :, None] * oh_slot[:, None, :]
         keep = plan.keep[:, j].astype(dtype)
         dispatch = dispatch + d * keep[:, None, None]
-        combine = combine + d * (plan.gates[:, j] * keep)[:, None, None]
+        # gates are fp32; cast the per-route weight so the (t, e, cap)
+        # combine tensor stays in the requested dtype (and CSEs with the
+        # dispatch mask instead of silently promoting to fp32)
+        w = (plan.gates[:, j].astype(dtype) * keep)
+        combine = combine + d * w[:, None, None]
     return dispatch, combine
 
 

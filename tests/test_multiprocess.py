@@ -117,6 +117,20 @@ class TestTrainStep:
         )
 
 
+class TestComposedMesh:
+    def test_dp_sp_tp_ep_across_processes(self, tmp_path):
+        # 2 processes x 4 local devices = (2, 2, 2) mesh spanning hosts:
+        # the data axis crosses the process boundary, so the composed
+        # MoE step's gradient psum and per-process batch placement ride
+        # the multi-controller path for real.
+        res = run_world("composed_mesh", n_procs=2, local_devices=4,
+                        tmpdir=tmp_path, timeout=420)
+        payloads = _assert_ok(res, "composed_mesh")
+        assert payloads[0]["losses"] == pytest.approx(
+            payloads[1]["losses"]
+        )
+
+
 class TestCheckpoint:
     def test_save_agree_resume(self, tmp_path):
         res = run_world("checkpoint", n_procs=2, local_devices=2,

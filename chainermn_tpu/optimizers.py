@@ -311,6 +311,7 @@ def build_train_step(
     param_specs=None,
     batch_specs=None,
     accum_steps: int = 1,
+    remat=False,
     donate: bool = True,
     use_shard_map: bool = True,
     has_aux: bool = False,
@@ -377,6 +378,13 @@ def build_train_step(
     losses over equal microbatches, the numerics) match the unaccumulated
     step; gradient sync still happens once per step.  The per-chip batch
     must divide by it.
+
+    ``remat``: rematerialize the forward pass in the backward
+    (``jax.checkpoint`` around ``loss_fn``) — trade FLOPs for HBM.
+    ``True`` uses JAX's default policy; pass a
+    ``jax.checkpoint_policies`` policy (e.g.
+    ``dots_with_no_batch_dims_saveable``) for finer control.  Composes
+    with ``accum_steps`` (remat inside each microbatch).
     """
     from jax.sharding import NamedSharding, PartitionSpec as P
 
@@ -404,6 +412,12 @@ def build_train_step(
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if remat:
+        loss_fn = (
+            jax.checkpoint(loss_fn)
+            if remat is True
+            else jax.checkpoint(loss_fn, policy=remat)
+        )
 
     def _value_and_grad(fn, params, batch):
         """value_and_grad of ``fn``, microbatched over ``accum_steps``

@@ -156,6 +156,46 @@ class TestGradAccumulation:
         )
 
 
+class TestRemat:
+    """remat=True rematerializes the forward in the backward — values
+    and updates must be bit-comparable to the plain step."""
+
+    def _mlp_loss(self, params, batch):
+        x = batch
+        h = jnp.tanh(x @ params["w1"])
+        return jnp.mean((h @ params["w2"]) ** 2)
+
+    def _run(self, comm, remat):
+        opt = cmn.create_multi_node_optimizer(optax.adam(0.05), comm)
+        rng = np.random.RandomState(0)
+        params = {
+            "w1": jnp.asarray(rng.randn(4, 8), jnp.float32) * 0.4,
+            "w2": jnp.asarray(rng.randn(8, 2), jnp.float32) * 0.4,
+        }
+        step = build_train_step(
+            comm, self._mlp_loss, opt, donate=False, remat=remat,
+            accum_steps=2,
+        )
+        params, opt_state = step.place(params, opt.init(params))
+        x = jnp.asarray(rng.randn(32, 4), jnp.float32)
+        bx = jax.device_put(x, step.batch_sharding)
+        for _ in range(3):
+            params, opt_state, m = step(params, opt_state, bx)
+        return np.asarray(params["w1"]), float(m["loss"])
+
+    def test_remat_matches_plain(self, comm):
+        w_plain, l_plain = self._run(comm, remat=False)
+        w_remat, l_remat = self._run(comm, remat=True)
+        np.testing.assert_allclose(l_remat, l_plain, rtol=1e-6)
+        np.testing.assert_allclose(w_remat, w_plain, rtol=1e-6, atol=1e-8)
+
+    def test_policy_object_accepted(self, comm):
+        policy = jax.checkpoint_policies.nothing_saveable
+        w_pol, l_pol = self._run(comm, remat=policy)
+        w_plain, l_plain = self._run(comm, remat=False)
+        np.testing.assert_allclose(l_pol, l_plain, rtol=1e-6)
+
+
 class TestDoubleBuffering:
     def test_first_update_is_zero_then_stale(self, comm):
         opt = cmn.create_multi_node_optimizer(

@@ -194,6 +194,7 @@ class TestRemat:
         w_pol, l_pol = self._run(comm, remat=policy)
         w_plain, l_plain = self._run(comm, remat=False)
         np.testing.assert_allclose(l_pol, l_plain, rtol=1e-6)
+        np.testing.assert_allclose(w_pol, w_plain, rtol=1e-6, atol=1e-8)
 
 
 class TestDoubleBuffering:

@@ -10,7 +10,8 @@ Design:
   ordinary single-device causal LM.  Called inside ``shard_map`` with the
   token sequence sharded over ``seq_axis``, the SAME module becomes
   sequence-parallel: positional embeddings use global positions (axis
-  index offset) and attention runs :func:`parallel.ring_attention` over
+  index offset) and attention runs :func:`parallel.ring_attention` (or
+  :func:`parallel.ulysses_attention` with ``sp_impl="ulysses"``) over
   the axis — everything else (LN, MLPs, embeddings) is position-local and
   needs no communication.
 * ``attention_fn`` hook: the single-device core (default
@@ -47,13 +48,22 @@ class SelfAttention(nn.Module):
     """Causal self-attention; optionally tensor-parallel over ``tp_axis``
     (heads sharded Megatron-style: column-parallel q/k/v projections, one
     row-parallel psum on the output projection) and/or sequence-parallel
-    over ``seq_axis`` (ring attention).  The two compose: each chip then
-    holds its head shard of its sequence shard."""
+    over ``seq_axis``.  The two compose: each chip then holds its head
+    shard of its sequence shard.
+
+    ``sp_impl`` picks the sequence-parallel algorithm: ``"ring"``
+    (ppermute K/V rotation — any head count, O(seq/chips) memory) or
+    ``"ulysses"`` (two all_to_alls exchanging sequence- for
+    head-sharding; local heads must divide by the seq-axis size, bulk
+    ICI transposes instead of n ring hops).  Ulysses runs
+    ``attention_fn`` on its gathered blocks (pass the flash kernel);
+    ring uses its own flash tier automatically on TPU."""
 
     n_heads: int
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
+    sp_impl: str = "ring"
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -93,9 +103,22 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, heads, dh)
         v = v.reshape(b, s, heads, dh)
         if self.seq_axis is not None:
-            from chainermn_tpu.parallel import ring_attention
+            if self.sp_impl == "ring":
+                from chainermn_tpu.parallel import ring_attention
 
-            out = ring_attention(q, k, v, self.seq_axis, causal=causal)
+                out = ring_attention(q, k, v, self.seq_axis, causal=causal)
+            elif self.sp_impl == "ulysses":
+                from chainermn_tpu.parallel import ulysses_attention
+
+                out = ulysses_attention(
+                    q, k, v, self.seq_axis, causal=causal,
+                    attention_fn=self.attention_fn,
+                )
+            else:
+                raise ValueError(
+                    f"sp_impl must be 'ring' or 'ulysses', got "
+                    f"{self.sp_impl!r}"
+                )
         elif self.attention_fn is not None:
             out = self.attention_fn(q, k, v, causal, dh**-0.5)
         else:
@@ -142,6 +165,7 @@ class TransformerBlock(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
+    sp_impl: str = "ring"
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -149,7 +173,8 @@ class TransformerBlock(nn.Module):
         ln = lambda: nn.LayerNorm(dtype=jnp.float32)
         x = x + SelfAttention(
             self.n_heads, dtype=self.dtype, seq_axis=self.seq_axis,
-            tp_axis=self.tp_axis, attention_fn=self.attention_fn,
+            tp_axis=self.tp_axis, sp_impl=self.sp_impl,
+            attention_fn=self.attention_fn,
         )(ln()(x).astype(self.dtype))
         if self.tp_axis is not None:
             mlp = TpMlpBlock(self.d_ff, tp_axis=self.tp_axis,
@@ -177,6 +202,7 @@ class TransformerLM(nn.Module):
     dtype: Any = jnp.bfloat16
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
+    sp_impl: str = "ring"
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -217,7 +243,7 @@ class TransformerLM(nn.Module):
             x = TransformerBlock(
                 self.n_heads, d_ff, dtype=self.dtype,
                 seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                attention_fn=self.attention_fn,
+                sp_impl=self.sp_impl, attention_fn=self.attention_fn,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Weight-tied head.

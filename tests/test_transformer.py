@@ -113,6 +113,53 @@ class TestSequenceParallel:
             np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
         )
 
+    def test_sp_ulysses_matches_dense(self, devices8):
+        """sp_impl='ulysses': all_to_all head/sequence exchange inside
+        the SAME TransformerLM — 4 chips so the 4 heads divide."""
+        from jax.sharding import Mesh
+
+        mesh4 = Mesh(np.array(devices8[:4]), ("mn",))
+        dense, _ = _models()
+        uly = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+            max_len=MAXLEN, dtype=jnp.float32, seq_axis="mn",
+            sp_impl="ulysses",
+        )
+        toks = _tokens(b=2, s=64)
+        params = dense.init(jax.random.PRNGKey(0), toks)
+        want = dense.apply(params, toks)
+        f = jax.jit(
+            jax.shard_map(
+                lambda p, t: uly.apply(p, t),
+                mesh=mesh4,
+                in_specs=(P(), P(None, "mn")),
+                out_specs=P(None, "mn"),
+                check_vma=False,
+            )
+        )
+        got = f(params, jax.device_put(
+            toks, NamedSharding(mesh4, P(None, "mn"))
+        ))
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=5e-4, atol=5e-5
+        )
+
+    def test_bad_sp_impl_rejected(self, mesh8):
+        bad = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=1,
+            max_len=MAXLEN, dtype=jnp.float32, seq_axis="mn",
+            sp_impl="nope",
+        )
+        toks = _tokens(b=1, s=64)
+        with pytest.raises(ValueError, match="sp_impl"):
+            jax.jit(
+                jax.shard_map(
+                    lambda t: bad.init(jax.random.PRNGKey(0), t),
+                    mesh=mesh8, in_specs=P(None, "mn"), out_specs=P(),
+                    check_vma=False,
+                )
+            )(toks)
+
     def test_sp_loss_matches_dense(self, mesh8):
         dense, sp = _models()
         toks = _tokens(b=2, s=64)

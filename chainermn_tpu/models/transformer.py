@@ -203,17 +203,38 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
     sp_impl: str = "ring"
+    # Shard the embedding table AND the tied output head over tp_axis
+    # (Megatron VocabParallelEmbedding): logits come back as the LOCAL
+    # vocab block — train with vp_lm_loss, which assembles the softmax
+    # statistics with collectives instead of materializing (.., V) rows.
+    vocab_parallel: bool = False
     attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, tokens):
         b, s = tokens.shape
         d_ff = self.d_ff or 4 * self.d_model
-        embed = nn.Embed(
-            self.vocab_size, self.d_model,
-            embedding_init=nn.initializers.normal(0.02),
-            dtype=jnp.float32, name="embed",
-        )
+        if self.vocab_parallel:
+            if self.tp_axis is None:
+                raise ValueError(
+                    "vocab_parallel=True requires tp_axis (the vocab "
+                    "shards over the model axis)"
+                )
+            from chainermn_tpu.parallel import VocabParallelEmbed
+
+            # auto-generated name ("VocabParallelEmbed_0") keeps the
+            # param tree spec-derivable (the class marker must appear in
+            # the flax path)
+            embed = VocabParallelEmbed(
+                self.vocab_size, self.d_model, axis_name=self.tp_axis,
+                dtype=jnp.float32,
+            )
+        else:
+            embed = nn.Embed(
+                self.vocab_size, self.d_model,
+                embedding_init=nn.initializers.normal(0.02),
+                dtype=jnp.float32, name="embed",
+            )
         pos_table = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_len, self.d_model), jnp.float32,
@@ -247,6 +268,8 @@ class TransformerLM(nn.Module):
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Weight-tied head.
+        if self.vocab_parallel:
+            return embed.attend(x.astype(jnp.float32))  # local vocab block
         logits = x.astype(jnp.float32) @ embed.embedding.T
         return logits
 
@@ -262,33 +285,65 @@ def lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     ).mean()
 
 
-def sp_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
-               axis_name: str) -> jnp.ndarray:
-    """Next-token cross entropy for a sequence-sharded block.
-
-    Each shard's last position predicts the NEXT shard's first token, so
-    targets cross the shard boundary via ``ppermute`` (the differentiable
-    p2p layer the reference's send/recv points at); the final global
-    position has no target and is masked.  Returns the global mean
-    (psum-reduced), identical on every shard.
-    """
-    import optax
-
+def _sp_targets(tokens: jnp.ndarray, axis_name: str):
+    """The shard-boundary protocol shared by the sequence-parallel
+    losses: each shard's last position predicts the NEXT shard's first
+    token (targets cross the boundary via ``ppermute`` — the
+    differentiable p2p layer the reference's send/recv points at), and
+    the final *global* position has no target.  Returns
+    ``(targets (b, s), valid (1, s) float mask)``."""
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, s = tokens.shape
-    # next shard's first token arrives from the right neighbor
     nxt = lax.ppermute(
         tokens[:, :1], axis_name,
         [((i + 1) % n, i) for i in range(n)],
     )
-    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)  # (b, s)
-    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    targets = jnp.concatenate([tokens[:, 1:], nxt], axis=1)
     # mask the last global position (wrapped target is shard 0's BOS)
     global_pos = me * s + jnp.arange(s)[None, :]
-    valid = jnp.broadcast_to(
-        (global_pos < n * s - 1).astype(ce.dtype), ce.shape
-    )
+    valid = (global_pos < n * s - 1).astype(jnp.float32)
+    return targets, valid
+
+
+def _sp_masked_mean(ce: jnp.ndarray, valid: jnp.ndarray,
+                    axis_name: str) -> jnp.ndarray:
+    valid = jnp.broadcast_to(valid.astype(ce.dtype), ce.shape)
     total = lax.psum(jnp.sum(ce * valid), axis_name)
     count = lax.psum(jnp.sum(valid), axis_name)
     return total / count
+
+
+def sp_lm_loss(logits: jnp.ndarray, tokens: jnp.ndarray,
+               axis_name: str) -> jnp.ndarray:
+    """Next-token cross entropy for a sequence-sharded block
+    (boundary-crossing targets per :func:`_sp_targets`).  Returns the
+    global mean (psum-reduced), identical on every shard."""
+    import optax
+
+    targets, valid = _sp_targets(tokens, axis_name)
+    ce = optax.softmax_cross_entropy_with_integer_labels(logits, targets)
+    return _sp_masked_mean(ce, valid, axis_name)
+
+
+def vp_lm_loss(logits_local: jnp.ndarray, tokens: jnp.ndarray,
+               model_axis: str,
+               seq_axis: Optional[str] = None) -> jnp.ndarray:
+    """Next-token cross entropy from vocab-sharded logits
+    (``TransformerLM(vocab_parallel=True)``): per-position CE is
+    assembled by :func:`~chainermn_tpu.parallel.vocab_parallel_cross_entropy`
+    (one pmax + two psums over ``model_axis`` — no full-vocab row), with
+    the same boundary-crossing targets as :func:`sp_lm_loss` when the
+    sequence is also sharded over ``seq_axis``."""
+    from chainermn_tpu.parallel import vocab_parallel_cross_entropy
+
+    if seq_axis is not None:
+        targets, valid = _sp_targets(tokens, seq_axis)
+        ce = vocab_parallel_cross_entropy(
+            logits_local, targets, model_axis
+        )
+        return _sp_masked_mean(ce, valid, seq_axis)
+    ce = vocab_parallel_cross_entropy(
+        logits_local[:, :-1], tokens[:, 1:], model_axis
+    )
+    return ce.mean()

@@ -4,6 +4,8 @@ from .pipeline import build_pipeline_train_step, gpipe  # noqa: F401
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense,
     RowParallelDense,
+    VocabParallelEmbed,
+    vocab_parallel_cross_entropy,
     megatron_param_specs,
     sharded_init,
 )
@@ -28,6 +30,8 @@ __all__ = [
     "build_pipeline_train_step",
     "ColumnParallelDense",
     "RowParallelDense",
+    "VocabParallelEmbed",
+    "vocab_parallel_cross_entropy",
     "megatron_param_specs",
     "sharded_init",
     "expert_parallel_moe",

@@ -115,13 +115,97 @@ def _path_keys(path):
 
 
 def _tp_owner_kind(keys) -> Optional[str]:
-    """'col' / 'row' / None for a flax param path, innermost match wins."""
+    """'col' / 'row' / 'vocab' / None for a flax param path, innermost
+    match wins."""
     for k in reversed(keys):
         if "ColumnParallel" in k or k in COLUMN_PARALLEL_NAMES:
             return "col"
         if "RowParallel" in k or k in ROW_PARALLEL_NAMES:
             return "row"
+        if "VocabParallel" in k:
+            return "vocab"
     return None
+
+
+class VocabParallelEmbed(nn.Module):
+    """Embedding table sharded over the vocab dimension (Megatron's
+    VocabParallelEmbedding): chip ``i`` holds rows
+    ``[i*V/n, (i+1)*V/n)``.  Lookup masks out-of-range tokens locally and
+    psums the partial embeddings — one allreduce; ``attend(x)`` is the
+    weight-tied output head, returning the LOCAL vocab block's logits
+    (feed them to :func:`vocab_parallel_cross_entropy` / the model-level
+    ``vp_lm_loss``, which never materialize the full-vocab row)."""
+
+    vocab_size: int
+    features: int
+    axis_name: str = "tp"
+    dtype: Any = jnp.float32
+    embedding_init: Callable = nn.initializers.normal(0.02)
+
+    def setup(self):
+        n = lax.axis_size(self.axis_name)
+        if self.vocab_size % n:
+            raise ValueError(
+                f"vocab_size ({self.vocab_size}) not divisible by the "
+                f"'{self.axis_name}' axis size ({n})"
+            )
+        self.embedding = self.param(
+            "embedding",
+            _sharded_init(self.embedding_init, self.axis_name),
+            (self.vocab_size // n, self.features), jnp.float32,
+        )
+
+    def _range(self):
+        local_v = self.embedding.shape[0]
+        start = lax.axis_index(self.axis_name) * local_v
+        return start, local_v
+
+    def __call__(self, tokens):
+        start, local_v = self._range()
+        local = tokens - start
+        in_range = (local >= 0) & (local < local_v)
+        safe = jnp.clip(local, 0, local_v - 1)
+        out = jnp.take(self.embedding, safe, axis=0)
+        out = jnp.where(in_range[..., None], out, 0)
+        return lax.psum(out.astype(self.dtype), self.axis_name)
+
+    def attend(self, x):
+        """(..., features) -> (..., local_vocab) logits against this
+        chip's vocab block (the tied head; no collective here)."""
+        return x @ self.embedding.T.astype(x.dtype)
+
+
+def vocab_parallel_cross_entropy(logits_local: jnp.ndarray,
+                                 targets: jnp.ndarray,
+                                 axis_name: str) -> jnp.ndarray:
+    """Per-position cross entropy from vocab-sharded logits.
+
+    ``logits_local``: (..., V/n) — this chip's vocab block;
+    ``targets``: (...) global token ids.  The softmax statistics are
+    assembled with one pmax and two psums; the (..., V) full-vocab row
+    never exists on any chip (Megatron's parallel cross entropy).
+    """
+    local_v = logits_local.shape[-1]
+    start = lax.axis_index(axis_name) * local_v
+    logits_f = logits_local.astype(jnp.float32)
+    # the max is a pure numerical-stability shift (lse is exactly
+    # invariant to it), so stopping its gradient is exact — and pmax has
+    # no differentiation rule anyway
+    m = lax.pmax(
+        lax.stop_gradient(jnp.max(logits_f, axis=-1)), axis_name
+    )
+    z = lax.psum(
+        jnp.sum(jnp.exp(logits_f - m[..., None]), axis=-1), axis_name
+    )
+    lse = m + jnp.log(z)
+    local_t = targets - start
+    in_range = (local_t >= 0) & (local_t < local_v)
+    safe = jnp.clip(local_t, 0, local_v - 1)
+    picked = jnp.take_along_axis(
+        logits_f, safe[..., None], axis=-1
+    )[..., 0]
+    target_logit = lax.psum(jnp.where(in_range, picked, 0.0), axis_name)
+    return lse - target_logit
 
 
 def _tp_leaf_spec(keys, model_axis):
@@ -137,6 +221,8 @@ def _tp_leaf_spec(keys, model_axis):
         return P(None, model_axis) if last == "kernel" else P(model_axis)
     if kind == "row":
         return P(model_axis, None) if last == "kernel" else P()
+    if kind == "vocab":
+        return P(model_axis, None) if last == "embedding" else P()
     return None
 
 

@@ -304,6 +304,164 @@ class TestMoeMlpDenseVsParallel:
         assert (np.abs(rows).sum(axis=-1) == 0).any()
 
 
+class TestVocabParallel:
+    """Megatron vocab-parallel embedding + cross entropy: the (.., V)
+    logits row never materializes; numerics must match the dense path."""
+
+    def test_cross_entropy_matches_optax(self, devices8):
+        import optax
+        from jax.sharding import Mesh, NamedSharding
+
+        from chainermn_tpu.parallel import vocab_parallel_cross_entropy
+
+        mesh2 = Mesh(np.array(devices8[:2]), ("tp",))
+        rng = np.random.RandomState(0)
+        logits = jnp.asarray(rng.randn(4, 10, 32), jnp.float32)
+        targets = jnp.asarray(rng.randint(0, 32, (4, 10)), jnp.int32)
+        want = optax.softmax_cross_entropy_with_integer_labels(
+            logits, targets
+        )
+        f = jax.jit(
+            jax.shard_map(
+                lambda lg, t: vocab_parallel_cross_entropy(lg, t, "tp"),
+                mesh=mesh2,
+                in_specs=(P(None, None, "tp"), P()),
+                out_specs=P(), check_vma=False,
+            )
+        )
+        got = f(
+            jax.device_put(
+                logits, NamedSharding(mesh2, P(None, None, "tp"))
+            ),
+            targets,
+        )
+        np.testing.assert_allclose(
+            np.asarray(got), np.asarray(want), rtol=1e-5, atol=1e-6
+        )
+
+    def test_embed_matches_dense_lookup(self, devices8):
+        from jax.sharding import Mesh
+        from chainermn_tpu.parallel import VocabParallelEmbed
+        from chainermn_tpu.parallel.tensor_parallel import _tp_leaf_spec
+
+        mesh2 = cmn.create_communicator(
+            "mesh", devices=devices8[:2], sp_size=1, tp_size=2
+        ).mesh
+        vp = VocabParallelEmbed(32, 8, axis_name="mn_model")
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 32, (3, 5)), jnp.int32
+        )
+        params, _ = sharded_init(
+            lambda t: vp.init(jax.random.PRNGKey(0), t),
+            mesh2, (P(),),
+            lambda p: jax.tree_util.tree_map(
+                lambda _: P("mn_model", None), p
+            ),
+            toks,
+        )
+        table = np.asarray(params["params"]["embedding"])  # global (32, 8)
+        assert table.shape == (32, 8)
+        out = jax.jit(
+            jax.shard_map(
+                lambda p, t: vp.apply(p, t),
+                mesh=mesh2,
+                in_specs=(
+                    jax.tree_util.tree_map(
+                        lambda _: P("mn_model", None), params
+                    ),
+                    P(),
+                ),
+                out_specs=P(), check_vma=False,
+            )
+        )(params, toks)
+        np.testing.assert_allclose(
+            np.asarray(out), table[np.asarray(toks)], rtol=1e-6
+        )
+
+    def _run_vp(self, comm, params_host, n_steps=2):
+        from chainermn_tpu.models.transformer import (
+            TransformerLM,
+            vp_lm_loss,
+        )
+        from chainermn_tpu.parallel import megatron_param_specs
+
+        model = TransformerLM(
+            vocab_size=64, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=S, dtype=jnp.float32, tp_axis="mn_model",
+            vocab_parallel=True,
+        )
+        specs = megatron_param_specs(params_host, model_axis="mn_model")
+        opt = cmn.create_multi_node_optimizer(optax.sgd(5e-2), comm)
+
+        def loss_fn(p, b):
+            return vp_lm_loss(model.apply(p, b), b, "mn_model")
+
+        step = build_train_step(
+            comm, loss_fn, opt, data_axes=comm.data_axis_names,
+            param_specs=specs, batch_specs=P("mn_data"), donate=False,
+        )
+        params, opt_state = step.place(params_host, opt.init(params_host))
+        toks = jnp.asarray(
+            np.random.RandomState(1).randint(0, 64, (8, S)), jnp.int32
+        )
+        batch = step.place_batch(toks)
+        losses = []
+        for _ in range(n_steps):
+            params, opt_state, m = step(params, opt_state, batch)
+            losses.append(float(m["loss"]))
+        return _host_tree(params), losses
+
+    def test_vp_lm_factorization_oracle(self, devices8):
+        from chainermn_tpu.models.transformer import TransformerLM
+        from chainermn_tpu.parallel import megatron_param_specs
+
+        comm_tp = cmn.create_communicator(
+            "mesh", devices=devices8, sp_size=1, tp_size=2
+        )
+        comm_dp = cmn.create_communicator(
+            "mesh", devices=devices8, sp_size=1, tp_size=1
+        )
+        model = TransformerLM(
+            vocab_size=64, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=S, dtype=jnp.float32, tp_axis="mn_model",
+            vocab_parallel=True,
+        )
+        params, _ = sharded_init(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            comm_tp.mesh, (P("mn_data"),),
+            lambda p: megatron_param_specs(p, model_axis="mn_model"),
+            jnp.zeros((4, S), jnp.int32),
+        )
+        # embedding is genuinely vocab-sharded on the TP mesh
+        emb = params["params"]["VocabParallelEmbed_0"]["embedding"]
+        assert emb.shape == (64, D)
+        assert {sh.data.shape for sh in emb.addressable_shards} == {
+            (32, D)
+        }
+        host = _host_tree(params)
+        p_tp, l_tp = self._run_vp(comm_tp, host)
+        p_dp, l_dp = self._run_vp(comm_dp, host)
+        np.testing.assert_allclose(l_tp, l_dp, rtol=2e-4, atol=1e-5)
+        flat_dp = dict(jax.tree_util.tree_leaves_with_path(p_dp))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(p_tp):
+            np.testing.assert_allclose(
+                leaf, flat_dp[path], rtol=5e-4, atol=2e-5,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+    def test_vocab_parallel_without_tp_axis_rejected(self):
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        model = TransformerLM(
+            vocab_size=64, d_model=D, n_heads=HEADS, n_layers=1,
+            max_len=S, dtype=jnp.float32, vocab_parallel=True,
+        )
+        with pytest.raises(ValueError, match="vocab_parallel"):
+            model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, S), jnp.int32)
+            )
+
+
 class TestTpOnlyTransformer:
     """TransformerLM(tp_axis=...) factorization oracle: (8,1,1) vs
     (4,1,2) — Megatron attention + MLP sharding changes nothing."""

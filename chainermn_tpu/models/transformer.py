@@ -185,6 +185,33 @@ class TransformerBlock(nn.Module):
         return x
 
 
+def make_lm_embed(parent: nn.Module, vocab_size: int, d_model: int,
+                  tp_axis, vocab_parallel: bool):
+    """The embedding module both LM families construct: dense
+    ``nn.Embed`` (named "embed") or, with ``vocab_parallel=True``, a
+    :class:`~chainermn_tpu.parallel.VocabParallelEmbed` sharded over
+    ``tp_axis`` (auto-named so the class marker stays in the flax path
+    for spec derivation).  Must be called from inside ``parent``'s
+    compact ``__call__`` (the submodule registers on ``parent``)."""
+    del parent  # registration happens via the nn.compact caller's scope
+    if vocab_parallel:
+        if tp_axis is None:
+            raise ValueError(
+                "vocab_parallel=True requires tp_axis (the vocab "
+                "shards over the model axis)"
+            )
+        from chainermn_tpu.parallel import VocabParallelEmbed
+
+        return VocabParallelEmbed(
+            vocab_size, d_model, axis_name=tp_axis, dtype=jnp.float32,
+        )
+    return nn.Embed(
+        vocab_size, d_model,
+        embedding_init=nn.initializers.normal(0.02),
+        dtype=jnp.float32, name="embed",
+    )
+
+
 class TransformerLM(nn.Module):
     """Causal LM: tokens (batch, seq) -> logits (batch, seq, vocab).
 
@@ -214,27 +241,10 @@ class TransformerLM(nn.Module):
     def __call__(self, tokens):
         b, s = tokens.shape
         d_ff = self.d_ff or 4 * self.d_model
-        if self.vocab_parallel:
-            if self.tp_axis is None:
-                raise ValueError(
-                    "vocab_parallel=True requires tp_axis (the vocab "
-                    "shards over the model axis)"
-                )
-            from chainermn_tpu.parallel import VocabParallelEmbed
-
-            # auto-generated name ("VocabParallelEmbed_0") keeps the
-            # param tree spec-derivable (the class marker must appear in
-            # the flax path)
-            embed = VocabParallelEmbed(
-                self.vocab_size, self.d_model, axis_name=self.tp_axis,
-                dtype=jnp.float32,
-            )
-        else:
-            embed = nn.Embed(
-                self.vocab_size, self.d_model,
-                embedding_init=nn.initializers.normal(0.02),
-                dtype=jnp.float32, name="embed",
-            )
+        embed = make_lm_embed(
+            self, self.vocab_size, self.d_model, self.tp_axis,
+            self.vocab_parallel,
+        )
         pos_table = self.param(
             "pos_embed", nn.initializers.normal(0.02),
             (self.max_len, self.d_model), jnp.float32,

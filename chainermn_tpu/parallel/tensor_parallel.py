@@ -107,6 +107,7 @@ class RowParallelDense(nn.Module):
 # is plain data.
 COLUMN_PARALLEL_NAMES: tuple = ()
 ROW_PARALLEL_NAMES: tuple = ()
+VOCAB_PARALLEL_NAMES: tuple = ()
 
 
 def _path_keys(path):
@@ -122,7 +123,7 @@ def _tp_owner_kind(keys) -> Optional[str]:
             return "col"
         if "RowParallel" in k or k in ROW_PARALLEL_NAMES:
             return "row"
-        if "VocabParallel" in k:
+        if "VocabParallel" in k or k in VOCAB_PARALLEL_NAMES:
             return "vocab"
     return None
 
@@ -234,9 +235,10 @@ def megatron_param_specs(params, model_axis: str = "tp"):
 
     Column kernels shard their output features (``P(None, axis)``, bias
     ``P(axis)``); Row kernels shard their input features
-    (``P(axis, None)``, bias replicated); everything else replicates.
-    For custom-named modules, build the spec tree by hand — it is plain
-    data.
+    (``P(axis, None)``, bias replicated); VocabParallelEmbed tables shard
+    their vocab rows (``P(axis, None)``); everything else replicates.
+    For custom-named modules, register the name in the ``*_NAMES``
+    tuples above or build the spec tree by hand — it is plain data.
     """
     from jax.sharding import PartitionSpec as P
     import jax.tree_util as jtu

@@ -74,6 +74,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--aux-coef", type=float, default=1e-2)
     p.add_argument("--report-every", type=int, default=20)
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="shard the embedding table + tied head over the "
+                        "model axis (Megatron vocab parallelism)")
     p.add_argument("--cpu-mesh", action="store_true",
                    help="run on a virtual CPU device mesh (testing)")
     args = p.parse_args(argv)
@@ -116,6 +119,7 @@ def main(argv=None):
         n_layers=args.n_layers, n_experts=args.n_experts, moe_every=2,
         k=2, capacity_factor=1.25, max_len=args.seq_len,
         seq_axis="mn_seq", tp_axis="mn_model", expert_axis="mn_model",
+        vocab_parallel=args.vocab_parallel,
         aux_stat_axes=("mn_data", "mn_seq", "mn_model"),
     )
 
@@ -142,6 +146,7 @@ def main(argv=None):
         return moe_lm_loss(
             model.apply(p, b), b, seq_axis="mn_seq",
             model_axis="mn_model", aux_coef=args.aux_coef,
+            vocab_parallel=args.vocab_parallel,
         )
 
     step = cmn.build_train_step(

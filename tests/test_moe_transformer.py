@@ -179,6 +179,87 @@ class TestFactorizationOracle:
         }
 
 
+class TestComposedVocabParallel:
+    """The fully-loaded flagship: DP x SP(ring) x TP x EP PLUS the
+    vocab-parallel embedding/head — factorization oracle on a
+    64-vocab model (divisible by the model-axis width)."""
+
+    def _run(self, comm, params_host, n_steps=2):
+        model = MoeTransformerLM(
+            vocab_size=64, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+            n_experts=EXPERTS, d_ff=FF, moe_every=2, k=2, capacity=CAP,
+            max_len=S, dtype=jnp.float32, seq_axis="mn_seq",
+            tp_axis="mn_model", expert_axis="mn_model",
+            vocab_parallel=True,
+            aux_stat_axes=("mn_data", "mn_seq", "mn_model"),
+        )
+        specs = moe_param_specs(params_host)
+        opt = cmn.create_multi_node_optimizer(optax.sgd(5e-2), comm)
+
+        def loss_fn(p, b):
+            return moe_lm_loss(
+                model.apply(p, b), b, seq_axis="mn_seq",
+                model_axis="mn_model", aux_coef=1e-2,
+                vocab_parallel=True,
+            )
+
+        step = build_train_step(
+            comm, loss_fn, opt, data_axes=comm.data_axis_names,
+            param_specs=specs, batch_specs=P("mn_data", "mn_seq"),
+            donate=False,
+        )
+        params, opt_state = step.place(params_host, opt.init(params_host))
+        toks = jnp.asarray(
+            np.random.RandomState(2).randint(0, 64, (B, S)), jnp.int32
+        )
+        batch = step.place_batch(toks)
+        losses = []
+        for _ in range(n_steps):
+            params, opt_state, m = step(params, opt_state, batch)
+            losses.append(float(m["loss"]))
+        return _host_tree(params), losses
+
+    def test_factorizations_agree(self, devices8):
+        comm222 = cmn.create_communicator(
+            "mesh", devices=devices8, sp_size=2, tp_size=2
+        )
+        comm111 = cmn.create_communicator(
+            "mesh", devices=devices8[:1], sp_size=1, tp_size=1
+        )
+        model = MoeTransformerLM(
+            vocab_size=64, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+            n_experts=EXPERTS, d_ff=FF, moe_every=2, k=2, capacity=CAP,
+            max_len=S, dtype=jnp.float32, seq_axis="mn_seq",
+            tp_axis="mn_model", expert_axis="mn_model",
+            vocab_parallel=True,
+            aux_stat_axes=("mn_data", "mn_seq", "mn_model"),
+        )
+        toks = jnp.asarray(
+            np.random.RandomState(2).randint(0, 64, (B, S)), jnp.int32
+        )
+        params, _ = sharded_init(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            comm222.mesh, (P("mn_data", "mn_seq"),), moe_param_specs,
+            toks,
+        )
+        emb = params["params"]["VocabParallelEmbed_0"]["embedding"]
+        assert emb.shape == (64, D)  # global vocab dim
+        assert {sh.data.shape for sh in emb.addressable_shards} == {
+            (32, D)
+        }
+        host = _host_tree(params)
+        p222, l222 = self._run(comm222, host)
+        p111, l111 = self._run(comm111, host)
+        assert all(np.isfinite(l222))
+        np.testing.assert_allclose(l222, l111, rtol=2e-4, atol=1e-5)
+        flat111 = dict(jax.tree_util.tree_leaves_with_path(p111))
+        for path, leaf in jax.tree_util.tree_leaves_with_path(p222):
+            np.testing.assert_allclose(
+                leaf, flat111[path], rtol=5e-4, atol=2e-5,
+                err_msg=jax.tree_util.keystr(path),
+            )
+
+
 class TestComposedTraining:
     def test_loss_decreases_with_aux(self, devices8):
         comm = cmn.create_communicator(

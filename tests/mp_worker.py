@@ -208,8 +208,9 @@ def scenario_composed_mesh(pid, nproc, scratch):
     """The composed DP x SP x TP x EP step across real processes: a
     (2, 2, 2) mesh spanning two jax.distributed processes (4 CPU chips
     each), MoeTransformerLM with ring attention / Megatron TP / expert
-    all_to_all, per-process local batch rows.  Asserts the loss is
-    finite, identical on every process, and decreasing."""
+    all_to_all / vocab-parallel embedding+head, per-process local batch
+    rows.  Asserts the loss is finite, identical on every process, and
+    decreasing."""
     import numpy as np
     import jax
     import jax.numpy as jnp
@@ -228,12 +229,12 @@ def scenario_composed_mesh(pid, nproc, scratch):
     comm = _comm("mesh", sp_size=2, tp_size=2)
     assert comm.process_count == nproc and comm.size == 8
 
-    B, S, V = 4, 16, 61
+    B, S, V = 4, 16, 64
     model = MoeTransformerLM(
         vocab_size=V, d_model=32, n_heads=4, n_layers=2, n_experts=4,
         d_ff=64, moe_every=2, k=2, capacity=B * S * 2, max_len=S,
         dtype=jnp.float32, seq_axis="mn_seq", tp_axis="mn_model",
-        expert_axis="mn_model",
+        expert_axis="mn_model", vocab_parallel=True,
         aux_stat_axes=("mn_data", "mn_seq", "mn_model"),
     )
     toks_global = np.random.RandomState(0).randint(0, V, (B, S))
@@ -247,7 +248,7 @@ def scenario_composed_mesh(pid, nproc, scratch):
     def loss_fn(p, b):
         return moe_lm_loss(
             model.apply(p, b), b, seq_axis="mn_seq",
-            model_axis="mn_model", aux_coef=1e-2,
+            model_axis="mn_model", aux_coef=1e-2, vocab_parallel=True,
         )
 
     step = build_train_step(

@@ -31,7 +31,7 @@ def __getattr__(name):
 
         return getattr(seq2seq, name)
     if name in ("TransformerLM", "TransformerBlock", "lm_loss",
-                "sp_lm_loss", "vp_lm_loss"):
+                "sp_lm_loss", "vp_lm_loss", "generate"):
         from . import transformer
 
         return getattr(transformer, name)

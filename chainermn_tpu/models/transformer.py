@@ -357,3 +357,82 @@ def vp_lm_loss(logits_local: jnp.ndarray, tokens: jnp.ndarray,
         logits_local[:, :-1], tokens[:, 1:], model_axis
     )
     return ce.mean()
+
+
+def generate(model: TransformerLM, params, prompt: jnp.ndarray,
+             max_new_tokens: int, *, temperature: float = 0.0,
+             rng=None) -> jnp.ndarray:
+    """Autoregressive sampling from a (dense, single-device) LM.
+
+    Greedy when ``temperature == 0``, else softmax sampling at the given
+    temperature.  One jitted ``fori_loop``; each step re-runs the causal
+    forward on the (statically padded) buffer — positions past the
+    frontier cannot influence earlier logits, so the recompute is exact.
+    A KV-cache decode tier would trade this O(n^2)-per-token recompute
+    for cache memory; at the model sizes in this repo the simple form is
+    compile-once (the loop is cached per (model, shapes, temperature))
+    and fast enough.  Works for any model whose apply returns logits or
+    a ``(logits, aux)`` pair — ``TransformerLM`` and a dense-mode
+    ``MoeTransformerLM`` (``expert_axis=None``) both qualify.
+    Sequence-/vocab-parallel variants are for training; materialize a
+    dense model (same param tree for ``seq_axis=None``) to sample.
+
+    Args:
+      prompt: (batch, prompt_len) int32 token ids.
+      max_new_tokens: tokens to append; ``prompt_len + max_new_tokens``
+        must fit ``model.max_len``.
+      rng: PRNGKey, required when ``temperature > 0``.
+    Returns:
+      (batch, prompt_len + max_new_tokens) tokens, prompt included.
+    """
+    b, s0 = prompt.shape
+    total = s0 + max_new_tokens
+    if total > model.max_len:
+        raise ValueError(
+            f"prompt_len + max_new_tokens = {total} exceeds "
+            f"max_len={model.max_len}"
+        )
+    if temperature > 0 and rng is None:
+        raise ValueError("temperature > 0 needs an rng key")
+    if rng is None:
+        rng = jax.random.PRNGKey(0)  # unused in greedy mode
+
+    buf0 = jnp.zeros((b, total), jnp.int32)
+    buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
+    loop = _generate_loop(model, s0, max_new_tokens, float(temperature))
+    buf, _ = loop(params, buf0, rng)
+    return buf
+
+
+@functools.lru_cache(maxsize=32)
+def _generate_loop(model, s0: int, max_new_tokens: int,
+                   temperature: float):
+    """Compiled sampling loop, cached per (model config, shapes,
+    temperature) so repeated generate() calls reuse the executable
+    (flax modules are frozen/hashable; a fresh jit per call would
+    re-trace every time)."""
+
+    @jax.jit
+    def run(params, buf0, key):
+        def body(i, carry):
+            buf, key = carry
+            out = model.apply(params, buf)
+            logits = out[0] if isinstance(out, tuple) else out
+            step_logits = lax.dynamic_index_in_dim(
+                logits, s0 + i - 1, axis=1, keepdims=False
+            )  # (b, V) at the frontier position
+            if temperature > 0:
+                key, sub = jax.random.split(key)
+                nxt = jax.random.categorical(
+                    sub, step_logits / temperature, axis=-1
+                )
+            else:
+                nxt = jnp.argmax(step_logits, axis=-1)
+            buf = lax.dynamic_update_slice(
+                buf, nxt[:, None].astype(jnp.int32), (0, s0 + i)
+            )
+            return buf, key
+
+        return lax.fori_loop(0, max_new_tokens, body, (buf0, key))
+
+    return run

@@ -207,6 +207,54 @@ class TestSequenceParallel:
         assert any(np.abs(np.asarray(l)).max() > 0 for l in leaves)
 
 
+class TestGenerate:
+    """Autoregressive sampling: the padded-buffer fori_loop must match a
+    growing-buffer python loop exactly (causality makes the recompute
+    exact)."""
+
+    def _setup(self):
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=32, dtype=jnp.float32,
+        )
+        prompt = _tokens(b=2, s=4, seed=5)
+        params = model.init(jax.random.PRNGKey(0), prompt)
+        return model, params, prompt
+
+    def test_greedy_matches_python_loop(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        fast = generate(model, params, prompt, 6)
+        buf = prompt
+        for _ in range(6):
+            logits = model.apply(params, buf)
+            nxt = jnp.argmax(logits[:, -1], axis=-1)
+            buf = jnp.concatenate([buf, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(buf))
+
+    def test_sampling_deterministic_given_key(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        key = jax.random.PRNGKey(7)
+        a = generate(model, params, prompt, 5, temperature=0.8, rng=key)
+        bb = generate(model, params, prompt, 5, temperature=0.8, rng=key)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
+        assert np.asarray(a).max() < VOCAB and np.asarray(a).min() >= 0
+
+    def test_overflow_and_missing_rng_rejected(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        with pytest.raises(ValueError, match="max_len"):
+            generate(model, params, prompt, 40)
+        with pytest.raises(ValueError, match="rng"):
+            generate(model, params, prompt, 2, temperature=0.5)
+
+
 class TestTraining:
     def test_dp_train_step_learns(self, devices8):
         comm = cmn.create_communicator("tpu", devices=devices8)

@@ -64,7 +64,49 @@ class SelfAttention(nn.Module):
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
     sp_impl: str = "ring"
+    # KV-cache decode mode: keys/values accumulate in 'cache' variables
+    # of length cache_len; each call appends its s positions and attends
+    # against everything cached so far.  Single-device, causal only.
+    decode: bool = False
+    cache_len: int = 0
     attention_fn: Optional[Callable] = None
+
+    def _decode_attend(self, q, k, v, b, heads, dh, scale):
+        """Append k/v to the cache and attend q against the filled
+        prefix — exact causal attention at O(cache_len) per step."""
+        ck = self.variable(
+            "cache", "cached_key", jnp.zeros,
+            (b, self.cache_len, heads, dh), jnp.float32,
+        )
+        cv = self.variable(
+            "cache", "cached_value", jnp.zeros,
+            (b, self.cache_len, heads, dh), jnp.float32,
+        )
+        ci = self.variable(
+            "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
+        )
+        idx = ci.value
+        ck.value = lax.dynamic_update_slice(
+            ck.value, k.astype(jnp.float32), (0, idx, 0, 0)
+        )
+        cv.value = lax.dynamic_update_slice(
+            cv.value, v.astype(jnp.float32), (0, idx, 0, 0)
+        )
+        s = q.shape[1]
+        ci.value = idx + s
+        scores = jnp.einsum(
+            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.value
+        ) * scale
+        kpos = jnp.arange(self.cache_len)[None, :]
+        qpos = idx + jnp.arange(s)[:, None]
+        mask = kpos <= qpos  # causal AND only-written positions
+        scores = jnp.where(mask[None, None], scores, -1e30)
+        # Overflowing the cache would otherwise be silently clamped by
+        # dynamic_update_slice (the failure the static max_len guard
+        # prevents in training mode) — poison the logits loudly instead.
+        scores = jnp.where(idx + s > self.cache_len, jnp.nan, scores)
+        p = jax.nn.softmax(scores, axis=-1)
+        return jnp.einsum("bhqk,bkhd->bqhd", p, cv.value).astype(q.dtype)
 
     @nn.compact
     def __call__(self, x, *, causal: bool = True):
@@ -102,7 +144,17 @@ class SelfAttention(nn.Module):
         q = q.reshape(b, s, heads, dh)
         k = k.reshape(b, s, heads, dh)
         v = v.reshape(b, s, heads, dh)
-        if self.seq_axis is not None:
+        if self.decode:
+            if self.seq_axis is not None or self.tp_axis is not None:
+                raise ValueError(
+                    "decode mode is single-device (no seq/tp axes)"
+                )
+            if not causal:
+                raise ValueError("decode mode implies causal attention")
+            if self.cache_len <= 0:
+                raise ValueError("decode mode needs cache_len > 0")
+            out = self._decode_attend(q, k, v, b, heads, dh, dh**-0.5)
+        elif self.seq_axis is not None:
             if self.sp_impl == "ring":
                 from chainermn_tpu.parallel import ring_attention
 
@@ -166,6 +218,8 @@ class TransformerBlock(nn.Module):
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
     sp_impl: str = "ring"
+    decode: bool = False
+    cache_len: int = 0
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -174,6 +228,7 @@ class TransformerBlock(nn.Module):
         x = x + SelfAttention(
             self.n_heads, dtype=self.dtype, seq_axis=self.seq_axis,
             tp_axis=self.tp_axis, sp_impl=self.sp_impl,
+            decode=self.decode, cache_len=self.cache_len,
             attention_fn=self.attention_fn,
         )(ln()(x).astype(self.dtype))
         if self.tp_axis is not None:
@@ -230,6 +285,11 @@ class TransformerLM(nn.Module):
     seq_axis: Optional[str] = None
     tp_axis: Optional[str] = None
     sp_impl: str = "ring"
+    # KV-cache decode: see SelfAttention.decode; generate(use_cache=True)
+    # builds the decode-mode twin automatically, sizing cache_len to the
+    # actual generation length (0 = default to max_len).
+    decode: bool = False
+    cache_len: int = 0
     # Shard the embedding table AND the tied output head over tp_axis
     # (Megatron VocabParallelEmbedding): logits come back as the LOCAL
     # vocab block — train with vp_lm_loss, which assembles the softmax
@@ -267,6 +327,15 @@ class TransformerLM(nn.Module):
                 f"sequence length {s} exceeds max_len={self.max_len}; "
                 "raise max_len"
             )
+        if self.decode:
+            # global position of this call's first token = tokens cached
+            # so far (a dedicated counter so the embedding stays in sync
+            # with the attention caches)
+            pos_idx = self.variable(
+                "cache", "pos_index", lambda: jnp.zeros((), jnp.int32)
+            )
+            offset = pos_idx.value
+            pos_idx.value = offset + s
         pos = lax.dynamic_slice_in_dim(pos_table, offset, s, axis=0)
 
         x = (embed(tokens) + pos[None]).astype(self.dtype)
@@ -274,7 +343,9 @@ class TransformerLM(nn.Module):
             x = TransformerBlock(
                 self.n_heads, d_ff, dtype=self.dtype,
                 seq_axis=self.seq_axis, tp_axis=self.tp_axis,
-                sp_impl=self.sp_impl, attention_fn=self.attention_fn,
+                sp_impl=self.sp_impl, decode=self.decode,
+                cache_len=self.cache_len or self.max_len,
+                attention_fn=self.attention_fn,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
         # Weight-tied head.
@@ -361,27 +432,34 @@ def vp_lm_loss(logits_local: jnp.ndarray, tokens: jnp.ndarray,
 
 def generate(model: TransformerLM, params, prompt: jnp.ndarray,
              max_new_tokens: int, *, temperature: float = 0.0,
-             rng=None) -> jnp.ndarray:
+             rng=None, use_cache: Optional[bool] = None) -> jnp.ndarray:
     """Autoregressive sampling from a (dense, single-device) LM.
 
     Greedy when ``temperature == 0``, else softmax sampling at the given
-    temperature.  One jitted ``fori_loop``; each step re-runs the causal
-    forward on the (statically padded) buffer — positions past the
-    frontier cannot influence earlier logits, so the recompute is exact.
-    A KV-cache decode tier would trade this O(n^2)-per-token recompute
-    for cache memory; at the model sizes in this repo the simple form is
-    compile-once (the loop is cached per (model, shapes, temperature))
-    and fast enough.  Works for any model whose apply returns logits or
-    a ``(logits, aux)`` pair — ``TransformerLM`` and a dense-mode
-    ``MoeTransformerLM`` (``expert_axis=None``) both qualify.
-    Sequence-/vocab-parallel variants are for training; materialize a
-    dense model (same param tree for ``seq_axis=None``) to sample.
+    temperature.  Two tiers, numerically identical (pinned by test):
+
+    * ``use_cache=True`` (default for cache-capable models): the model's
+      decode-mode twin prefills the prompt once, then each new token
+      attends against the KV cache — O(max_len) per token.
+    * ``use_cache=False``: one jitted ``fori_loop`` re-running the
+      causal forward on a statically padded buffer each step — positions
+      past the frontier cannot influence earlier logits, so the
+      recompute is exact.  Works for ANY logits-or-(logits, aux) model
+      (e.g. a dense-mode ``MoeTransformerLM``, which has no decode
+      mode yet).
+
+    Both compiled loops are cached per (model config, shapes,
+    temperature).  Sequence-/vocab-parallel variants are for training;
+    materialize a dense model (same param tree for ``seq_axis=None``)
+    to sample.
 
     Args:
       prompt: (batch, prompt_len) int32 token ids.
       max_new_tokens: tokens to append; ``prompt_len + max_new_tokens``
         must fit ``model.max_len``.
       rng: PRNGKey, required when ``temperature > 0``.
+      use_cache: ``None`` auto-selects (cache when the model supports
+        decode mode and runs single-device dense).
     Returns:
       (batch, prompt_len + max_new_tokens) tokens, prompt included.
     """
@@ -396,12 +474,110 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
         raise ValueError("temperature > 0 needs an rng key")
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused in greedy mode
+    parallel = (
+        getattr(model, "seq_axis", None) is not None
+        or getattr(model, "tp_axis", None) is not None
+        or getattr(model, "vocab_parallel", False)
+    )
+    if parallel:
+        raise ValueError(
+            "generate() samples from single-device dense models; "
+            "construct one with seq_axis/tp_axis=None, "
+            "vocab_parallel=False (the param tree is compatible)"
+        )
+    if use_cache is None:
+        use_cache = _has_decode_field(model)
+    if use_cache:
+        loop = _cached_decode_loop(
+            _decode_twin(model, total), s0, max_new_tokens,
+            float(temperature),
+        )
+        return loop(params, prompt, rng)
 
     buf0 = jnp.zeros((b, total), jnp.int32)
     buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
     loop = _generate_loop(model, s0, max_new_tokens, float(temperature))
     buf, _ = loop(params, buf0, rng)
     return buf
+
+
+def _has_decode_field(model) -> bool:
+    import dataclasses
+
+    try:
+        return "decode" in {f.name for f in dataclasses.fields(model)}
+    except TypeError:
+        return False
+
+
+def _decode_twin(model, cache_len: int):
+    """The same architecture with ``decode=True`` and caches sized to
+    the actual generation length (not max_len — a short sample from a
+    long-context model shouldn't pay full-context attention per step);
+    parameters are layout-identical."""
+    import dataclasses
+
+    if not _has_decode_field(model):
+        raise ValueError(
+            f"{type(model).__name__} has no decode mode; call "
+            "generate(..., use_cache=False) for the recompute tier"
+        )
+    fields = {
+        f.name: getattr(model, f.name)
+        for f in dataclasses.fields(model)
+        if f.name not in ("parent", "name")
+    }
+    fields["decode"] = True
+    if "cache_len" in fields:
+        fields["cache_len"] = cache_len
+    return type(model)(**fields)
+
+
+def _sample(step_logits, key, temperature: float):
+    """One sampling decision — shared by both generate tiers so their
+    pinned numerical identity can't drift (same key-split order)."""
+    if temperature > 0:
+        key, sub = jax.random.split(key)
+        return jax.random.categorical(
+            sub, step_logits / temperature, axis=-1
+        ).astype(jnp.int32), key
+    return jnp.argmax(step_logits, axis=-1).astype(jnp.int32), key
+
+
+@functools.lru_cache(maxsize=32)
+def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
+                        temperature: float):
+    """Compiled KV-cache sampling: prefill the prompt, then scan one
+    token at a time against the caches."""
+
+    @jax.jit
+    def run(params, prompt, key):
+        logits, mut = dmodel.apply(params, prompt, mutable=["cache"])
+        cache = mut["cache"]
+        nxt, key = _sample(
+            logits[:, -1].astype(jnp.float32), key, temperature
+        )
+
+        def body(carry, _):
+            cache, tok, key = carry
+            logits, mut = dmodel.apply(
+                {**params, "cache": cache}, tok[:, None],
+                mutable=["cache"],
+            )
+            nxt, key = _sample(
+                logits[:, -1].astype(jnp.float32), key, temperature
+            )
+            return (mut["cache"], nxt, key), nxt
+
+        (_, _, key), rest = lax.scan(
+            body, (cache, nxt, key), None, length=max_new_tokens - 1
+        )
+        new = jnp.concatenate(
+            [nxt[:, None], jnp.moveaxis(rest, 0, 1)], axis=1
+        ) if max_new_tokens > 1 else nxt[:, None]
+        return jnp.concatenate([prompt, new], axis=1)
+
+    return run
 
 
 @functools.lru_cache(maxsize=32)
@@ -421,15 +597,11 @@ def _generate_loop(model, s0: int, max_new_tokens: int,
             step_logits = lax.dynamic_index_in_dim(
                 logits, s0 + i - 1, axis=1, keepdims=False
             )  # (b, V) at the frontier position
-            if temperature > 0:
-                key, sub = jax.random.split(key)
-                nxt = jax.random.categorical(
-                    sub, step_logits / temperature, axis=-1
-                )
-            else:
-                nxt = jnp.argmax(step_logits, axis=-1)
+            nxt, key = _sample(
+                step_logits.astype(jnp.float32), key, temperature
+            )
             buf = lax.dynamic_update_slice(
-                buf, nxt[:, None].astype(jnp.int32), (0, s0 + i)
+                buf, nxt[:, None], (0, s0 + i)
             )
             return buf, key
 

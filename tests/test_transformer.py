@@ -227,13 +227,63 @@ class TestGenerate:
         from chainermn_tpu.models.transformer import generate
 
         model, params, prompt = self._setup()
-        fast = generate(model, params, prompt, 6)
+        fast = generate(model, params, prompt, 6, use_cache=False)
         buf = prompt
         for _ in range(6):
             logits = model.apply(params, buf)
             nxt = jnp.argmax(logits[:, -1], axis=-1)
             buf = jnp.concatenate([buf, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(buf))
+
+    def test_kv_cache_matches_recompute(self):
+        """The decode-mode twin (prefill + per-token cache attention)
+        must emit the same tokens as the full-recompute tier."""
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        slow = generate(model, params, prompt, 6, use_cache=False)
+        fast = generate(model, params, prompt, 6, use_cache=True)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+        # and the auto-selected default is the cache path
+        auto = generate(model, params, prompt, 6)
+        np.testing.assert_array_equal(np.asarray(auto), np.asarray(slow))
+
+    def test_kv_cache_single_token(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        a = generate(model, params, prompt, 1, use_cache=True)
+        b2 = generate(model, params, prompt, 1, use_cache=False)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
+
+    def test_moe_model_without_decode_gets_clear_error(self):
+        from chainermn_tpu.models.moe_transformer import MoeTransformerLM
+        from chainermn_tpu.models.transformer import generate
+
+        moe = MoeTransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
+        )
+        prompt = _tokens(b=1, s=4)
+        with pytest.raises(ValueError, match="no decode mode"):
+            generate(moe, {}, prompt, 2, use_cache=True)
+        # and the recompute tier works for it (auto-selected)
+        params = moe.init(jax.random.PRNGKey(0), prompt)
+        out = generate(moe, params, prompt, 3)
+        assert out.shape == (1, 7)
+
+    def test_parallel_model_rejected(self):
+        from chainermn_tpu.models.transformer import (
+            TransformerLM,
+            generate,
+        )
+
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=1,
+            max_len=32, dtype=jnp.float32, seq_axis="mn",
+        )
+        with pytest.raises(ValueError, match="single-device"):
+            generate(model, {}, _tokens(b=1, s=4), 2)
 
     def test_sampling_deterministic_given_key(self):
         from chainermn_tpu.models.transformer import generate

@@ -1,27 +1,32 @@
-// Native input pipeline: threaded batch loader with crop/flip/normalize.
+// Native input pipelines: threaded batch loaders producing into a fixed
+// ring of reusable staging slots.
 //
 // Role in the framework (SURVEY.md section 2, "native-code obligations"):
 // the reference leans on Chainer's MultiprocessIterator plus
 // HostPinnedMemory staging (chainermn/communicators/_memory_utility.py)
-// for its ImageNet input path.  The TPU rebuild's equivalent host-side
-// bottleneck is batch assembly + augmentation ahead of device_put; this
-// library does that work in C++ worker threads, entirely off the Python
-// GIL, producing ready float batches into a fixed ring of reusable slots
-// (the moral analogue of pinned staging buffers).
+// for its input path.  The TPU rebuild's equivalent host-side bottleneck
+// is batch assembly + augmentation ahead of device_put; these loaders do
+// that work in C++ worker threads, entirely off the Python GIL.
 //
-// Design:
-//  * Source data is an in-memory (or mmapped) uint8 tensor (N,H,W,C) with
-//    int32 labels — the array-backed dataset shape the framework's
-//    npz/memmap datasets provide.
+// Two concrete loaders over one ring engine (RingLoader):
+//  * Image loader — uint8 (N,H,W,C) + int32 labels; crop / flip /
+//    normalize into float batches (the ImageNet path).
+//  * Token loader — a flat int32 token stream; shuffled fixed-length
+//    windows into (batch, seq_len) int32 batches (the LM path).
+//
+// Shared design:
 //  * Worker threads claim batch tickets from an atomic counter; ticket b
 //    fills ring slot b % ring_size, so consumption order is deterministic
 //    regardless of thread count.
 //  * Per-epoch shuffle permutations are seeded by (seed + epoch) and
 //    cached for the two epochs that can be in flight at once; per-sample
-//    crop/flip randomness is seeded by (seed, global sample ordinal), so
-//    results are reproducible for any thread count.
+//    randomness is seeded by (seed, global sample ordinal), so results
+//    are reproducible for any thread count.
 //  * The consumer acquires a slot (blocking), reads the batch (zero-copy
 //    view from Python), and releases it back to the producers.
+//  * seek(iteration) repositions the stream in O(ring) — determinism is
+//    keyed on (seed, ticket), so the post-seek stream is bit-identical
+//    to a fresh loader consumed to the same point.
 //
 // Built with plain g++ -shared (no pybind11 in this environment); the
 // Python side binds via ctypes (chainermn_tpu/utils/native_loader.py).
@@ -52,47 +57,166 @@ struct Slot {
   std::condition_variable cv_free;
 };
 
-struct Loader {
-  const uint8_t* data;
-  const int32_t* labels;
-  int n, h, w, c;
-  int batch, crop_h, crop_w;
-  int ring_size;
-  uint64_t seed;
-  bool shuffle, train;
-  std::vector<float> mean, stddev;
+// The ring engine: tickets, slots, workers, permutation cache, seek.
+// Subclasses define one epoch's batch count, per-slot buffer sizes, and
+// how a ticket's batch is filled.
+struct RingLoader {
+  int ring_size = 0;
+  int n_threads = 0;
+  uint64_t seed = 0;
+  bool shuffle = false;
+  long long batches_per_epoch = 0;
+  long long perm_len = 0;  // permutation domain (samples or windows)
 
-  long long batches_per_epoch;
-  int n_threads;
   std::atomic<long long> next_ticket{0};
   long long consume_idx = 0;
   std::atomic<bool> stop{false};
   std::vector<std::unique_ptr<Slot>> slots;
   std::vector<std::thread> workers;
 
-  // Permutation cache: epoch -> order. Only a sliding window of epochs is
-  // ever in flight (ring_size < batches_per_epoch * window).
+  // Permutation cache: epoch -> order. Only a sliding window of epochs
+  // is ever in flight (ring_size <= batches_per_epoch).
   std::mutex perm_m;
   long long perm_epochs[2] = {-1, -1};
   std::vector<uint32_t> perms[2];
+
+  virtual ~RingLoader() = default;
+  virtual void fill_batch(Slot& s, long long ticket) = 0;
+  virtual void size_slot(Slot& s) = 0;
 
   const std::vector<uint32_t>& perm_for_epoch(long long e) {
     std::lock_guard<std::mutex> g(perm_m);
     int slot = static_cast<int>(e & 1);
     if (perm_epochs[slot] != e) {
       std::vector<uint32_t>& p = perms[slot];
-      p.resize(n);
+      p.resize(perm_len);
       std::iota(p.begin(), p.end(), 0u);
       if (shuffle) {
         std::mt19937_64 rng(seed + 0x9e3779b97f4a7c15ULL * (e + 1));
-        for (int i = n - 1; i > 0; --i) {
-          std::uniform_int_distribution<int> d(0, i);
+        for (long long i = perm_len - 1; i > 0; --i) {
+          std::uniform_int_distribution<long long> d(0, i);
           std::swap(p[i], p[d(rng)]);
         }
       }
       perm_epochs[slot] = e;
     }
     return perms[slot];
+  }
+
+  // Returns false on invalid config.
+  bool start(int ring, int threads) {
+    if (batches_per_epoch <= 0 || ring <= 0 || threads <= 0) return false;
+    // The two-entry (epoch parity) permutation cache is only safe while
+    // concurrently-filling tickets span at most two consecutive epochs;
+    // clamping ring to one epoch's batch count guarantees that.
+    if (ring > batches_per_epoch)
+      ring = static_cast<int>(batches_per_epoch);
+    ring_size = ring;
+    n_threads = threads;
+    for (int i = 0; i < ring_size; ++i) {
+      auto s = std::make_unique<Slot>();
+      size_slot(*s);
+      s->next_fill = i;  // slot i's first ticket is i
+      slots.push_back(std::move(s));
+    }
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { worker(); });
+    return true;
+  }
+
+  void worker() {
+    while (!stop.load(std::memory_order_relaxed)) {
+      long long ticket = next_ticket.fetch_add(1);
+      Slot& s = *slots[ticket % ring_size];
+      {
+        std::unique_lock<std::mutex> lk(s.m);
+        s.cv_free.wait(lk, [&] {
+          return stop.load() || (s.ready_batch == -1 && !s.in_use &&
+                                 s.next_fill == ticket);
+        });
+        if (stop.load()) return;
+      }
+      fill_batch(s, ticket);
+      {
+        std::lock_guard<std::mutex> lk(s.m);
+        s.ready_batch = ticket;
+        s.next_fill = ticket + ring_size;
+      }
+      s.cv_ready.notify_all();
+    }
+  }
+
+  // Blocks until the next batch (deterministic ticket order) is ready;
+  // returns the slot index or -1 after shutdown.
+  int acquire(Slot** out) {
+    long long want = consume_idx;
+    Slot& s = *slots[want % ring_size];
+    std::unique_lock<std::mutex> lk(s.m);
+    s.cv_ready.wait(lk, [&] { return stop.load() || s.ready_batch == want; });
+    if (stop.load()) return -1;
+    s.in_use = true;
+    *out = &s;
+    consume_idx++;
+    return static_cast<int>(want % ring_size);
+  }
+
+  void release(int slot) {
+    Slot& s = *slots[slot];
+    {
+      std::lock_guard<std::mutex> lk(s.m);
+      s.in_use = false;
+      s.ready_batch = -1;
+    }
+    s.cv_free.notify_all();
+  }
+
+  void halt_workers() {
+    stop.store(true);
+    for (auto& s : slots) {
+      s->cv_free.notify_all();
+      s->cv_ready.notify_all();
+    }
+    for (auto& t : workers) t.join();
+    workers.clear();
+  }
+
+  // Reposition so the next acquire returns `iteration` — O(ring),
+  // independent of how deep into training the target is.
+  int seek(long long iteration) {
+    if (iteration < 0) return -1;
+    halt_workers();
+    stop.store(false);
+    next_ticket.store(iteration);
+    consume_idx = iteration;
+    long long r = iteration % ring_size;
+    for (int j = 0; j < ring_size; ++j) {
+      Slot& s = *slots[j];
+      std::lock_guard<std::mutex> lk(s.m);
+      s.ready_batch = -1;
+      s.in_use = false;
+      // first ticket >= iteration that lands in slot j
+      s.next_fill = iteration + ((j - r + ring_size) % ring_size);
+    }
+    for (int i = 0; i < n_threads; ++i)
+      workers.emplace_back([this] { worker(); });
+    return 0;
+  }
+};
+
+// ---------------------------------------------------------------------
+// Image loader: crop / flip / normalize (the ImageNet path).
+// ---------------------------------------------------------------------
+struct ImageLoader : RingLoader {
+  const uint8_t* data;
+  const int32_t* labels;
+  int n, h, w, c;
+  int batch, crop_h, crop_w;
+  bool train;
+  std::vector<float> mean, stddev;
+
+  void size_slot(Slot& s) override {
+    s.x.resize(static_cast<size_t>(batch) * crop_h * crop_w * c);
+    s.y.resize(batch);
   }
 
   void fill_sample(float* dst, uint32_t src_idx, uint64_t sample_ordinal) {
@@ -118,7 +242,7 @@ struct Loader {
     }
   }
 
-  void fill_batch(Slot& s, long long ticket) {
+  void fill_batch(Slot& s, long long ticket) override {
     long long e = ticket / batches_per_epoch;
     long long b_in_epoch = ticket % batches_per_epoch;
     const std::vector<uint32_t>& p = perm_for_epoch(e);
@@ -130,26 +254,30 @@ struct Loader {
                   idx, static_cast<uint64_t>(e) * n + ordinal);
     }
   }
+};
 
-  void worker() {
-    while (!stop.load(std::memory_order_relaxed)) {
-      long long ticket = next_ticket.fetch_add(1);
-      Slot& s = *slots[ticket % ring_size];
-      {
-        std::unique_lock<std::mutex> lk(s.m);
-        s.cv_free.wait(lk, [&] {
-          return stop.load() || (s.ready_batch == -1 && !s.in_use &&
-                                 s.next_fill == ticket);
-        });
-        if (stop.load()) return;
-      }
-      fill_batch(s, ticket);
-      {
-        std::lock_guard<std::mutex> lk(s.m);
-        s.ready_batch = ticket;
-        s.next_fill = ticket + ring_size;
-      }
-      s.cv_ready.notify_all();
+// ---------------------------------------------------------------------
+// Token loader: shuffled fixed-length windows of a flat token stream
+// (the LM path).  Window w covers tokens [w*seq_len, (w+1)*seq_len).
+// ---------------------------------------------------------------------
+struct TokenLoader : RingLoader {
+  const int32_t* tokens;
+  long long n_tokens;
+  int batch, seq_len;
+
+  void size_slot(Slot& s) override {
+    s.y.resize(static_cast<size_t>(batch) * seq_len);
+  }
+
+  void fill_batch(Slot& s, long long ticket) override {
+    long long e = ticket / batches_per_epoch;
+    long long b_in_epoch = ticket % batches_per_epoch;
+    const std::vector<uint32_t>& p = perm_for_epoch(e);
+    for (int i = 0; i < batch; ++i) {
+      uint32_t window = p[b_in_epoch * batch + i];
+      std::memcpy(s.y.data() + static_cast<size_t>(i) * seq_len,
+                  tokens + static_cast<long long>(window) * seq_len,
+                  static_cast<size_t>(seq_len) * sizeof(int32_t));
     }
   }
 };
@@ -166,123 +294,85 @@ void* cmn_loader_create(const uint8_t* data, const int32_t* labels, int n,
   if (!data || !labels || n <= 0 || batch <= 0 || batch > n ||
       crop_h > h || crop_w > w || n_threads <= 0 || ring_size <= 0)
     return nullptr;
-  Loader* L = new Loader();
+  ImageLoader* L = new ImageLoader();
   L->data = data;
   L->labels = labels;
   L->n = n; L->h = h; L->w = w; L->c = c;
   L->batch = batch; L->crop_h = crop_h; L->crop_w = crop_w;
-  L->ring_size = ring_size;
-  L->n_threads = n_threads;
   L->seed = seed;
   L->shuffle = shuffle != 0;
   L->train = train != 0;
   L->mean.assign(mean, mean + c);
   L->stddev.assign(stddev, stddev + c);
   L->batches_per_epoch = n / batch;  // drop-last semantics
-  if (L->batches_per_epoch == 0) { delete L; return nullptr; }
-  // The two-entry (epoch parity) permutation cache is only safe while
-  // concurrently-filling tickets span at most two consecutive epochs.
-  // Fills in flight cover tickets [consume_idx, consume_idx + ring), so
-  // clamping ring to one epoch's batch count guarantees that: a fill for
-  // epoch e+2 can only start after every epoch-e ticket was consumed.
-  if (ring_size > L->batches_per_epoch)
-    ring_size = static_cast<int>(L->batches_per_epoch);
-  L->ring_size = ring_size;
-  for (int i = 0; i < ring_size; ++i) {
-    auto s = std::make_unique<Slot>();
-    s->x.resize(static_cast<size_t>(batch) * crop_h * crop_w * c);
-    s->y.resize(batch);
-    s->next_fill = i;  // slot i's first ticket is i
-    L->slots.push_back(std::move(s));
-  }
-  for (int i = 0; i < n_threads; ++i)
-    L->workers.emplace_back([L] { L->worker(); });
-  return L;
+  L->perm_len = n;
+  if (!L->start(ring_size, n_threads)) { delete L; return nullptr; }
+  return static_cast<RingLoader*>(L);
+}
+
+void* cmn_token_loader_create(const int32_t* tokens, long long n_tokens,
+                              int batch, int seq_len, int n_threads,
+                              int ring_size, uint64_t seed, int shuffle) {
+  if (!tokens || n_tokens <= 0 || batch <= 0 || seq_len <= 0 ||
+      n_threads <= 0 || ring_size <= 0)
+    return nullptr;
+  TokenLoader* L = new TokenLoader();
+  L->tokens = tokens;
+  L->n_tokens = n_tokens;
+  L->batch = batch;
+  L->seq_len = seq_len;
+  L->seed = seed;
+  L->shuffle = shuffle != 0;
+  long long windows = n_tokens / seq_len;
+  L->perm_len = windows;
+  L->batches_per_epoch = windows / batch;  // drop-last
+  if (!L->start(ring_size, n_threads)) { delete L; return nullptr; }
+  return static_cast<RingLoader*>(L);
 }
 
 // Blocks until the next batch (in deterministic ticket order) is ready.
 // Returns the slot id (>= 0) and sets *x / *y to the slot's buffers;
 // the caller must cmn_loader_release(slot) before that slot can be
-// reused.  Returns -1 after shutdown.
+// reused.  Returns -1 after shutdown.  For token loaders *x is null.
 int cmn_loader_acquire(void* handle, float** x, int32_t** y) {
-  Loader* L = static_cast<Loader*>(handle);
-  long long want = L->consume_idx;
-  Slot& s = *L->slots[want % L->ring_size];
-  std::unique_lock<std::mutex> lk(s.m);
-  s.cv_ready.wait(lk, [&] { return L->stop.load() || s.ready_batch == want; });
-  if (L->stop.load()) return -1;
-  s.in_use = true;
-  *x = s.x.data();
-  *y = s.y.data();
-  L->consume_idx++;
-  return static_cast<int>(want % L->ring_size);
+  RingLoader* L = static_cast<RingLoader*>(handle);
+  Slot* s = nullptr;
+  int slot = L->acquire(&s);
+  if (slot < 0) return -1;
+  if (x) *x = s->x.empty() ? nullptr : s->x.data();
+  if (y) *y = s->y.data();
+  return slot;
 }
 
 void cmn_loader_release(void* handle, int slot) {
-  Loader* L = static_cast<Loader*>(handle);
-  Slot& s = *L->slots[slot];
-  {
-    std::lock_guard<std::mutex> lk(s.m);
-    s.in_use = false;
-    s.ready_batch = -1;
-  }
-  s.cv_free.notify_all();
+  static_cast<RingLoader*>(handle)->release(slot);
 }
 
 long long cmn_loader_epoch(void* handle) {
-  Loader* L = static_cast<Loader*>(handle);
+  RingLoader* L = static_cast<RingLoader*>(handle);
   return L->consume_idx / L->batches_per_epoch;
 }
 
 long long cmn_loader_iteration(void* handle) {
-  return static_cast<Loader*>(handle)->consume_idx;
+  return static_cast<RingLoader*>(handle)->consume_idx;
 }
 
 long long cmn_loader_batches_per_epoch(void* handle) {
-  return static_cast<Loader*>(handle)->batches_per_epoch;
+  return static_cast<RingLoader*>(handle)->batches_per_epoch;
 }
 
 // Reposition the stream so the next acquire returns ticket `iteration`
 // (forwards or backwards), without producing and discarding the skipped
-// batches.  Determinism is keyed on (seed, ticket), so the post-seek
-// stream is bit-identical to a fresh loader consumed to the same point.
-// Quiesces the worker threads, resets the ring, and restarts them —
-// milliseconds, independent of how deep into training the target is.
+// batches.
 int cmn_loader_seek(void* handle, long long iteration) {
-  Loader* L = static_cast<Loader*>(handle);
-  if (!L || iteration < 0) return -1;
-  L->stop.store(true);
-  for (auto& s : L->slots) {
-    s->cv_free.notify_all();
-    s->cv_ready.notify_all();
-  }
-  for (auto& t : L->workers) t.join();
-  L->workers.clear();
-  L->stop.store(false);
-  L->next_ticket.store(iteration);
-  L->consume_idx = iteration;
-  long long r = iteration % L->ring_size;
-  for (int j = 0; j < L->ring_size; ++j) {
-    Slot& s = *L->slots[j];
-    std::lock_guard<std::mutex> lk(s.m);
-    s.ready_batch = -1;
-    s.in_use = false;
-    // first ticket >= iteration that lands in slot j
-    s.next_fill = iteration + ((j - r + L->ring_size) % L->ring_size);
-  }
-  for (int i = 0; i < L->n_threads; ++i)
-    L->workers.emplace_back([L] { L->worker(); });
-  return 0;
+  RingLoader* L = static_cast<RingLoader*>(handle);
+  if (!L) return -1;
+  return L->seek(iteration);
 }
 
 void cmn_loader_destroy(void* handle) {
-  Loader* L = static_cast<Loader*>(handle);
-  L->stop.store(true);
-  for (auto& s : L->slots) {
-    s->cv_free.notify_all();
-    s->cv_ready.notify_all();
-  }
-  for (auto& t : L->workers) t.join();
+  RingLoader* L = static_cast<RingLoader*>(handle);
+  L->halt_workers();
   delete L;
 }
 

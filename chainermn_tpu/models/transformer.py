@@ -73,40 +73,51 @@ class SelfAttention(nn.Module):
 
     def _decode_attend(self, q, k, v, b, heads, dh, scale):
         """Append k/v to the cache and attend q against the filled
-        prefix — exact causal attention at O(cache_len) per step."""
+        prefix — exact causal attention at O(cache_len) per step.
+
+        The dtype flow mirrors ``ops.multi_head_attention`` exactly
+        (caches in compute dtype, QK einsum in compute dtype then fp32
+        softmax, probs cast back for the PV einsum) so the KV-cache and
+        recompute generate tiers stay token-for-token identical for
+        bf16 models too."""
         ck = self.variable(
             "cache", "cached_key", jnp.zeros,
-            (b, self.cache_len, heads, dh), jnp.float32,
+            (b, self.cache_len, heads, dh), q.dtype,
         )
         cv = self.variable(
             "cache", "cached_value", jnp.zeros,
-            (b, self.cache_len, heads, dh), jnp.float32,
+            (b, self.cache_len, heads, dh), q.dtype,
         )
         ci = self.variable(
             "cache", "cache_index", lambda: jnp.zeros((), jnp.int32)
         )
         idx = ci.value
         ck.value = lax.dynamic_update_slice(
-            ck.value, k.astype(jnp.float32), (0, idx, 0, 0)
+            ck.value, k.astype(q.dtype), (0, idx, 0, 0)
         )
         cv.value = lax.dynamic_update_slice(
-            cv.value, v.astype(jnp.float32), (0, idx, 0, 0)
+            cv.value, v.astype(q.dtype), (0, idx, 0, 0)
         )
         s = q.shape[1]
         ci.value = idx + s
         scores = jnp.einsum(
-            "bqhd,bkhd->bhqk", q.astype(jnp.float32), ck.value
-        ) * scale
+            "bqhd,bkhd->bhqk", q, ck.value
+        ).astype(jnp.float32) * scale
         kpos = jnp.arange(self.cache_len)[None, :]
         qpos = idx + jnp.arange(s)[:, None]
         mask = kpos <= qpos  # causal AND only-written positions
-        scores = jnp.where(mask[None, None], scores, -1e30)
+        scores = jnp.where(
+            mask[None, None], scores, jnp.finfo(jnp.float32).min
+        )
         # Overflowing the cache would otherwise be silently clamped by
         # dynamic_update_slice (the failure the static max_len guard
         # prevents in training mode) — poison the logits loudly instead.
         scores = jnp.where(idx + s > self.cache_len, jnp.nan, scores)
-        p = jax.nn.softmax(scores, axis=-1)
-        return jnp.einsum("bhqk,bkhd->bqhd", p, cv.value).astype(q.dtype)
+        p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+        p = p / jnp.sum(p, axis=-1, keepdims=True)
+        return jnp.einsum(
+            "bhqk,bkhd->bqhd", p.astype(q.dtype), cv.value
+        )
 
     @nn.compact
     def __call__(self, x, *, causal: bool = True):
@@ -464,6 +475,11 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
       (batch, prompt_len + max_new_tokens) tokens, prompt included.
     """
     b, s0 = prompt.shape
+    if max_new_tokens < 0:
+        raise ValueError(f"max_new_tokens must be >= 0, got "
+                         f"{max_new_tokens}")
+    if max_new_tokens == 0:
+        return prompt
     total = s0 + max_new_tokens
     if total > model.max_len:
         raise ValueError(

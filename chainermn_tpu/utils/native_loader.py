@@ -1,16 +1,18 @@
-"""ctypes binding for the native (C++) input pipeline.
+"""ctypes binding for the native (C++) input pipelines.
 
 SURVEY.md section 2 "native-code obligations": the reference's host-side
 data path is Chainer's MultiprocessIterator plus pinned-memory staging
 buffers; ``csrc/loader.cpp`` is the TPU rebuild's native equivalent — a
-worker-thread batch loader (crop / flip / normalize off the GIL) producing
-into a fixed ring of reusable staging slots.  This module compiles it on
-first use with ``g++`` (no pybind11 in the image; plain C ABI + ctypes)
-and wraps it as a Python iterator.
+shared worker-thread ring engine with two loaders on top: image batches
+(crop / flip / normalize off the GIL — :class:`NativeImageLoader`, the
+ImageNet path) and token-stream batches (shuffled fixed-length windows —
+:class:`NativeTokenLoader`, the LM path).  This module compiles the
+library on first use with ``g++`` (no pybind11 in the image; plain C ABI
++ ctypes) and wraps each loader as a Python iterator.
 
 Falls back cleanly: ``native_available()`` is False when no compiler is
-present, and :class:`NativeImageLoader` raises with a clear message —
-callers (e.g. the ImageNet example) can then use SerialIterator.
+present, and the loaders raise with a clear message — callers (e.g. the
+ImageNet example) can then use SerialIterator.
 """
 
 from __future__ import annotations
@@ -108,6 +110,12 @@ def _load_library() -> ctypes.CDLL:
             ctypes.c_int, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+        ]
+        lib.cmn_token_loader_create.restype = ctypes.c_void_p
+        lib.cmn_token_loader_create.argtypes = [
+            ctypes.c_void_p, ctypes.c_longlong,
+            ctypes.c_int, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_uint64, ctypes.c_int,
         ]
         lib.cmn_loader_acquire.restype = ctypes.c_int
         lib.cmn_loader_acquire.argtypes = [
@@ -253,6 +261,105 @@ class NativeImageLoader:
         producing/discarding of skipped batches), works forwards and
         backwards.
         """
+        target = int(state["iteration"])
+        if self._lib.cmn_loader_seek(self._handle, target) != 0:
+            raise ValueError(f"cmn_loader_seek({target}) failed")
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.cmn_loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass
+
+
+class NativeTokenLoader:
+    """Threaded native batch loader over a flat int32 token stream.
+
+    The LM-family counterpart of :class:`NativeImageLoader`: the corpus
+    is cut into ``n_tokens // seq_len`` fixed windows; each epoch visits
+    a (seeded, per-epoch) shuffled permutation of windows in batches of
+    ``batch_size`` (drop-last), assembled by C++ worker threads into the
+    shared staging ring.  Yields int32 (batch, seq_len) arrays — feed
+    them to ``step.place_batch`` and train with ``lm_loss``.
+
+    Deterministic in ``seed`` for any thread count; ``serialize`` /
+    ``restore`` reposition via the native O(ring) seek, matching the
+    checkpointer's iterator contract.
+    """
+
+    def __init__(self, tokens: np.ndarray, batch_size: int, seq_len: int,
+                 *, n_threads: int = 4, ring: int = 8, seed: int = 0,
+                 shuffle: bool = True):
+        lib = _load_library()
+        tokens = np.ascontiguousarray(tokens, dtype=np.int32).reshape(-1)
+        if tokens.size < seq_len * batch_size:
+            raise ValueError(
+                f"corpus of {tokens.size} tokens cannot fill one "
+                f"(batch={batch_size}) x (seq_len={seq_len}) batch"
+            )
+        self._tokens = tokens  # the C++ side borrows this buffer
+        self._lib = lib
+        self._shape = (batch_size, seq_len)
+        self._create_args = (int(batch_size), int(seq_len),
+                             int(n_threads), int(ring), int(seed),
+                             int(bool(shuffle)))
+        self._handle = None
+        self._create()
+
+    def _create(self):
+        batch, seq_len, n_threads, ring, seed, shuffle = self._create_args
+        self._handle = self._lib.cmn_token_loader_create(
+            self._tokens.ctypes.data_as(ctypes.c_void_p),
+            self._tokens.size, batch, seq_len, n_threads, ring, seed,
+            shuffle,
+        )
+        if not self._handle:
+            raise ValueError(
+                "cmn_token_loader_create rejected the configuration"
+            )
+
+    def __iter__(self):
+        return self
+
+    def __next__(self) -> np.ndarray:
+        slot, toks = self.acquire()
+        try:
+            return np.array(toks)
+        finally:
+            self.release(slot)
+
+    def acquire(self) -> Tuple[int, np.ndarray]:
+        """Zero-copy: (slot_id, tokens_view); the view is valid until
+        ``release(slot_id)``."""
+        yp = ctypes.POINTER(ctypes.c_int32)()
+        slot = self._lib.cmn_loader_acquire(self._handle, None,
+                                            ctypes.byref(yp))
+        if slot < 0:
+            raise StopIteration
+        return slot, np.ctypeslib.as_array(yp, shape=self._shape)
+
+    def release(self, slot: int) -> None:
+        self._lib.cmn_loader_release(self._handle, slot)
+
+    @property
+    def epoch(self) -> int:
+        return int(self._lib.cmn_loader_epoch(self._handle))
+
+    @property
+    def batches_per_epoch(self) -> int:
+        return int(self._lib.cmn_loader_batches_per_epoch(self._handle))
+
+    def serialize(self):
+        return {
+            "iteration": int(self._lib.cmn_loader_iteration(self._handle))
+        }
+
+    def restore(self, state):
         target = int(state["iteration"])
         if self._lib.cmn_loader_seek(self._handle, target) != 0:
             raise ValueError(f"cmn_loader_seek({target}) failed")

@@ -77,6 +77,9 @@ def main(argv=None):
     p.add_argument("--vocab-parallel", action="store_true",
                    help="shard the embedding table + tied head over the "
                         "model axis (Megatron vocab parallelism)")
+    p.add_argument("--native-loader", action="store_true",
+                   help="assemble token batches with the C++ worker-"
+                        "thread loader (GIL-free, deterministic)")
     p.add_argument("--cpu-mesh", action="store_true",
                    help="run on a virtual CPU device mesh (testing)")
     args = p.parse_args(argv)
@@ -155,11 +158,28 @@ def main(argv=None):
     )
     params, opt_state = step.place(params, opt.init(params))
 
+    loader = None
+    if args.native_loader:
+        from chainermn_tpu.utils.native_loader import NativeTokenLoader
+
+        loader = NativeTokenLoader(
+            corpus.reshape(-1), batch, args.seq_len, n_threads=4, seed=1
+        )
+        if chief:
+            print("input: native C++ token loader "
+                  f"({loader.batches_per_epoch} batches/epoch)")
+
     rng = np.random.RandomState(1)
     t0, tokens_done, last_loss = time.perf_counter(), 0, float("nan")
     for it in range(1, args.steps + 1):
-        rows = rng.randint(0, corpus.shape[0], size=batch)
-        toks = step.place_batch(jnp.asarray(corpus[rows]))
+        if loader is not None:
+            # __next__ copies out of the ring slot before releasing it —
+            # required here because place_batch's device transfer is
+            # async and must not race a worker refilling the slot
+            toks = step.place_batch(jnp.asarray(next(loader)))
+        else:
+            rows = rng.randint(0, corpus.shape[0], size=batch)
+            toks = step.place_batch(jnp.asarray(corpus[rows]))
         params, opt_state, metrics = step(params, opt_state, toks)
         tokens_done += batch * args.seq_len
         if it % args.report_every == 0 or it == args.steps:
@@ -169,6 +189,8 @@ def main(argv=None):
                 print(f"step {it:5d}  loss {last_loss:.4f}  "
                       f"{tokens_done / dt:,.0f} tok/s")
             t0, tokens_done = time.perf_counter(), 0
+    if loader is not None:
+        loader.close()
     if chief:
         print(f"final: loss={last_loss:.4f} "
               f"(uniform would be {np.log(args.vocab):.3f}; the Markov "

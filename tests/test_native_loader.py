@@ -13,6 +13,7 @@ import pytest
 from chainermn_tpu.utils.native_loader import (
     NativeImageLoader,
     native_available,
+    NativeTokenLoader,
 )
 
 pytestmark = pytest.mark.skipif(
@@ -220,3 +221,77 @@ class TestBookkeepingAndLifecycle:
         assert np.isfinite(x).all()
         assert x.min() >= 0.0 and x.max() <= 1.0  # default mean 0, std 255
         loader.close()
+
+
+class TestTokenLoader:
+    """The LM-path loader over the shared ring engine: shuffled
+    fixed-length windows of a flat token stream."""
+
+    def _corpus(self, n=1024):
+        return np.arange(n, dtype=np.int32)
+
+    def test_windows_partition_the_corpus(self):
+        # one epoch must visit every window exactly once (batch 4 x
+        # seq 8 over 256 tokens = 32 windows = 8 batches/epoch)
+        ld = NativeTokenLoader(self._corpus(256), 4, 8, seed=3)
+        try:
+            assert ld.batches_per_epoch == 8
+            seen = []
+            for _ in range(ld.batches_per_epoch):
+                seen.append(next(ld))
+            toks = np.concatenate([b.reshape(-1) for b in seen])
+            np.testing.assert_array_equal(
+                np.sort(toks), np.arange(256, dtype=np.int32)
+            )
+            # windows are contiguous runs
+            firsts = np.concatenate([b[:, 0] for b in seen])
+            assert (firsts % 8 == 0).all()
+        finally:
+            ld.close()
+
+    def test_thread_count_does_not_change_stream(self):
+        ref = NativeTokenLoader(self._corpus(), 4, 16, n_threads=1,
+                                seed=7)
+        many = NativeTokenLoader(self._corpus(), 4, 16, n_threads=7,
+                                 seed=7)
+        try:
+            for _ in range(20):
+                np.testing.assert_array_equal(next(ref), next(many))
+        finally:
+            ref.close()
+            many.close()
+
+    def test_epochs_reshuffle_deterministically(self):
+        a = NativeTokenLoader(self._corpus(), 8, 8, seed=1)
+        b = NativeTokenLoader(self._corpus(), 8, 8, seed=1)
+        try:
+            bpe = a.batches_per_epoch
+            e0 = [next(a) for _ in range(bpe)]
+            e1 = [next(a) for _ in range(bpe)]
+            assert any(
+                not np.array_equal(x, y) for x, y in zip(e0, e1)
+            )  # different epoch order
+            for x in e0:
+                np.testing.assert_array_equal(x, next(b))  # same seed
+        finally:
+            a.close()
+            b.close()
+
+    def test_serialize_restore_repositions(self):
+        ld = NativeTokenLoader(self._corpus(), 4, 16, seed=5)
+        try:
+            for _ in range(5):
+                next(ld)
+            state = ld.serialize()
+            want = [next(ld) for _ in range(4)]
+            for _ in range(3):
+                next(ld)
+            ld.restore(state)
+            for w in want:
+                np.testing.assert_array_equal(next(ld), w)
+        finally:
+            ld.close()
+
+    def test_too_small_corpus_rejected(self):
+        with pytest.raises(ValueError, match="cannot fill"):
+            NativeTokenLoader(np.arange(16, dtype=np.int32), 4, 8)

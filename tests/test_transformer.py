@@ -256,6 +256,34 @@ class TestGenerate:
         b2 = generate(model, params, prompt, 1, use_cache=False)
         np.testing.assert_array_equal(np.asarray(a), np.asarray(b2))
 
+    def test_zero_tokens_returns_prompt(self):
+        from chainermn_tpu.models.transformer import generate
+
+        model, params, prompt = self._setup()
+        out = generate(model, params, prompt, 0)
+        np.testing.assert_array_equal(np.asarray(out), np.asarray(prompt))
+        with pytest.raises(ValueError, match="max_new_tokens"):
+            generate(model, params, prompt, -1)
+
+    def test_kv_cache_matches_recompute_bf16(self):
+        """The dtype-flow parity claim must hold for the default bf16
+        compute dtype too (caches live in compute dtype, same
+        einsum/softmax casting as the oracle attention)."""
+        from chainermn_tpu.models.transformer import (
+            TransformerLM,
+            generate,
+        )
+
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            max_len=32, dtype=jnp.bfloat16,
+        )
+        prompt = _tokens(b=2, s=4, seed=9)
+        params = model.init(jax.random.PRNGKey(1), prompt)
+        slow = generate(model, params, prompt, 6, use_cache=False)
+        fast = generate(model, params, prompt, 6, use_cache=True)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
     def test_moe_model_without_decode_gets_clear_error(self):
         from chainermn_tpu.models.moe_transformer import MoeTransformerLM
         from chainermn_tpu.models.transformer import generate

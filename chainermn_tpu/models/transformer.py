@@ -44,6 +44,22 @@ class MlpBlock(nn.Module):
         return nn.Dense(d, dtype=self.dtype)(h)
 
 
+def _stream_dropout(module: nn.Module, h, rate: float,
+                    deterministic: bool, seq_axis):
+    """Inverted dropout for the residual stream.  Under sequence
+    parallelism the 'dropout' rng collection is replicated across
+    shards, so the shard index is folded in — every shard draws an
+    independent mask instead of reusing one pattern (same convention as
+    the sharded parameter initializers)."""
+    if rate <= 0.0 or deterministic:
+        return h
+    rng = module.make_rng("dropout")
+    if seq_axis is not None:
+        rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
+    keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
+    return jnp.where(keep, h / (1.0 - rate), 0).astype(h.dtype)
+
+
 class SelfAttention(nn.Module):
     """Causal self-attention; optionally tensor-parallel over ``tp_axis``
     (heads sharded Megatron-style: column-parallel q/k/v projections, one
@@ -231,23 +247,32 @@ class TransformerBlock(nn.Module):
     sp_impl: str = "ring"
     decode: bool = False
     cache_len: int = 0
+    dropout_rate: float = 0.0
+    deterministic: bool = False
     attention_fn: Optional[Callable] = None
 
     @nn.compact
     def __call__(self, x):
         ln = lambda: nn.LayerNorm(dtype=jnp.float32)
-        x = x + SelfAttention(
+
+        def drop(h):
+            return _stream_dropout(
+                self, h, self.dropout_rate, self.deterministic,
+                self.seq_axis,
+            )
+
+        x = x + drop(SelfAttention(
             self.n_heads, dtype=self.dtype, seq_axis=self.seq_axis,
             tp_axis=self.tp_axis, sp_impl=self.sp_impl,
             decode=self.decode, cache_len=self.cache_len,
             attention_fn=self.attention_fn,
-        )(ln()(x).astype(self.dtype))
+        )(ln()(x).astype(self.dtype)))
         if self.tp_axis is not None:
             mlp = TpMlpBlock(self.d_ff, tp_axis=self.tp_axis,
                              dtype=self.dtype)
         else:
             mlp = MlpBlock(self.d_ff, dtype=self.dtype)
-        x = x + mlp(ln()(x).astype(self.dtype))
+        x = x + drop(mlp(ln()(x).astype(self.dtype)))
         return x
 
 
@@ -301,6 +326,13 @@ class TransformerLM(nn.Module):
     # actual generation length (0 = default to max_len).
     decode: bool = False
     cache_len: int = 0
+    # Residual-stream dropout (attention out, MLP out, token
+    # embeddings) — applied identically on the TP and non-TP paths, with
+    # per-shard independent masks under SP; 0.0 draws no rng.  Construct
+    # an eval twin with deterministic=True to switch it off (generate()
+    # does this automatically).
+    dropout_rate: float = 0.0
+    deterministic: bool = False
     # Shard the embedding table AND the tied output head over tp_axis
     # (Megatron VocabParallelEmbedding): logits come back as the LOCAL
     # vocab block — train with vp_lm_loss, which assembles the softmax
@@ -350,12 +382,17 @@ class TransformerLM(nn.Module):
         pos = lax.dynamic_slice_in_dim(pos_table, offset, s, axis=0)
 
         x = (embed(tokens) + pos[None]).astype(self.dtype)
+        x = _stream_dropout(
+            self, x, self.dropout_rate, self.deterministic, self.seq_axis
+        )
         for _ in range(self.n_layers):
             x = TransformerBlock(
                 self.n_heads, d_ff, dtype=self.dtype,
                 seq_axis=self.seq_axis, tp_axis=self.tp_axis,
                 sp_impl=self.sp_impl, decode=self.decode,
                 cache_len=self.cache_len or self.max_len,
+                dropout_rate=self.dropout_rate,
+                deterministic=self.deterministic,
                 attention_fn=self.attention_fn,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
@@ -512,7 +549,9 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
 
     buf0 = jnp.zeros((b, total), jnp.int32)
     buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
-    loop = _generate_loop(model, s0, max_new_tokens, float(temperature))
+    loop = _generate_loop(
+        _eval_twin(model), s0, max_new_tokens, float(temperature)
+    )
     buf, _ = loop(params, buf0, rng)
     return buf
 
@@ -526,9 +565,26 @@ def _has_decode_field(model) -> bool:
         return False
 
 
+def _eval_twin(model):
+    """The same architecture with dropout off (``deterministic=True``
+    where the field exists) — sampling must not apply training-time
+    dropout, and the 'dropout' rng collection isn't threaded through
+    the generation loops."""
+    import dataclasses
+
+    fields = {
+        f.name: getattr(model, f.name)
+        for f in dataclasses.fields(model)
+        if f.name not in ("parent", "name")
+    }
+    if "deterministic" in fields:
+        fields["deterministic"] = True
+    return type(model)(**fields)
+
+
 def _decode_twin(model, cache_len: int):
-    """The same architecture with ``decode=True`` and caches sized to
-    the actual generation length (not max_len — a short sample from a
+    """The eval twin with ``decode=True`` and caches sized to the
+    actual generation length (not max_len — a short sample from a
     long-context model shouldn't pay full-context attention per step);
     parameters are layout-identical."""
     import dataclasses
@@ -538,9 +594,10 @@ def _decode_twin(model, cache_len: int):
             f"{type(model).__name__} has no decode mode; call "
             "generate(..., use_cache=False) for the recompute tier"
         )
+    twin = _eval_twin(model)
     fields = {
-        f.name: getattr(model, f.name)
-        for f in dataclasses.fields(model)
+        f.name: getattr(twin, f.name)
+        for f in dataclasses.fields(twin)
         if f.name not in ("parent", "name")
     }
     fields["decode"] = True

@@ -41,3 +41,17 @@ def mesh8(devices8):
     from jax.sharding import Mesh
 
     return Mesh(np.array(devices8), ("mn",))
+
+
+def subprocess_env(devices: int = 8) -> dict:
+    """Env for spawning a framework subprocess on a virtual CPU mesh —
+    shared by the multi-process tier and the example smoke tests (one
+    place to change the recipe)."""
+    import os
+
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)
+    env["XLA_FLAGS"] = f"--xla_force_host_platform_device_count={devices}"
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["PYTHONPATH"] = repo + os.pathsep + env.get("PYTHONPATH", "")
+    return env

@@ -43,15 +43,13 @@ def _free_port() -> int:
 def run_world(scenario, n_procs=2, local_devices=1, tmpdir="/tmp",
               timeout=240, extra_env=None):
     """Spawn ``n_procs`` workers; return list of (returncode, stdout)."""
+    from conftest import subprocess_env
+
     port = _free_port()
-    env = dict(os.environ)
     # the ambient env may point JAX at the (single-claim) TPU tunnel;
-    # workers must build their own CPU world
-    env.pop("JAX_PLATFORMS", None)
-    env["XLA_FLAGS"] = (
-        f"--xla_force_host_platform_device_count={local_devices}"
-    )
-    env["PYTHONPATH"] = _REPO + os.pathsep + env.get("PYTHONPATH", "")
+    # workers must build their own CPU world (subprocess_env pops
+    # JAX_PLATFORMS and forces the virtual device count)
+    env = subprocess_env(local_devices)
     env.update(extra_env or {})
     procs = [
         subprocess.Popen(

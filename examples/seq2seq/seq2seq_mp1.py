@@ -137,6 +137,11 @@ def main(argv=None):
 
     opt = model.optimizer(optax.adam(args.lr))
     opt_state = opt.init(params)
+    # Per-stage eager dispatch: each stage's params live on their OWN
+    # chip (genuinely partitioned model memory), which plain jit cannot
+    # take as one argument set — whole-step compilation of a chain
+    # needs a mesh-based layout (that performance tier is
+    # parallel.build_pipeline_train_step; see docs/model_parallel.md).
     step = model.value_and_grad(seq2seq_loss)
 
     rng = np.random.RandomState(1)
@@ -147,8 +152,8 @@ def main(argv=None):
         losses = []
         for it in range(n_iter):
             idx = order[it * args.batchsize:(it + 1) * args.batchsize]
-            if len(idx) == 0:
-                break
+            if len(idx) < args.batchsize:
+                break  # drop-last keeps the traced shapes stable
             x, ys_out = batch_of(train, idx)
             loss, grads = step(params, x, ys_out)
             params, opt_state = opt.update(grads, opt_state, params)

@@ -17,7 +17,6 @@ same guarantee the MPI bcast gave, then placed as a global array.
 from __future__ import annotations
 
 import numpy as np
-import jax
 
 
 class _MultiNodeIterator:

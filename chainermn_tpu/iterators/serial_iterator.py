@@ -8,7 +8,7 @@ batches ready for ``jax.device_put`` onto a data-sharded mesh.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from typing import Optional
 
 import numpy as np
 

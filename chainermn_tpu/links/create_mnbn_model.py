@@ -17,9 +17,7 @@ MultiNodeBatchNormalization factory bound to the communicator's axes.
 from __future__ import annotations
 
 import dataclasses
-import functools
 import warnings
-from typing import Any, Optional
 
 from flax import linen as nn
 

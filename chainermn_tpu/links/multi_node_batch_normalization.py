@@ -17,9 +17,8 @@ the transpose collective automatically.
 
 from __future__ import annotations
 
-from typing import Any, Optional, Sequence, Tuple, Union
+from typing import Any, Optional, Tuple, Union
 
-import jax
 import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax

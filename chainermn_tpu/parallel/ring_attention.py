@@ -19,7 +19,6 @@ masked), keeping control flow static for XLA.
 
 from __future__ import annotations
 
-import functools
 from typing import Optional
 
 import jax

@@ -19,7 +19,6 @@ import time
 from typing import Any, Callable, Dict, Optional
 
 import jax
-import numpy as np
 
 from .triggers import get_trigger
 

@@ -39,6 +39,11 @@ def _recv_timeout_ms() -> int:
     return int(os.environ.get("CHAINERMN_TPU_OBJ_TIMEOUT_MS", 600_000))
 
 
+def _check_rank(value: int, size: int, name: str) -> None:
+    if not 0 <= value < size:
+        raise ValueError(f"{name} {value} out of range for size {size}")
+
+
 class LocalObjStore:
     """In-process mailbox — all ranks share one controller."""
 
@@ -47,8 +52,7 @@ class LocalObjStore:
         self._mail: dict = collections.defaultdict(collections.deque)
 
     def send(self, obj: Any, dest: int, tag: int = 0) -> None:
-        if not 0 <= dest < self._size:
-            raise ValueError(f"dest {dest} out of range for size {self._size}")
+        _check_rank(dest, self._size, "dest")
         self._mail[(dest, tag)].append(dumps(obj))
 
     def recv(self, source: int, tag: int = 0, dest: int = 0) -> Any:
@@ -61,8 +65,7 @@ class LocalObjStore:
         exactly like MPI_ANY_SOURCE.
         """
         del source
-        if not 0 <= dest < self._size:
-            raise ValueError(f"dest {dest} out of range for size {self._size}")
+        _check_rank(dest, self._size, "dest")
         box = self._mail[(dest, tag)]
         if not box:
             raise RuntimeError(
@@ -75,11 +78,13 @@ class LocalObjStore:
         return self.recv(source=-1, tag=tag, dest=dest)
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
-        del root
+        # single controller: every rank's payload is this caller's payload,
+        # so any in-range root broadcasts the same object
+        _check_rank(root, self._size, "root")
         return loads(dumps(obj))
 
     def gather(self, obj: Any, root: int = 0) -> list:
-        del root
+        _check_rank(root, self._size, "root")
         return [loads(dumps(obj)) for _ in range(self._size)]
 
     def allgather(self, obj: Any) -> list:
@@ -94,9 +99,30 @@ class MultiprocessObjStore:
     KV store exposed by the distributed client.
     """
 
-    def __init__(self, size: int):
+    def __init__(self, size: int, rank_to_process=None):
         self._size = size
         self._seq = collections.Counter()
+        # rank -> owning process index (from the topology's device order);
+        # lets collective roots be expressed as *ranks*, as in the
+        # reference's MPI world where rank == process.
+        self._rank_to_process = (
+            tuple(rank_to_process) if rank_to_process is not None else None
+        )
+
+    def _root_process(self, root: int) -> int:
+        """Process index owning rank ``root``."""
+        _check_rank(root, self._size, "root")
+        if self._rank_to_process is None:
+            # Without a topology, rank == process is only a safe reading
+            # when the world has exactly one rank per process; guessing
+            # otherwise would silently pick the wrong payload.
+            if self._size != jax.process_count():
+                raise ValueError(
+                    f"root rank {root} cannot be mapped to a process "
+                    "(no rank->process topology; pass rank_to_process)"
+                )
+            return root
+        return self._rank_to_process[root]
 
     # -- collectives ---------------------------------------------------
     def _host_allgather_bytes(self, payload: bytes) -> list:
@@ -115,16 +141,25 @@ class MultiprocessObjStore:
         ]
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
-        del root  # process 0 is the broadcast source, as in bcast_data
-        from jax.experimental import multihost_utils
-
+        """Every process returns the payload contributed by the process
+        owning rank ``root`` (an honest arbitrary-root broadcast: the
+        underlying transport is an allgather, so selecting the root's
+        payload costs nothing extra)."""
+        src = self._root_process(root)
         payloads = self._host_allgather_bytes(dumps(obj))
-        return loads(payloads[0])
+        return loads(payloads[src])
 
     def allgather(self, obj: Any) -> list:
         return [loads(p) for p in self._host_allgather_bytes(dumps(obj))]
 
     def gather(self, obj: Any, root: int = 0) -> list:
+        """Process-ordered list of every process's payload.
+
+        MPI's gather delivers the list only at ``root``; the host-side
+        transport here is an allgather, so every process receives it — a
+        documented superset (content identical at root).  ``root`` is
+        still validated so out-of-range ranks fail loudly."""
+        self._root_process(root)
         return self.allgather(obj)
 
     # -- addressed send/recv over the KV store -------------------------
@@ -167,7 +202,8 @@ class MultiprocessObjStore:
         return loads(payload[:total])
 
 
-def create_obj_store(size: int, process_count: int = 1):
+def create_obj_store(size: int, process_count: int = 1,
+                     rank_to_process=None):
     if process_count > 1:
-        return MultiprocessObjStore(size)
+        return MultiprocessObjStore(size, rank_to_process=rank_to_process)
     return LocalObjStore(size)

@@ -392,7 +392,10 @@ class NaiveCommunicator(CommunicatorBase):
                 devices = jax.devices()
             _topology = Topology.create(devices)
         super().__init__(_topology)
-        self._obj_store = create_obj_store(self.size, self.process_count)
+        self._obj_store = create_obj_store(
+            self.size, self.process_count,
+            rank_to_process=tuple(d.process_index for d in self.devices),
+        )
         self._allreduce_grad_dtype = (
             np.dtype(allreduce_grad_dtype) if allreduce_grad_dtype else None
         )

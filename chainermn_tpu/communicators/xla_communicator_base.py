@@ -75,7 +75,10 @@ class XlaCommunicatorBase(CommunicatorBase):
             else None
         )
         self._mesh = self._build_mesh()
-        self._obj_store = create_obj_store(self.size, self.process_count)
+        self._obj_store = create_obj_store(
+            self.size, self.process_count,
+            rank_to_process=tuple(d.process_index for d in self.devices),
+        )
         self._stack_spec = P(self.axis_names)
         self._stack_sharding = NamedSharding(self._mesh, self._stack_spec)
 

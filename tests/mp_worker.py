@@ -275,6 +275,55 @@ def scenario_composed_mesh(pid, nproc, scratch):
     return {"losses": losses}
 
 
+def scenario_iterators(pid, nproc, scratch):
+    """Multi-process data layer (reference: _multi_node_iterator /
+    _synchronized_iterator under mpiexec): the per-batch ``bcast_obj``
+    loop of create_multi_node_iterator and the seed agreement of
+    create_synchronized_iterator across real processes — including a
+    non-zero ``rank_master`` owned by the LAST process, pinning the
+    root-aware bcast_obj contract."""
+    import numpy as np
+    from chainermn_tpu.iterators import (
+        SerialIterator,
+        create_multi_node_iterator,
+        create_synchronized_iterator,
+    )
+
+    comm = _comm()
+    last = comm.size - 1  # a rank owned by the last process
+
+    # root-aware object collectives: the payload must come from the
+    # process owning rank `root`, not silently from process 0
+    assert comm.bcast_obj(f"from-{pid}", root=last) == f"from-{nproc - 1}"
+    try:
+        comm.bcast_obj("x", root=comm.size)
+        raise AssertionError("out-of-range root must raise")
+    except ValueError:
+        pass
+
+    # multi-node iterator: per-process datasets DIFFER; the wrapped
+    # stream must equal the master's (master rank on the last process)
+    ds = [int(x) for x in (np.arange(8) + 1000 * pid)]
+    it = create_multi_node_iterator(
+        SerialIterator(ds, 4, shuffle=False), comm, rank_master=last
+    )
+    got = [list(it.next()) for _ in range(2)]
+    want = np.arange(8) + 1000 * (nproc - 1)
+    assert got[0] == list(want[:4]), got
+    assert got[1] == list(want[4:]), got
+
+    # synchronized iterator: differently-seeded iterators must agree on
+    # the shuffle order after synchronization
+    sit = create_synchronized_iterator(
+        SerialIterator(list(range(16)), 8, shuffle=True, seed=pid), comm
+    )
+    order = [int(v) for v in sit.next()]
+    orders = comm.allgather_obj(order)
+    assert all(o == orders[0] for o in orders), orders
+    assert sorted(order) != order, "shuffle should not be identity"
+    return {"first_batch": [int(v) for v in got[0]]}
+
+
 def scenario_allreduce_persistent(pid, nproc, scratch):
     """Per-process drifted host stats must converge to the cross-process
     mean (parity: AllreducePersistent before snapshot/eval)."""

@@ -137,6 +137,17 @@ class TestCheckpoint:
         assert all(p["resumed_step"] == 7 for p in payloads)
 
 
+class TestIterators:
+    def test_multi_node_and_synchronized(self, tmp_path):
+        # 2 processes x 2 local devices: rank_master=3 lives on process 1,
+        # so the per-batch bcast_obj must relay the *master's* stream
+        # (and out-of-range roots must raise on every process).
+        res = run_world("iterators", n_procs=2, local_devices=2,
+                        tmpdir=tmp_path)
+        payloads = _assert_ok(res, "iterators")
+        assert payloads[0]["first_batch"] == payloads[1]["first_batch"]
+
+
 class TestAllreducePersistent:
     def test_cross_process_mean(self, tmp_path):
         res = run_world("allreduce_persistent", n_procs=2, tmpdir=tmp_path)

@@ -44,18 +44,45 @@ class MlpBlock(nn.Module):
         return nn.Dense(d, dtype=self.dtype)(h)
 
 
+# Data-parallel mesh axis names this package's communicators bind
+# (variants.py mesh factorizations).  Dropout folds bound ones into its
+# rng so data shards draw independent masks.
+_DATA_AXES = ("mn", "mn_data", "mn_inter", "mn_intra", "mn_x", "mn_y")
+
+
+def _bound_axes(names, exclude=()):
+    """The subset of ``names`` bound in the current trace (shard_map).
+    ``lax.axis_index`` raises NameError on an unbound axis; anything
+    else is a real error and propagates."""
+    out = []
+    for a in names:
+        if a in exclude or a is None:
+            continue
+        try:
+            lax.axis_index(a)
+        except NameError:
+            continue
+        out.append(a)
+    return out
+
+
 def _stream_dropout(module: nn.Module, h, rate: float,
-                    deterministic: bool, seq_axis):
-    """Inverted dropout for the residual stream.  Under sequence
-    parallelism the 'dropout' rng collection is replicated across
-    shards, so the shard index is folded in — every shard draws an
-    independent mask instead of reusing one pattern (same convention as
-    the sharded parameter initializers)."""
+                    deterministic: bool, seq_axis, tp_axis=None):
+    """Inverted dropout for the residual stream.  The 'dropout' rng
+    collection is replicated across the mesh, so every *token-splitting*
+    shard index is folded in — the sequence shard (SP) and any bound
+    data axis (DP) — giving each shard an independent mask instead of
+    one pattern correlated across the global batch.  The tensor axis is
+    deliberately NOT folded: across TP shards the residual stream is
+    replicated and the masks must agree."""
     if rate <= 0.0 or deterministic:
         return h
     rng = module.make_rng("dropout")
+    fold = _bound_axes(_DATA_AXES, exclude=(tp_axis, seq_axis))
     if seq_axis is not None:
-        rng = jax.random.fold_in(rng, lax.axis_index(seq_axis))
+        fold.append(seq_axis)
+    for a in fold:
+        rng = jax.random.fold_in(rng, lax.axis_index(a))
     keep = jax.random.bernoulli(rng, 1.0 - rate, h.shape)
     return jnp.where(keep, h / (1.0 - rate), 0).astype(h.dtype)
 
@@ -258,7 +285,7 @@ class TransformerBlock(nn.Module):
         def drop(h):
             return _stream_dropout(
                 self, h, self.dropout_rate, self.deterministic,
-                self.seq_axis,
+                self.seq_axis, self.tp_axis,
             )
 
         x = x + drop(SelfAttention(
@@ -383,7 +410,8 @@ class TransformerLM(nn.Module):
 
         x = (embed(tokens) + pos[None]).astype(self.dtype)
         x = _stream_dropout(
-            self, x, self.dropout_rate, self.deterministic, self.seq_axis
+            self, x, self.dropout_rate, self.deterministic, self.seq_axis,
+            self.tp_axis,
         )
         for _ in range(self.n_layers):
             x = TransformerBlock(
@@ -492,9 +520,11 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
     * ``use_cache=False``: one jitted ``fori_loop`` re-running the
       causal forward on a statically padded buffer each step — positions
       past the frontier cannot influence earlier logits, so the
-      recompute is exact.  Works for ANY logits-or-(logits, aux) model
-      (e.g. a dense-mode ``MoeTransformerLM``, which has no decode
-      mode yet).
+      recompute is exact.  Works for ANY logits-or-(logits, aux) model;
+      for capacity-routed MoE models (e.g. dense-mode
+      ``MoeTransformerLM``) the twin's expert capacity is raised to the
+      no-drop bound so a pad token's route can never evict a real
+      token's (see :func:`_recompute_twin`).
 
     Both compiled loops are cached per (model config, shapes,
     temperature).  Sequence-/vocab-parallel variants are for training;
@@ -550,7 +580,8 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
     buf0 = jnp.zeros((b, total), jnp.int32)
     buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
     loop = _generate_loop(
-        _eval_twin(model), s0, max_new_tokens, float(temperature)
+        _recompute_twin(model, b, total), s0, max_new_tokens,
+        float(temperature)
     )
     buf, _ = loop(params, buf0, rng)
     return buf
@@ -580,6 +611,33 @@ def _eval_twin(model):
     if "deterministic" in fields:
         fields["deterministic"] = True
     return type(model)(**fields)
+
+
+def _recompute_twin(model, batch: int, total: int):
+    """Eval twin made exact for capacity-routed MoE models.
+
+    The recompute tier runs the forward on a zero-padded (batch, total)
+    buffer; positions past the frontier are routed by the MoE gate like
+    real tokens, and with a finite per-expert capacity a pad token's
+    route can claim a queue slot ahead of a real token's (route-major
+    slot assignment), changing earlier logits.  Overriding capacity to
+    the flattened token count makes drops impossible (an expert can be
+    chosen by at most every token once, since top-k picks distinct
+    experts), restoring the padding-invariance the tier's exactness
+    claim rests on."""
+    import dataclasses
+
+    twin = _eval_twin(model)
+    names = {f.name for f in dataclasses.fields(twin)}
+    if "capacity" in names:
+        fields = {
+            f.name: getattr(twin, f.name)
+            for f in dataclasses.fields(twin)
+            if f.name not in ("parent", "name")
+        }
+        fields["capacity"] = batch * total
+        twin = type(twin)(**fields)
+    return twin
 
 
 def _decode_twin(model, cache_len: int):

@@ -143,6 +143,17 @@ def native_available() -> bool:
         return False
 
 
+def _check_no_held(held: set, op: str) -> None:
+    # the native seek quiesces and restarts workers, clearing in_use:
+    # a still-held zero-copy view would be silently overwritten
+    if held:
+        raise RuntimeError(
+            f"{op}() with acquired slot(s) {sorted(held)} outstanding — "
+            "release() them first (their zero-copy views would be "
+            "overwritten by restarted workers)"
+        )
+
+
 class NativeImageLoader:
     """Threaded native batch loader over an in-memory uint8 image array.
 
@@ -182,6 +193,7 @@ class NativeImageLoader:
                              int(n_threads), int(ring), int(seed),
                              int(bool(shuffle)), int(bool(train)))
         self._handle = None
+        self._held = set()
         self._create()
 
     def _create(self):
@@ -225,12 +237,14 @@ class NativeImageLoader:
         )
         if slot < 0:
             raise StopIteration
+        self._held.add(slot)
         b, ch, cw, c = self._shape
         x = np.ctypeslib.as_array(xp, shape=(b, ch, cw, c))
         y = np.ctypeslib.as_array(yp, shape=(b,))
         return slot, x, y
 
     def release(self, slot: int) -> None:
+        self._held.discard(slot)
         self._lib.cmn_loader_release(self._handle, slot)
 
     # -- bookkeeping (SerialIterator-compatible surface) ---------------
@@ -262,6 +276,7 @@ class NativeImageLoader:
         backwards.
         """
         target = int(state["iteration"])
+        _check_no_held(self._held, "restore")
         if self._lib.cmn_loader_seek(self._handle, target) != 0:
             raise ValueError(f"cmn_loader_seek({target}) failed")
 
@@ -309,6 +324,7 @@ class NativeTokenLoader:
                              int(n_threads), int(ring), int(seed),
                              int(bool(shuffle)))
         self._handle = None
+        self._held = set()
         self._create()
 
     def _create(self):
@@ -341,9 +357,11 @@ class NativeTokenLoader:
                                             ctypes.byref(yp))
         if slot < 0:
             raise StopIteration
+        self._held.add(slot)
         return slot, np.ctypeslib.as_array(yp, shape=self._shape)
 
     def release(self, slot: int) -> None:
+        self._held.discard(slot)
         self._lib.cmn_loader_release(self._handle, slot)
 
     @property
@@ -361,6 +379,7 @@ class NativeTokenLoader:
 
     def restore(self, state):
         target = int(state["iteration"])
+        _check_no_held(self._held, "restore")
         if self._lib.cmn_loader_seek(self._handle, target) != 0:
             raise ValueError(f"cmn_loader_seek({target}) failed")
 

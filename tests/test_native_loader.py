@@ -212,6 +212,21 @@ class TestBookkeepingAndLifecycle:
         assert loader.epoch == deep // loader.batches_per_epoch
         loader.close(), other.close()
 
+    def test_restore_refuses_while_slot_held(self):
+        # restore's native seek restarts workers, which would overwrite a
+        # still-held zero-copy view — it must raise until release()
+        images, labels = _data()
+        loader = NativeImageLoader(
+            images, labels, BATCH, crop=(8, 8), n_threads=2, seed=3,
+        )
+        state = loader.serialize()
+        slot, _x, _y = loader.acquire()
+        with pytest.raises(RuntimeError, match="acquired slot"):
+            loader.restore(state)
+        loader.release(slot)
+        loader.restore(state)  # released: seek proceeds
+        loader.close()
+
     def test_train_augmentation_in_range(self):
         images, labels = _data()
         loader = NativeImageLoader(

@@ -280,6 +280,42 @@ class TestDropout:
         for r in range(1, 8):
             assert not np.allclose(shards[0, 0], shards[0, r])
 
+    def test_dp_shards_draw_independent_masks(self, mesh8):
+        """Under data parallelism with a replicated dropout rng, every
+        batch shard would reuse the identical mask pattern on different
+        rows, correlating regularization across the global batch — the
+        bound data axis ("mn") must fold into the rng.  IDENTICAL rows
+        on every shard must therefore produce different outputs."""
+        from chainermn_tpu.models.transformer import TransformerLM
+
+        m = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=1,
+            max_len=32, dtype=jnp.float32, dropout_rate=0.5,
+        )
+        row = _tokens(b=1, s=8, seed=3)
+        toks = jnp.tile(row, (8, 1))  # same row on all 8 data shards
+        params = m.init(
+            {"params": jax.random.PRNGKey(0),
+             "dropout": jax.random.PRNGKey(1)}, row
+        )
+        f = jax.jit(
+            jax.shard_map(
+                lambda p, t, k: m.apply(p, t, rngs={"dropout": k}),
+                mesh=mesh8,
+                in_specs=(P(), P("mn"), P()),
+                out_specs=P("mn"),
+                check_vma=False,
+            )
+        )
+        out = np.asarray(f(params, toks, jax.random.PRNGKey(5)))
+        # identical inputs + per-shard masks => no two shard outputs match
+        for r in range(1, 8):
+            assert not np.allclose(out[0], out[r])
+        # outside shard_map nothing is bound; apply still works
+        plain = m.apply(params, row,
+                        rngs={"dropout": jax.random.PRNGKey(5)})
+        assert np.isfinite(np.asarray(plain)).all()
+
     def test_generate_works_on_dropout_model(self):
         from chainermn_tpu.models.transformer import generate
 
@@ -387,6 +423,44 @@ class TestGenerate:
         params = moe.init(jax.random.PRNGKey(0), prompt)
         out = generate(moe, params, prompt, 3)
         assert out.shape == (1, 7)
+
+    def test_moe_recompute_padding_exact(self):
+        """Pad tokens past the frontier must not change sampled tokens.
+
+        Capacity routing is the one mechanism by which padding can leak
+        *backward* through the causal mask: a pad's route can claim an
+        expert queue slot ahead of a real token's (route-major slot
+        order).  The recompute twin raises capacity to the no-drop
+        bound, so the padded-buffer forward must equal an unpadded
+        growing-prefix forward at the same no-drop capacity — with the
+        model's own deliberately TIGHT capacity (2 slots, heavy drops)
+        this fails if the twin keeps the model's capacity."""
+        from chainermn_tpu.models.moe_transformer import MoeTransformerLM
+        from chainermn_tpu.models.transformer import (
+            _recompute_twin,
+            generate,
+        )
+
+        moe = MoeTransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
+            capacity=2,
+        )
+        prompt = _tokens(b=1, s=4, seed=11)
+        params = moe.init(jax.random.PRNGKey(0), prompt)
+        fast = generate(moe, params, prompt, 4, use_cache=False)
+
+        twin = _recompute_twin(moe, 1, 8)
+        assert twin.capacity == 8  # the no-drop bound, not the model's 2
+        buf = prompt
+        for _ in range(4):
+            out = twin.apply(params, buf)
+            logits = out[0] if isinstance(out, tuple) else out
+            nxt = jnp.argmax(
+                logits[:, -1].astype(jnp.float32), axis=-1
+            ).astype(jnp.int32)
+            buf = jnp.concatenate([buf, nxt[:, None]], axis=1)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(buf))
 
     def test_parallel_model_rejected(self):
         from chainermn_tpu.models.transformer import (

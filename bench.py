@@ -305,17 +305,28 @@ def config_resnet50_native_input():
 
     import ml_dtypes
 
+    from chainermn_tpu.iterators import prefetch_to_device
+
     step = cmn.build_train_step(comm, loss_fn, opt)
     params, opt_state = step.place(params, opt.init(params))
     state = {"p": params, "o": opt_state}
 
+    def host_batches():
+        while True:
+            slot, xv, yv = loader.acquire()
+            try:
+                # cast to bf16 on the HOST: the transfer ships half the
+                # bytes, and the copy detaches from the zero-copy slot
+                yield (xv.astype(ml_dtypes.bfloat16), np.array(yv))
+            finally:
+                loader.release(slot)
+
+    # double-buffered H2D: batch i+1's device_put is dispatched while
+    # step i computes (async dispatch), hiding transfer behind compute
+    it = prefetch_to_device(host_batches(), step.place_batch, depth=2)
+
     def run():
-        slot, xv, yv = loader.acquire()
-        # cast to bf16 on the HOST so the host->device transfer ships
-        # half the bytes
-        bx = step.place_batch((xv.astype(ml_dtypes.bfloat16), yv))
-        loader.release(slot)
-        state["p"], state["o"], m = step(state["p"], state["o"], bx)
+        state["p"], state["o"], m = step(state["p"], state["o"], next(it))
         return m["loss"]
 
     try:
@@ -325,12 +336,13 @@ def config_resnet50_native_input():
     return {
         "metric": "resnet50_native_input_images_per_sec_per_chip",
         "value": round(batch / dt / comm.size, 2),
-        "unit": "images/sec/chip (incl. C++ input pipeline)",
+        "unit": "images/sec/chip (incl. C++ input pipeline, "
+                "double-buffered H2D)",
         "step_time_ms": round(dt * 1e3, 2),
         "note": (
-            "includes per-step host->device batch transfer; on a "
-            "tunneled/remote device this config is link-bound, not "
-            "pipeline-bound"
+            "per-step host->device transfer overlapped with compute via "
+            "prefetch_to_device; on a tunneled/remote device the link "
+            "RTT still bounds this config"
         ),
     }
 

@@ -54,4 +54,8 @@ def __getattr__(name):
         from chainermn_tpu.iterators import create_synchronized_iterator
 
         return create_synchronized_iterator
+    if name == "prefetch_to_device":
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        return prefetch_to_device
     raise AttributeError(name)

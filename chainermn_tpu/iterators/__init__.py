@@ -1,9 +1,11 @@
 from .serial_iterator import SerialIterator  # noqa: F401
 from .multi_node_iterator import create_multi_node_iterator  # noqa: F401
 from .synchronized_iterator import create_synchronized_iterator  # noqa: F401
+from .device_prefetch import prefetch_to_device  # noqa: F401
 
 __all__ = [
     "SerialIterator",
     "create_multi_node_iterator",
     "create_synchronized_iterator",
+    "prefetch_to_device",
 ]

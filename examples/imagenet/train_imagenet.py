@@ -132,6 +132,12 @@ def main(argv=None):
     p.add_argument("--native-loader", action="store_true",
                    help="use the C++ threaded loader (csrc/loader.cpp): "
                         "crop/flip/normalize in worker threads off the GIL")
+    p.add_argument("--prefetch", type=int, default=2,
+                   help="device-side input double-buffering depth: batch "
+                        "i+1's host->device transfer is dispatched while "
+                        "step i computes (0 disables; the input pipeline "
+                        "runs up to this many batches ahead, which a "
+                        "checkpoint resume reflects)")
     p.add_argument("--cpu-mesh", action="store_true")
     p.add_argument("--checkpoint", default=None)
     args = p.parse_args(argv)
@@ -266,7 +272,15 @@ def main(argv=None):
     )
     params, opt_state = step.place(params, opt_state)
 
-    updater = Updater(train_it, step, params, opt_state)
+    feed_it = train_it
+    if args.prefetch > 0:
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        # batches arrive on device `prefetch` deep: H2D overlaps compute
+        feed_it = prefetch_to_device(
+            train_it, step.place_batch, depth=args.prefetch
+        )
+    updater = Updater(feed_it, step, params, opt_state)
     trainer = Trainer(updater, stop_trigger=(args.epoch, "epoch"))
 
     def eval_metric(p, batch):

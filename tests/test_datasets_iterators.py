@@ -139,3 +139,72 @@ class TestMultiNodeIterator:
         assert x.shape == (4, 1)
         # attribute delegation
         assert it.batch_size == 4
+
+
+class TestDevicePrefetch:
+    """prefetch_to_device must (a) preserve the stream, (b) return
+    PLACED arrays, and (c) stay `depth` transfers ahead of the consumer
+    — the H2D/compute overlap that hides input latency."""
+
+    def _batches(self, n=6):
+        return [np.full((8, 2), float(i), np.float32) for i in range(n)]
+
+    def test_stream_preserved_and_placed(self, devices8):
+        import jax
+        import optax
+
+        from chainermn_tpu.iterators import prefetch_to_device
+        from chainermn_tpu.optimizers import build_train_step
+
+        tcomm = cmn.create_communicator("tpu", devices=devices8)
+        step = build_train_step(
+            tcomm, lambda p, b: (p["w"] * b).sum(),
+            cmn.create_multi_node_optimizer(optax.sgd(0.1), tcomm),
+        )
+        it = prefetch_to_device(iter(self._batches()), step.place_batch)
+        got = list(it)
+        assert len(got) == 6
+        for i, b in enumerate(got):
+            assert isinstance(b, jax.Array)
+            assert b.sharding == step.batch_sharding
+            np.testing.assert_array_equal(np.asarray(b), np.full((8, 2), i))
+
+    def test_prefetch_depth_ahead(self):
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        placed = []
+
+        def place(x):
+            placed.append(int(x[0, 0]))
+            return x
+
+        it = prefetch_to_device(iter(self._batches()), place, depth=2)
+        first = next(it)
+        assert int(first[0, 0]) == 0
+        # while the caller computes on batch 0, batches 1 AND 2 are
+        # already dispatched (one popped slot refilled + depth ahead)
+        assert placed == [0, 1, 2]
+        next(it)
+        assert placed == [0, 1, 2, 3]
+
+    def test_exhaustion_drains_buffer(self):
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        it = prefetch_to_device(iter(self._batches(3)), lambda x: x,
+                                depth=4)
+        assert len(list(it)) == 3
+        with pytest.raises(StopIteration):
+            next(it)
+
+    def test_bad_depth_rejected(self):
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        with pytest.raises(ValueError, match="depth"):
+            prefetch_to_device(iter([]), lambda x: x, depth=0)
+
+    def test_bookkeeping_passthrough(self):
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        base = SerialIterator(list(range(16)), 4, shuffle=False)
+        it = prefetch_to_device(base, lambda x: x)
+        assert it.batch_size == 4

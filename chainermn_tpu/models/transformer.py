@@ -109,7 +109,8 @@ class SelfAttention(nn.Module):
     sp_impl: str = "ring"
     # KV-cache decode mode: keys/values accumulate in 'cache' variables
     # of length cache_len; each call appends its s positions and attends
-    # against everything cached so far.  Single-device, causal only.
+    # against everything cached so far.  Causal only; composes with
+    # tp_axis (head-sharded caches) but not seq_axis.
     decode: bool = False
     cache_len: int = 0
     attention_fn: Optional[Callable] = None
@@ -199,14 +200,18 @@ class SelfAttention(nn.Module):
         k = k.reshape(b, s, heads, dh)
         v = v.reshape(b, s, heads, dh)
         if self.decode:
-            if self.seq_axis is not None or self.tp_axis is not None:
+            if self.seq_axis is not None:
                 raise ValueError(
-                    "decode mode is single-device (no seq/tp axes)"
+                    "decode mode does not compose with sequence "
+                    "parallelism (a decoded token needs its whole cache)"
                 )
             if not causal:
                 raise ValueError("decode mode implies causal attention")
             if self.cache_len <= 0:
                 raise ValueError("decode mode needs cache_len > 0")
+            # tp_axis composes: q/k/v hold this chip's LOCAL heads, the
+            # cache shards with them, and the row-parallel output
+            # projection below carries the one psum per step.
             out = self._decode_attend(q, k, v, b, heads, dh, dh**-0.5)
         elif self.seq_axis is not None:
             if self.sp_impl == "ring":
@@ -508,7 +513,8 @@ def vp_lm_loss(logits_local: jnp.ndarray, tokens: jnp.ndarray,
 
 def generate(model: TransformerLM, params, prompt: jnp.ndarray,
              max_new_tokens: int, *, temperature: float = 0.0,
-             rng=None, use_cache: Optional[bool] = None) -> jnp.ndarray:
+             rng=None, use_cache: Optional[bool] = None,
+             comm=None, param_specs=None) -> jnp.ndarray:
     """Autoregressive sampling from a (dense, single-device) LM.
 
     Greedy when ``temperature == 0``, else softmax sampling at the given
@@ -527,9 +533,12 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
       token's (see :func:`_recompute_twin`).
 
     Both compiled loops are cached per (model config, shapes,
-    temperature).  Sequence-/vocab-parallel variants are for training;
-    materialize a dense model (same param tree for ``seq_axis=None``)
-    to sample.
+    temperature).  Tensor-parallel models sample natively: pass ``comm``
+    (whose mesh binds ``model.tp_axis``) and ``param_specs`` — the whole
+    loop then runs in one ``shard_map`` with head-sharded KV caches and
+    a row-parallel psum per decoded token.  Sequence-/vocab-parallel
+    variants are for training; materialize a dense/TP model (same param
+    tree for ``seq_axis=None``) to sample.
 
     Args:
       prompt: (batch, prompt_len) int32 token ids.
@@ -557,34 +566,56 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
         raise ValueError("temperature > 0 needs an rng key")
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused in greedy mode
-    parallel = (
+    tp_axis = getattr(model, "tp_axis", None)
+    if (
         getattr(model, "seq_axis", None) is not None
-        or getattr(model, "tp_axis", None) is not None
         or getattr(model, "vocab_parallel", False)
-    )
-    if parallel:
+    ):
         raise ValueError(
-            "generate() samples from single-device dense models; "
-            "construct one with seq_axis/tp_axis=None, "
+            "generate() samples from dense (optionally tensor-parallel) "
+            "models; construct one with seq_axis=None, "
             "vocab_parallel=False (the param tree is compatible)"
+        )
+    if tp_axis is not None and (comm is None or param_specs is None):
+        raise ValueError(
+            "a tensor-parallel model generates under its mesh: pass "
+            "comm= (whose mesh binds the tp axis) and param_specs= "
+            "(the parameter PartitionSpec tree, e.g. "
+            "megatron_param_specs/moe_param_specs)"
         )
     if use_cache is None:
         use_cache = _has_decode_field(model)
     if use_cache:
         loop = _cached_decode_loop(
-            _decode_twin(model, total), s0, max_new_tokens,
+            _decode_twin(model, total, batch=b), s0, max_new_tokens,
             float(temperature),
         )
-        return loop(params, prompt, rng)
+        run, args = loop, (params, prompt, rng)
+    else:
+        buf0 = jnp.zeros((b, total), jnp.int32)
+        buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
+        loop = _generate_loop(
+            _recompute_twin(model, b, total), s0, max_new_tokens,
+            float(temperature)
+        )
+        run = lambda p, buf, key: loop(p, buf, key)[0]
+        args = (params, buf0, rng)
+    if tp_axis is None:
+        return run(*args)
+    # TP tier: the whole sampling loop runs inside one shard_map over
+    # the communicator's mesh — head-sharded KV caches live as scan
+    # carries within the body, the row-parallel projections carry one
+    # psum per decoded token.  Tokens are replicated (P()) outputs.
+    from jax.sharding import PartitionSpec as P
 
-    buf0 = jnp.zeros((b, total), jnp.int32)
-    buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
-    loop = _generate_loop(
-        _recompute_twin(model, b, total), s0, max_new_tokens,
-        float(temperature)
+    sharded = jax.jit(
+        jax.shard_map(
+            run, mesh=comm.mesh,
+            in_specs=(param_specs, P(), P()), out_specs=P(),
+            check_vma=False,
+        )
     )
-    buf, _ = loop(params, buf0, rng)
-    return buf
+    return sharded(*args)
 
 
 def _has_decode_field(model) -> bool:
@@ -640,11 +671,14 @@ def _recompute_twin(model, batch: int, total: int):
     return twin
 
 
-def _decode_twin(model, cache_len: int):
+def _decode_twin(model, cache_len: int, batch: Optional[int] = None):
     """The eval twin with ``decode=True`` and caches sized to the
     actual generation length (not max_len — a short sample from a
     long-context model shouldn't pay full-context attention per step);
-    parameters are layout-identical."""
+    parameters are layout-identical.  Capacity-routed MoE models get the
+    same no-drop capacity override as :func:`_recompute_twin` (prefill
+    routes batch*prompt_len tokens at once; a drop there would desync
+    the two generate tiers)."""
     import dataclasses
 
     if not _has_decode_field(model):
@@ -661,6 +695,8 @@ def _decode_twin(model, cache_len: int):
     fields["decode"] = True
     if "cache_len" in fields:
         fields["cache_len"] = cache_len
+    if "capacity" in fields and batch is not None:
+        fields["capacity"] = batch * cache_len
     return type(model)(**fields)
 
 
@@ -681,22 +717,27 @@ def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
     """Compiled KV-cache sampling: prefill the prompt, then scan one
     token at a time against the caches."""
 
+    def logits_of(out):
+        # (logits, aux) models (MoeTransformerLM) vs plain logits
+        return out[0] if isinstance(out, tuple) else out
+
     @jax.jit
     def run(params, prompt, key):
-        logits, mut = dmodel.apply(params, prompt, mutable=["cache"])
+        out, mut = dmodel.apply(params, prompt, mutable=["cache"])
         cache = mut["cache"]
         nxt, key = _sample(
-            logits[:, -1].astype(jnp.float32), key, temperature
+            logits_of(out)[:, -1].astype(jnp.float32), key, temperature
         )
 
         def body(carry, _):
             cache, tok, key = carry
-            logits, mut = dmodel.apply(
+            out, mut = dmodel.apply(
                 {**params, "cache": cache}, tok[:, None],
                 mutable=["cache"],
             )
             nxt, key = _sample(
-                logits[:, -1].astype(jnp.float32), key, temperature
+                logits_of(out)[:, -1].astype(jnp.float32), key,
+                temperature
             )
             return (mut["cache"], nxt, key), nxt
 

@@ -408,7 +408,11 @@ class TestGenerate:
         fast = generate(model, params, prompt, 6, use_cache=True)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
 
-    def test_moe_model_without_decode_gets_clear_error(self):
+    def test_moe_kv_cache_matches_recompute(self):
+        """MoE decode mode (prefill + per-token cache attention, fresh
+        per-call routing) must emit the same tokens as the no-drop
+        recompute tier — both twins share the no-drop capacity
+        override, so per-token routing decisions coincide."""
         from chainermn_tpu.models.moe_transformer import MoeTransformerLM
         from chainermn_tpu.models.transformer import generate
 
@@ -416,13 +420,14 @@ class TestGenerate:
             vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
             n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
         )
-        prompt = _tokens(b=1, s=4)
-        with pytest.raises(ValueError, match="no decode mode"):
-            generate(moe, {}, prompt, 2, use_cache=True)
-        # and the recompute tier works for it (auto-selected)
+        prompt = _tokens(b=2, s=4)
         params = moe.init(jax.random.PRNGKey(0), prompt)
-        out = generate(moe, params, prompt, 3)
-        assert out.shape == (1, 7)
+        slow = generate(moe, params, prompt, 4, use_cache=False)
+        fast = generate(moe, params, prompt, 4, use_cache=True)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+        # auto-select now picks the cache tier for MoE too
+        auto = generate(moe, params, prompt, 4)
+        np.testing.assert_array_equal(np.asarray(auto), np.asarray(slow))
 
     def test_moe_recompute_padding_exact(self):
         """Pad tokens past the frontier must not change sampled tokens.
@@ -472,8 +477,59 @@ class TestGenerate:
             vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=1,
             max_len=32, dtype=jnp.float32, seq_axis="mn",
         )
-        with pytest.raises(ValueError, match="single-device"):
+        with pytest.raises(ValueError, match="seq_axis=None"):
             generate(model, {}, _tokens(b=1, s=4), 2)
+        # tensor-parallel needs its mesh: a clear error without comm
+        tp_model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=1,
+            max_len=32, dtype=jnp.float32, tp_axis="mn_model",
+        )
+        with pytest.raises(ValueError, match="param_specs"):
+            generate(tp_model, {}, _tokens(b=1, s=4), 2)
+
+    def test_tp_generate_on_mesh(self, devices8):
+        """Tensor-parallel sampling: the loop runs in one shard_map over
+        a (dp=2, tp=4) mesh with head-sharded KV caches.  Oracles:
+        (a) the TP cache tier == the TP recompute tier (same mesh), and
+        (b) tp=4 == tp=1 on the same global params — factorization
+        invariance, the same style as the composed-mesh train tests."""
+        import chainermn_tpu as cmn
+        from chainermn_tpu.models.transformer import (
+            TransformerLM,
+            generate,
+        )
+        from chainermn_tpu.parallel import (
+            megatron_param_specs,
+            sharded_init,
+        )
+
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=4, n_layers=2,
+            max_len=32, dtype=jnp.float32, tp_axis="mn_model",
+        )
+        prompt = _tokens(b=2, s=4, seed=21)
+        comm4 = cmn.create_communicator("hybrid", devices=devices8,
+                                        tp_size=4)
+        params, specs = sharded_init(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            comm4.mesh, (P(),),
+            lambda p: megatron_param_specs(p, model_axis="mn_model"),
+            prompt,
+        )
+        fast = generate(model, params, prompt, 5, use_cache=True,
+                        comm=comm4, param_specs=specs)
+        slow = generate(model, params, prompt, 5, use_cache=False,
+                        comm=comm4, param_specs=specs)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+        assert fast.shape == (2, 9)
+
+        # same params on a degenerate tp=1 mesh must sample identically
+        comm1 = cmn.create_communicator("hybrid", devices=devices8,
+                                        tp_size=1)
+        host = jax.tree_util.tree_map(np.asarray, params)
+        one = generate(model, host, prompt, 5, use_cache=True,
+                       comm=comm1, param_specs=specs)
+        np.testing.assert_array_equal(np.asarray(one), np.asarray(fast))
 
     def test_sampling_deterministic_given_key(self):
         from chainermn_tpu.models.transformer import generate

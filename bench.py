@@ -332,6 +332,7 @@ def config_resnet50_native_input():
     try:
         dt = _time_steps(run, steps, warmup=1)
     finally:
+        it.close()  # retire the generator's held slot before the loader
         loader.close()
     return {
         "metric": "resnet50_native_input_images_per_sec_per_chip",
@@ -517,9 +518,9 @@ def config_transformer_lm_long():
         n_layers=n_layers, max_len=seq,
         attention_fn=None if SMOKE else flash_attention_fn(),
     )
-    tps, step_time, _ = _bench_lm(
+    tps, step_time, extra = _bench_lm(
         model, lambda p, b: lm_loss(model.apply(p, b), b), comm,
-        batch=batch, seq=seq, vocab=vocab,
+        batch=batch, seq=seq, vocab=vocab, with_flops=True,
     )
     return {
         "metric": "transformer_lm_seq8192_tokens_per_sec_per_chip",
@@ -527,6 +528,7 @@ def config_transformer_lm_long():
         "unit": "tokens/sec/chip (flash attention, bf16, seq 8192)",
         "step_time_ms": round(step_time * 1e3, 2),
         "seq_len": seq,
+        **extra,
     }
 
 
@@ -553,10 +555,10 @@ def config_moe_lm():
         max_len=seq,
         attention_fn=None if SMOKE else flash_attention_fn(),
     )
-    tps, step_time, _ = _bench_lm(
+    tps, step_time, extra = _bench_lm(
         model,
         lambda p, b: moe_lm_loss(model.apply(p, b), b, aux_coef=1e-2),
-        comm, batch=batch, seq=seq, vocab=vocab,
+        comm, batch=batch, seq=seq, vocab=vocab, with_flops=True,
     )
     return {
         "metric": "moe_lm_tokens_per_sec_per_chip",
@@ -564,6 +566,7 @@ def config_moe_lm():
         "unit": "tokens/sec/chip (top-2 MoE every other block)",
         "step_time_ms": round(step_time * 1e3, 2),
         "n_experts": n_experts,
+        **extra,
     }
 
 
@@ -634,12 +637,21 @@ def config_seq2seq_mp():
 
     step_time = _time_steps(run, steps, 1 if SMOKE else 3)
     tokens = batch * seqlen * 2  # enc + dec
-    return {
-        "metric": "seq2seq_mp_tokens_per_sec",
-        "value": round(tokens / step_time, 1),
-        "unit": "tokens/sec (MultiNodeChainList enc|dec split)",
+    out = {
+        "metric": "seq2seq_mp_tokens_per_sec_per_chip",
+        "value": round(tokens / step_time / comm.size, 1),
+        "unit": "tokens/sec/chip (MultiNodeChainList enc|dec split; on "
+                "one chip both stages share it)",
         "step_time_ms": round(step_time * 1e3, 2),
+        "n_chips": comm.size,
     }
+    flops = _flops_of(whole_step, holder["params"], holder["state"])
+    peak = _peak_flops(comm.devices[0])
+    if flops:
+        out["model_tflops_per_step"] = round(flops / 1e12, 2)
+        if peak:
+            out["mfu"] = round(flops / step_time / (peak * comm.size), 4)
+    return out
 
 
 def main():

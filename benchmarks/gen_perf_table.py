@@ -1,0 +1,112 @@
+#!/usr/bin/env python
+"""Generate docs/performance.md's measured table from a BENCH_r*.json.
+
+Round 2's perf doc hand-copied bench numbers and drifted (the doc said
+double-buffering measured 0.92x while the driver-captured bench said
+1.043x).  This script makes the doc's measured table a *function* of the
+driver-captured JSON: the table lives between markers
+
+    <!-- bench-table:begin source=BENCH_rNN.json -->
+    ...generated...
+    <!-- bench-table:end -->
+
+and ``tests/test_perf_doc.py`` asserts the committed doc byte-matches
+regeneration from its declared source, so a hand-edit or a stale number
+fails CI.
+
+Usage:
+    python benchmarks/gen_perf_table.py            # check (exit 1 on drift)
+    python benchmarks/gen_perf_table.py --write    # rewrite the block
+    python benchmarks/gen_perf_table.py --source BENCH_r03.json --write
+"""
+
+import argparse
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+DOC = os.path.join(REPO, "docs", "performance.md")
+BEGIN_RE = re.compile(
+    r"<!-- bench-table:begin source=(?P<src>[\w.]+) -->"
+)
+END = "<!-- bench-table:end -->"
+
+
+def _fmt_value(v):
+    if v is None:
+        return "—"
+    if isinstance(v, float):
+        return f"{v:,.1f}" if abs(v) >= 100 else f"{v:,.3g}"
+    return f"{v:,}" if isinstance(v, int) else str(v)
+
+
+def _md(s) -> str:
+    """Escape cell content: a literal '|' (e.g. 'enc|dec') would split
+    the markdown row into extra columns."""
+    return str(s).replace("|", "\\|")
+
+
+def _row(name, entry):
+    if "error" in entry:
+        return f"| {name} | {_md(entry.get('metric', name))} | error | — | — | — |"
+    mfu = entry.get("mfu")
+    return "| {} | {} | {} | {} | {} | {} |".format(
+        _md(name),
+        _md(entry.get("metric", name)),
+        _fmt_value(entry.get("value")),
+        _md(entry.get("unit", "")),
+        _fmt_value(entry.get("step_time_ms")),
+        f"{mfu:.3f}" if isinstance(mfu, (int, float)) else "—",
+    )
+
+
+def generate(bench_path: str) -> str:
+    with open(bench_path) as f:
+        # the bench file may hold the wrapped driver record or the raw line
+        data = json.load(f)
+    if "parsed" in data:
+        data = data["parsed"]
+    lines = [
+        "| config | metric | value | unit | step ms | MFU |",
+        "|---|---|---|---|---|---|",
+        _row("resnet50 (headline)", data),
+    ]
+    for name, entry in data.get("configs", {}).items():
+        lines.append(_row(name, entry))
+    return "\n".join(lines)
+
+
+def main():
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--write", action="store_true")
+    ap.add_argument("--source", default=None,
+                    help="override the source= file named in the doc")
+    args = ap.parse_args()
+
+    doc = open(DOC).read()
+    m = BEGIN_RE.search(doc)
+    if not m or END not in doc:
+        sys.exit("docs/performance.md is missing the bench-table markers")
+    src = args.source or m.group("src")
+    begin_line = f"<!-- bench-table:begin source={src} -->"
+    table = generate(os.path.join(REPO, src))
+    block = f"{begin_line}\n{table}\n{END}"
+
+    start, stop = m.start(), doc.index(END) + len(END)
+    new_doc = doc[:start] + block + doc[stop:]
+    if args.write:
+        open(DOC, "w").write(new_doc)
+        print(f"wrote table from {src}")
+        return
+    if new_doc != doc:
+        sys.exit(
+            f"docs/performance.md measured table drifted from {src}; "
+            "run: python benchmarks/gen_perf_table.py --write"
+        )
+    print(f"docs/performance.md matches {src}")
+
+
+if __name__ == "__main__":
+    main()

@@ -28,8 +28,12 @@ def mnbn_factory(comm, **bn_kwargs):
     """A ``norm`` factory usable by models: ``norm(size) -> Module``."""
 
     def make(size: int, **kw):
-        merged = dict(bn_kwargs)
-        merged.update(kw)
+        # call-site kwargs (scale_init, the model's compute dtype) are
+        # defaults; anything the user pinned in bn_kwargs wins — an
+        # explicit create_mnbn_model(model, comm, dtype=float32) must
+        # not be silently overridden by the model's bf16
+        merged = dict(kw)
+        merged.update(bn_kwargs)
         return MultiNodeBatchNormalization(
             size=size, axis_name=comm.axis_names, **merged
         )

@@ -105,17 +105,26 @@ class MultiNodeBatchNormalization(nn.Module):
 
         shape = [1] * x.ndim
         shape[feature_axis] = self.size
-        mean = mean.reshape(shape)
-        var = var.reshape(shape)
-        y = (x.astype(jnp.float32) - mean) * lax.rsqrt(var + self.epsilon)
+        # Statistics accumulate in fp32 above; the NORMALIZATION
+        # arithmetic runs in self.dtype, matching flax BatchNorm — for
+        # bf16 models this is the round-3 MFU lever (the per-element
+        # scale/shift stream halves its bytes), with the fp32 mean/inv
+        # folded into one per-channel multiplier and offset first so
+        # the precision-sensitive part stays fp32.
+        inv = lax.rsqrt(var + self.epsilon)
         if self.use_scale:
             gamma = self.param(
                 "scale", self.scale_init, (self.size,), jnp.float32
             )
-            y = y * gamma.reshape(shape)
+            inv = inv * gamma
+        offset = -mean * inv
         if self.use_bias:
             beta = self.param(
                 "bias", self.bias_init, (self.size,), jnp.float32
             )
-            y = y + beta.reshape(shape)
+            offset = offset + beta
+        y = (
+            x.astype(self.dtype) * inv.reshape(shape).astype(self.dtype)
+            + offset.reshape(shape).astype(self.dtype)
+        )
         return y.astype(self.dtype)

@@ -48,7 +48,7 @@ class Inception(nn.Module):
         def unit(y, features, kernel, padding="SAME"):
             y = conv(features, kernel, padding=padding)(y)
             if use_norm:
-                y = _bind_norm(self.norm, features, self.train)(y)
+                y = _bind_norm(self.norm, features, self.train, dtype=self.dtype)(y)
             return nn.relu(y)
 
         b1 = unit(x, self.out1, (1, 1))
@@ -87,7 +87,7 @@ class GoogLeNet(nn.Module):
         def unit(y, features, kernel, **kw):
             y = conv(features, kernel, **kw)(y)
             if use_norm:
-                y = _bind_norm(self.norm, features, self.train)(y)
+                y = _bind_norm(self.norm, features, self.train, dtype=self.dtype)(y)
             return nn.relu(y)
 
         x = x.astype(self.dtype)

@@ -245,7 +245,8 @@ class NativeImageLoader:
 
     def release(self, slot: int) -> None:
         self._held.discard(slot)
-        self._lib.cmn_loader_release(self._handle, slot)
+        if self._handle:  # releasing after close() is a no-op, not a crash
+            self._lib.cmn_loader_release(self._handle, slot)
 
     # -- bookkeeping (SerialIterator-compatible surface) ---------------
     @property
@@ -362,7 +363,8 @@ class NativeTokenLoader:
 
     def release(self, slot: int) -> None:
         self._held.discard(slot)
-        self._lib.cmn_loader_release(self._handle, slot)
+        if self._handle:  # releasing after close() is a no-op, not a crash
+            self._lib.cmn_loader_release(self._handle, slot)
 
     @property
     def epoch(self) -> int:

@@ -1,0 +1,42 @@
+"""The perf doc's measured table must be a function of the bench JSON.
+
+Round 2's doc hand-copied numbers and contradicted the driver-captured
+bench (0.92x vs 1.043x double-buffering).  docs/performance.md now
+embeds a generated table between markers declaring its source file;
+this test regenerates from that source and fails on any drift — a
+stale or hand-edited number cannot be committed silently.
+"""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def test_measured_table_matches_declared_source():
+    r = subprocess.run(
+        [sys.executable,
+         os.path.join(REPO, "benchmarks", "gen_perf_table.py")],
+        capture_output=True, text=True,
+    )
+    assert r.returncode == 0, (
+        f"doc drifted from its bench source:\n{r.stdout}{r.stderr}"
+    )
+    assert "matches" in r.stdout
+
+
+def test_generator_output_shape():
+    sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+    try:
+        from gen_perf_table import generate
+    finally:
+        sys.path.pop(0)
+
+    table = generate(os.path.join(REPO, "BENCH_r02.json"))
+    lines = table.splitlines()
+    assert lines[0].startswith("| config |")
+    # headline + every config row present
+    assert any("resnet50 (headline)" in l for l in lines)
+    assert any("seq2seq_mp" in l for l in lines)
+    assert any("moe_lm" in l for l in lines)

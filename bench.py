@@ -79,8 +79,18 @@ def _flops_of(jitted, *args):
 
 from chainermn_tpu.utils.benchmarking import (  # noqa: E402
     force_completion as _force,
-    time_steps as _time_steps,
+    time_steps as _time_steps_raw,
 )
+
+# Device burn-in before every timed config: the first executable timed
+# in a fresh process under-measures by 20-50% on the tunneled backend
+# (see utils/benchmarking.time_steps); ~12s of device activity
+# stabilizes it.  BENCH_BURN_S=0 to disable.
+_BURN_S = float(os.environ.get("BENCH_BURN_S", "0" if SMOKE else "12"))
+
+
+def _time_steps(run_fn, steps, warmup=1):
+    return _time_steps_raw(run_fn, steps, warmup, burn_seconds=_BURN_S)
 
 
 def _train_setup(comm, model, image, batch, n_classes, mutable_bn,

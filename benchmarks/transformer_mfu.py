@@ -1,0 +1,150 @@
+#!/usr/bin/env python
+"""TransformerLM MFU ablation (round 3): where do the 200 ms go?
+
+The bench config (8L/1024d, seq 2048, batch 8, flash attention, adamw)
+measures MFU 0.335.  Each rung isolates one component's cost with the
+same k-in-one-fori_loop timing as resnet_mfu_loop.py:
+
+  full        the bench config
+  batch16     is the MXU under-fed at batch 8?
+  no_head     lm_loss replaced by a mean over hidden states: removes the
+              32k-vocab logits matmul AND the fp32 (b, s, V) logits
+              materialization + softmax CE traffic (2.1 GB at batch 8)
+  no_attn     attention_fn returns q: isolates attention cost
+  sgd         adamw -> sgd: optimizer-state traffic share
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+K = int(os.environ.get("HUNT_K", "10"))
+VOCAB, D, LAYERS, SEQ = 32768, 1024, 8, 2048
+
+
+def _readback(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def time_variant(name, *, batch=8, loss="lm", attention="flash",
+                 opt="adamw"):
+    attn = {
+        "flash": flash_attention_fn(),
+        "none": lambda q, k, v, causal, scale: q,
+    }[attention]
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=D // 64, n_layers=LAYERS,
+        max_len=SEQ, attention_fn=attn,
+    )
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (batch, SEQ)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), toks[:1])
+    tx = (optax.adamw(3e-4, weight_decay=0.01) if opt == "adamw"
+          else optax.sgd(0.1, momentum=0.9))
+    opt_state = tx.init(params)
+
+    if loss == "lm":
+        def loss_fn(p):
+            return lm_loss(model.apply(p, toks), toks)
+    elif loss == "no_head":
+        # vocab-8 twin: the transformer blocks are identical, the 32k
+        # head matmul and the fp32 (b, s, 32k) logits/CE traffic vanish
+        small = TransformerLM(
+            vocab_size=8, d_model=D, n_heads=D // 64, n_layers=LAYERS,
+            max_len=SEQ, attention_fn=attn,
+        )
+        stoks = toks % 8
+        params = small.init(jax.random.PRNGKey(0), stoks[:1])
+        opt_state = tx.init(params)
+
+        def loss_fn(p):
+            return lm_loss(small.apply(p, stoks), stoks)
+    else:
+        raise ValueError(loss)
+
+    def one_step(p, o):
+        l, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        p = optax.apply_updates(p, updates)
+        return p, o, l
+
+    @jax.jit
+    def ksteps(p, o, n):
+        def body(i, carry):
+            p, o, _ = carry
+            return one_step(p, o)
+
+        return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
+
+    flops = None
+    try:
+        an = jax.jit(one_step).lower(
+            params, opt_state
+        ).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0]
+        flops = float(an.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    p, o, l = ksteps(params, opt_state, 2)
+    _readback(l)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _, _, l = ksteps(params, opt_state, n)
+        _readback(l)
+        return time.perf_counter() - t0
+
+    dts = []
+    for _ in range(2):
+        t1, t2 = timed(K), timed(2 * K)
+        dts.append((t2 - t1) / K)
+    dt = min(d for d in dts if d > 0) if any(d > 0 for d in dts) else dts[-1]
+    out = {
+        "variant": name,
+        "batch": batch,
+        "step_time_ms": round(dt * 1e3, 2),
+        "tokens_per_sec": round(batch * SEQ / dt, 1),
+        "samples": [round(d * 1e3, 2) for d in dts],
+    }
+    if flops:
+        out["tflops_per_step"] = round(flops / 1e12, 3)
+        out["mfu"] = round(flops / dt / 197e12, 4)
+    print(json.dumps(out), flush=True)
+
+
+VARIANTS = {
+    "full": lambda: time_variant("full"),
+    "batch16": lambda: time_variant("batch16", batch=16),
+    "no_head": lambda: time_variant("no_head", loss="no_head"),
+    "no_attn": lambda: time_variant("no_attn", attention="none"),
+    "sgd": lambda: time_variant("sgd", opt="sgd"),
+}
+
+
+def main():
+    for name in (sys.argv[1:] or list(VARIANTS)):
+        try:
+            VARIANTS[name]()
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -21,7 +21,8 @@ def force_completion(x) -> float:
     return float(np.asarray(x).ravel()[0])
 
 
-def time_steps(run_fn, steps: int, warmup: int = 1) -> float:
+def time_steps(run_fn, steps: int, warmup: int = 1,
+               burn_seconds: float = 0.0) -> float:
     """Seconds per step of ``run_fn`` via paired k / 2k timed runs.
 
     ``run_fn()`` must return an array whose value depends on the step's
@@ -29,12 +30,25 @@ def time_steps(run_fn, steps: int, warmup: int = 1) -> float:
     readback transitively waits on every one).  At least one warmup call
     always runs — it absorbs compilation and produces the value the
     pre-timing readback synchronizes on.
+
+    ``burn_seconds``: keep the device busy with ``run_fn`` for at least
+    this long before timing.  The FIRST executable measured in a fresh
+    process on the tunneled backend systematically under-measures by
+    20-50 % (a decaying per-dispatch cost that the paired difference
+    does not cancel; observed across every round-3 harness run —
+    measurements stabilize after a few seconds of device activity), so
+    benchmark entry points pass ~10 s here.
     """
     steps = max(int(steps), 1)
     out = None
     for _ in range(max(int(warmup), 1)):
         out = run_fn()
     force_completion(out)
+    if burn_seconds > 0:
+        t_end = time.perf_counter() + burn_seconds
+        while time.perf_counter() < t_end:
+            out = run_fn()
+            force_completion(out)
 
     def timed(k):
         t0 = time.perf_counter()

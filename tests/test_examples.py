@@ -94,6 +94,16 @@ class TestExampleScripts:
         )
         assert "final:" in out
 
+    def test_lm_sp_tp_train_and_sample(self, tmp_path):
+        out = _run(
+            "lm/train_lm.py", "--cpu-mesh", "--sp", "2", "--tp", "2",
+            "--steps", "6", "--report-every", "3", "--seq-len", "32",
+            "--d-model", "32", "--n-layers", "2", "--vocab", "64",
+            "--generate", "8", tmp_path=tmp_path,
+        )
+        assert "final:" in out
+        assert "sampled (tp-sharded KV-cache decode)" in out
+
     def test_mnist_checkpoint_resume(self, tmp_path):
         args = (
             "mnist/train_mnist_checkpoint.py", "--cpu-mesh",

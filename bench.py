@@ -478,6 +478,13 @@ def _lm_dims():
     return vocab, d_model, n_layers
 
 
+def _lm_heads(d_model):
+    """Head width 128 = the MXU lane dimension: dh=64 leaves half the
+    lanes idle in the flash kernel's QK/PV matmuls — measured 40%
+    slower end-to-end (benchmarks/transformer_mfu.py heads8 rung)."""
+    return max(d_model // 128, 1)
+
+
 def config_transformer_lm():
     """Beyond the reference's workloads: decoder-only LM with the Pallas
     flash-attention kernel — the matmul-heavy config where MFU should
@@ -491,7 +498,7 @@ def config_transformer_lm():
     seq = 128 if SMOKE else 2048
     batch = _env("BENCH_LM_BATCH", 2 if SMOKE else 8) * comm.size
     model = TransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        vocab_size=vocab, d_model=d_model, n_heads=_lm_heads(d_model),
         n_layers=n_layers, max_len=seq,
         attention_fn=None if SMOKE else flash_attention_fn(),
     )
@@ -524,7 +531,7 @@ def config_transformer_lm_long():
     seq = 256 if SMOKE else 8192
     batch = _env("BENCH_LM_LONG_BATCH", 1) * comm.size
     model = TransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        vocab_size=vocab, d_model=d_model, n_heads=_lm_heads(d_model),
         n_layers=n_layers, max_len=seq,
         attention_fn=None if SMOKE else flash_attention_fn(),
     )
@@ -560,7 +567,7 @@ def config_moe_lm():
     seq = 128 if SMOKE else 2048
     batch = _env("BENCH_MOE_BATCH", 2) * comm.size
     model = MoeTransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=d_model // 64,
+        vocab_size=vocab, d_model=d_model, n_heads=_lm_heads(d_model),
         n_layers=n_layers, n_experts=n_experts, moe_every=2, k=2,
         max_len=seq,
         attention_fn=None if SMOKE else flash_attention_fn(),

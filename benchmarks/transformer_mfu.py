@@ -39,13 +39,15 @@ def _readback(x):
 
 
 def time_variant(name, *, batch=8, loss="lm", attention="flash",
-                 opt="adamw"):
+                 opt="adamw", n_heads=None):
     attn = {
         "flash": flash_attention_fn(),
         "none": lambda q, k, v, causal, scale: q,
+        "xla": None,
     }[attention]
     model = TransformerLM(
-        vocab_size=VOCAB, d_model=D, n_heads=D // 64, n_layers=LAYERS,
+        vocab_size=VOCAB, d_model=D,
+        n_heads=n_heads or D // 64, n_layers=LAYERS,
         max_len=SEQ, attention_fn=attn,
     )
     toks = jnp.asarray(
@@ -133,6 +135,12 @@ VARIANTS = {
     "no_head": lambda: time_variant("no_head", loss="no_head"),
     "no_attn": lambda: time_variant("no_attn", attention="none"),
     "sgd": lambda: time_variant("sgd", opt="sgd"),
+    # head-geometry rungs: dh = d_model/n_heads is the flash kernel's
+    # MXU lane dimension; dh=64 leaves half the lanes idle
+    "heads8": lambda: time_variant("heads8", n_heads=8),
+    "heads8_xla": lambda: time_variant("heads8_xla", n_heads=8,
+                                       attention="xla"),
+    "xla_attn": lambda: time_variant("xla_attn", attention="xla"),
 }
 
 

@@ -514,6 +514,7 @@ def config_transformer_lm():
         "seq_len": seq,
         "d_model": d_model,
         "n_layers": n_layers,
+        "n_heads": model.n_heads,
         **extra,
     }
 

@@ -71,6 +71,23 @@ def bf16_norm_bf16red(size, **kw):
                         force_float32_reductions=False)
 
 
+def folded_norm(size, **kw):
+    """MultiNodeBatchNormalization without a mesh axis: fp32 stats, the
+    per-channel (inv*gamma, -mean*inv*gamma+beta) fold done in fp32,
+    ONE bf16 multiply-add pass over the activation.  The full-bench A/B
+    showed the sync-BN config (which uses this formulation) slightly
+    beating flax BatchNorm — this rung isolates the formulation."""
+    from chainermn_tpu.links.multi_node_batch_normalization import (
+        MultiNodeBatchNormalization,
+    )
+
+    kw.pop("dtype", None)
+    return MultiNodeBatchNormalization(
+        size=size, axis_name=None, dtype=jnp.bfloat16, epsilon=1e-5,
+        **kw,
+    )
+
+
 class S2DResNet(ResNet):
     """Stem consumes a 2x2 space-to-depth input (N, H/2, W/2, 12); the
     4x4 stride-1 conv with padding (2,1) is a reparametrization of the
@@ -213,6 +230,8 @@ VARIANTS = {
         "bn_bf16", ResNet50(train=True, norm=bf16_norm), 128),
     "bn_bf16red": lambda: time_variant(
         "bn_bf16red", ResNet50(train=True, norm=bf16_norm_bf16red), 128),
+    "folded": lambda: time_variant(
+        "folded", ResNet50(train=True, norm=folded_norm), 128),
     "s2d_bn16": lambda: time_variant(
         "s2d_bn16", _s2d(norm=bf16_norm), 128, s2d=True),
     "s2d_bn16red": lambda: time_variant(

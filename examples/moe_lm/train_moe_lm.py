@@ -74,6 +74,9 @@ def main(argv=None):
     p.add_argument("--lr", type=float, default=1e-3)
     p.add_argument("--aux-coef", type=float, default=1e-2)
     p.add_argument("--report-every", type=int, default=20)
+    p.add_argument("--generate", type=int, default=0,
+                   help="tokens to sample after training via the dense "
+                        "single-device twin (0 disables)")
     p.add_argument("--vocab-parallel", action="store_true",
                    help="shard the embedding table + tied head over the "
                         "model axis (Megatron vocab parallelism)")
@@ -195,6 +198,33 @@ def main(argv=None):
         print(f"final: loss={last_loss:.4f} "
               f"(uniform would be {np.log(args.vocab):.3f}; the Markov "
               "corpus floor is log 4 = 1.386)")
+
+    if args.generate > 0 and not args.vocab_parallel:
+        # Sample from the SAME sharded parameter tree: sequence
+        # parallelism is training-only, so the generation twin drops
+        # seq_axis but KEEPS the tensor/expert sharding — generate()
+        # runs the whole KV-cache loop in one shard_map over the mesh
+        # (head-sharded caches, expert all_to_all per step, routing at
+        # the no-drop capacity bound).  (--vocab-parallel models have
+        # no sampling tier yet: the vocab-sharded head would need a
+        # psum-argmax; materialize a dense head to sample from those.)
+        from chainermn_tpu.models.transformer import generate
+
+        gen_model = MoeTransformerLM(
+            vocab_size=args.vocab, d_model=args.d_model,
+            n_heads=args.n_heads, n_layers=args.n_layers,
+            n_experts=args.n_experts, moe_every=2, k=2,
+            capacity_factor=1.25, max_len=args.seq_len,
+            tp_axis="mn_model", expert_axis="mn_model",
+        )
+        prompt = jnp.asarray(corpus[:2, :8])
+        out = np.asarray(generate(
+            gen_model, params, prompt, args.generate,
+            comm=comm, param_specs=specs,
+        ))
+        if chief:
+            print(f"sampled (tp/ep-sharded MoE KV-cache decode): "
+                  f"{out[0].tolist()}")
     return last_loss
 
 

@@ -94,6 +94,17 @@ class TestExampleScripts:
         )
         assert "final:" in out
 
+    def test_moe_lm_composed_sampling(self, tmp_path):
+        # train sharded (SP x TP x EP), then sample through the
+        # tp/ep-sharded KV-cache decode under the same mesh
+        out = _run(
+            "moe_lm/train_moe_lm.py", "--cpu-mesh", "--sp", "2",
+            "--tp", "2", "--steps", "4", "--report-every", "2",
+            "--seq-len", "32", "--d-model", "32", "--n-layers", "2",
+            "--vocab", "64", "--generate", "8", tmp_path=tmp_path,
+        )
+        assert "sampled (tp/ep-sharded MoE KV-cache decode)" in out
+
     def test_lm_sp_tp_train_and_sample(self, tmp_path):
         out = _run(
             "lm/train_lm.py", "--cpu-mesh", "--sp", "2", "--tp", "2",

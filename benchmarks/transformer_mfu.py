@@ -39,7 +39,7 @@ def _readback(x):
 
 
 def time_variant(name, *, batch=8, loss="lm", attention="flash",
-                 opt="adamw", n_heads=None):
+                 opt="adamw", n_heads=None, remat=False):
     attn = {
         "flash": flash_attention_fn(),
         "none": lambda q, k, v, causal, scale: q,
@@ -61,6 +61,11 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
     if loss == "lm":
         def loss_fn(p):
             return lm_loss(model.apply(p, toks), toks)
+    elif loss == "chunked":
+        from chainermn_tpu.ops import chunked_lm_loss
+
+        def loss_fn(p):
+            return chunked_lm_loss(model, p, toks, n_chunks=16)
     elif loss == "no_head":
         # vocab-8 twin: the transformer blocks are identical, the 32k
         # head matmul and the fp32 (b, s, 32k) logits/CE traffic vanish
@@ -76,6 +81,9 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
             return lm_loss(small.apply(p, stoks), stoks)
     else:
         raise ValueError(loss)
+
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
 
     def one_step(p, o):
         l, grads = jax.value_and_grad(loss_fn)(p)
@@ -138,6 +146,22 @@ VARIANTS = {
     # head-geometry rungs: dh = d_model/n_heads is the flash kernel's
     # MXU lane dimension; dh=64 leaves half the lanes idle
     "heads8": lambda: time_variant("heads8", n_heads=8),
+    "heads8_b16_remat": lambda: time_variant(
+        "heads8_b16_remat", n_heads=8, batch=16, remat=True),
+    "heads8_b32_remat": lambda: time_variant(
+        "heads8_b32_remat", n_heads=8, batch=32, remat=True),
+    # chunked fused linear+CE: the (b, s, 32k) fp32 logits never
+    # materialize — the memory wall that made batch 16 OOM
+    "chunked": lambda: time_variant("chunked", n_heads=8,
+                                    loss="chunked"),
+    "chunked_b16": lambda: time_variant("chunked_b16", n_heads=8,
+                                        batch=16, loss="chunked"),
+    "chunked_b16_remat": lambda: time_variant(
+        "chunked_b16_remat", n_heads=8, batch=16, loss="chunked",
+        remat=True),
+    "chunked_b32_remat": lambda: time_variant(
+        "chunked_b32_remat", n_heads=8, batch=32, loss="chunked",
+        remat=True),
     "heads8_xla": lambda: time_variant("heads8_xla", n_heads=8,
                                        attention="xla"),
     "xla_attn": lambda: time_variant("xla_attn", attention="xla"),

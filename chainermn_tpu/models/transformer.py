@@ -370,6 +370,11 @@ class TransformerLM(nn.Module):
     # vocab block — train with vp_lm_loss, which assembles the softmax
     # statistics with collectives instead of materializing (.., V) rows.
     vocab_parallel: bool = False
+    # Return the post-LayerNorm hidden states (b, s, d) instead of
+    # logits: the chunked fused linear+CE loss
+    # (ops.chunked_lm_loss) applies the weight-tied head itself, one
+    # vocab chunk at a time, so the (b, s, V) logits never materialize.
+    return_hidden: bool = False
     attention_fn: Optional[Callable] = None
 
     @nn.compact
@@ -429,6 +434,8 @@ class TransformerLM(nn.Module):
                 attention_fn=self.attention_fn,
             )(x)
         x = nn.LayerNorm(dtype=jnp.float32)(x)
+        if self.return_hidden:
+            return x.astype(jnp.float32)
         # Weight-tied head.
         if self.vocab_parallel:
             return embed.attend(x.astype(jnp.float32))  # local vocab block

@@ -1,7 +1,12 @@
 from .losses import softmax_cross_entropy, accuracy  # noqa: F401
 from .attention import multi_head_attention  # noqa: F401
+from .chunked_ce import (  # noqa: F401
+    chunked_lm_loss,
+    chunked_softmax_cross_entropy,
+)
 
 __all__ = ["softmax_cross_entropy", "accuracy", "multi_head_attention",
+           "chunked_softmax_cross_entropy", "chunked_lm_loss",
            "flash_attention", "flash_attention_with_lse",
            "flash_attention_fn", "fused_cast_scale"]
 

@@ -571,6 +571,7 @@ def config_moe_lm():
         vocab_size=vocab, d_model=d_model, n_heads=_lm_heads(d_model),
         n_layers=n_layers, n_experts=n_experts, moe_every=2, k=2,
         max_len=seq,
+        dispatch_impl=os.environ.get("BENCH_MOE_DISPATCH", "auto"),
         attention_fn=None if SMOKE else flash_attention_fn(),
     )
     tps, step_time, extra = _bench_lm(

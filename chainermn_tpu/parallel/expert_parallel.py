@@ -160,6 +160,30 @@ def scatter_dispatch(x: jnp.ndarray, plan: RoutePlan, num_experts: int,
     return queues[:-1].reshape(num_experts, capacity, d)
 
 
+def gather_dispatch(x: jnp.ndarray, plan: RoutePlan, num_experts: int,
+                    capacity: int) -> jnp.ndarray:
+    """Token rows into queues via an id-scatter + row GATHER.
+
+    :func:`scatter_dispatch` scatters t*k d-wide rows; here only t*k
+    int32 token ids are scattered (into a (e*cap,) slot->token map) and
+    the queue rows are then one contiguous gather — trading the
+    random-access pattern from the wide write to the narrow one, which
+    is the cheaper side on TPU.  Empty/dropped slots map to a zero pad
+    row.  Numerics identical to both other backends.
+    """
+    t, d = x.shape
+    k = plan.chosen.shape[1]
+    dump = num_experts * capacity  # dropped routes land here
+    dest = jnp.where(
+        plan.keep, plan.chosen * capacity + plan.slot, dump
+    )  # (t, k)
+    ids = jnp.full((num_experts * capacity + 1,), t, jnp.int32)
+    token_ids = jnp.tile(jnp.arange(t, dtype=jnp.int32), (k,))
+    ids = ids.at[dest.T.reshape(-1)].set(token_ids, mode="drop")
+    x_pad = jnp.concatenate([x, jnp.zeros((1, d), x.dtype)])
+    return x_pad[ids[:-1]].reshape(num_experts, capacity, d)
+
+
 def scatter_combine(out: jnp.ndarray, plan: RoutePlan,
                     capacity: int) -> jnp.ndarray:
     """Gather each token's surviving expert outputs and gate-sum them —
@@ -298,10 +322,10 @@ def resolve_dispatch_impl(impl: str, t: int, num_experts: int,
     einsum against a one-hot operand dominates the layer's FLOPs."""
     if impl == "auto":
         return "scatter" if t * num_experts * cap >= (1 << 20) else "einsum"
-    if impl not in ("einsum", "scatter"):
+    if impl not in ("einsum", "scatter", "gather"):
         raise ValueError(
-            f"dispatch_impl must be 'auto', 'einsum' or 'scatter'; "
-            f"got {impl!r}"
+            f"dispatch_impl must be 'auto', 'einsum', 'scatter' or "
+            f"'gather'; got {impl!r}"
         )
     return impl
 
@@ -313,6 +337,8 @@ def dispatch_to_queues(x: jnp.ndarray, plan: RoutePlan, num_experts: int,
     if impl == "einsum":
         dispatch, _ = _dense_masks(plan, num_experts, capacity, x.dtype)
         return jnp.einsum("td,tec->ecd", x, dispatch)
+    if impl == "gather":
+        return gather_dispatch(x, plan, num_experts, capacity)
     return scatter_dispatch(x, plan, num_experts, capacity)
 
 

@@ -111,7 +111,7 @@ class TestRouting:
 
 
 class TestExpertParallelMoE:
-    @pytest.mark.parametrize("impl", ["einsum", "scatter"])
+    @pytest.mark.parametrize("impl", ["einsum", "scatter", "gather"])
     @pytest.mark.parametrize("k", [1, 2])
     def test_matches_dense_oracle_when_no_drops(self, mesh8, k, impl):
         x, rw, w1, w2 = _problem()
@@ -138,12 +138,12 @@ class TestExpertParallelMoE:
         assert float(aux) > 0.0
 
     def test_scatter_matches_einsum_with_drops_and_grads(self, mesh8):
-        """The two dispatch backends are numerically interchangeable —
+        """The dispatch backends are numerically interchangeable —
         including dropped routes (tight capacity) and gradients through
         gates, router, and expert weights."""
         x, rw, w1, w2 = _problem(seed=7)
         results = {}
-        for impl in ("einsum", "scatter"):
+        for impl in ("einsum", "scatter", "gather"):
             def loss(x, rw, w1, w2, impl=impl):
                 y, aux = expert_parallel_moe(
                     x, rw, mlp_experts(w1, w2), "mn", E, k=2,
@@ -175,12 +175,13 @@ class TestExpertParallelMoE:
                 np.asarray(fwd(xs, rw, w1, w2)),
                 [np.asarray(g) for g in grad(xs, rw, w1, w2)],
             )
-        np.testing.assert_allclose(
-            results["scatter"][0], results["einsum"][0],
-            rtol=1e-5, atol=1e-6,
-        )
-        for gs, ge in zip(results["scatter"][1], results["einsum"][1]):
-            np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
+        for other in ("scatter", "gather"):
+            np.testing.assert_allclose(
+                results[other][0], results["einsum"][0],
+                rtol=1e-5, atol=1e-6,
+            )
+            for gs, ge in zip(results[other][1], results["einsum"][1]):
+                np.testing.assert_allclose(gs, ge, rtol=1e-4, atol=1e-6)
 
     def test_differentiable_through_router_and_experts(self, mesh8):
         x, rw, w1, w2 = _problem(seed=3)

@@ -15,6 +15,7 @@ from .expert_parallel import (  # noqa: F401
     top_k_routing,
     route_plan,
     scatter_dispatch,
+    gather_dispatch,
     scatter_combine,
     dispatch_to_queues,
     combine_from_queues,
@@ -39,6 +40,7 @@ __all__ = [
     "top_k_routing",
     "route_plan",
     "scatter_dispatch",
+    "gather_dispatch",
     "scatter_combine",
     "dispatch_to_queues",
     "combine_from_queues",

@@ -1,0 +1,356 @@
+#!/usr/bin/env python
+"""Exposed-communication + double-buffering A/Bs, measured.
+
+The scaling projection (docs/performance.md) rests on the premise that
+the gradient ``psum`` rides the backward window — i.e. the *exposed*
+cost of gradient sync is near zero.  And the double-buffering knob's
+single-chip effect straddled 1.0 across two driver captures (r02
+1.043x, r03 0.971x).  Both claims get numbers here, via the reference's
+DummyCommunicator methodology (SURVEY.md section 5.1): run the same
+training config with and without the exchange, subtract.
+
+Variants (each prints one JSON line; k steps in ONE jitted fori_loop,
+the round-3 noise-proof harness — benchmarks/resnet_mfu_loop.py):
+
+Three rungs per config:
+  *_sync   build_train_step over the real communicator (psum in program)
+  *_dummy  build_train_step over DummyCommunicator — the IDENTICAL
+           compiled program minus the gradient exchange, so
+           (sync - dummy)/sync is the exposed-communication share with
+           everything else held equal
+  *_bare   a bare jitted optax step, no communicator machinery at all
+
+real-chip tier (default; 1-device mesh — the psum degenerates, so
+sync-vs-dummy bounds the single-chip machinery+collective cost):
+    resnet_{sync,dummy,bare}        ResNet-50 b128 224^2, sgd+momentum
+    lm_{sync,dummy,bare}            TransformerLM 8L/1024d b8 s2048, adamw
+
+virtual-mesh tier (--cpu-mesh; 8 virtual devices — the psum REALLY
+crosses ranks; CPU-confounded in that all 8 share host cores, so the
+exposed share here is a *pessimistic upper bound*: there is zero spare
+bandwidth to hide anything):
+    mesh_{sync,dummy}               MLP-1000 b2048-global
+    mesh_db_on / mesh_db_off        same config, double_buffering A/B
+    mesh_resnet_{sync,dummy,db_on,db_off}
+                                    ResNet-18 32^2 b128-global (conv mix)
+
+Usage:
+    python benchmarks/comm_overlap_bench.py                  # real chip
+    python benchmarks/comm_overlap_bench.py --cpu-mesh       # 8 virt dev
+    python benchmarks/comm_overlap_bench.py resnet_sync resnet_nosync
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu-mesh" in sys.argv:
+    sys.argv.remove("--cpu-mesh")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    CPU_MESH = True
+else:
+    CPU_MESH = False
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+K = int(os.environ.get("HUNT_K", "8" if CPU_MESH else "40"))
+REPEATS = int(os.environ.get("HUNT_REPEATS", "2"))
+
+
+def _readback(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def _time_kloop(ksteps, params, opt_state):
+    """(t_2k - t_k)/k with everything inside one dispatch."""
+    p, o, l = ksteps(params, opt_state, 2)  # compile + warm
+    _readback(l)
+    dts = []
+    for _ in range(REPEATS):
+        t0 = time.perf_counter()
+        _, _, l = ksteps(params, opt_state, K)
+        _readback(l)
+        t1 = time.perf_counter()
+        _, _, l = ksteps(params, opt_state, 2 * K)
+        _readback(l)
+        t2 = time.perf_counter()
+        dts.append(((t2 - t1) - (t1 - t0)) / K)
+    dt = min(d for d in dts if d > 0) if any(d > 0 for d in dts) else dts[-1]
+    return dt, dts
+
+
+def _emit(name, dt, dts, batch):
+    print(json.dumps({
+        "variant": name,
+        "step_time_ms": round(dt * 1e3, 3),
+        "samples_ms": [round(d * 1e3, 3) for d in dts],
+        "k": K,
+        "global_batch": batch,
+    }), flush=True)
+
+
+def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
+              double_buffering=False, comm_name="tpu"):
+    """Multi-node tier: build_train_step over the communicator's mesh —
+    grad psum + update in one program (k of them in one fori_loop)."""
+    import chainermn_tpu as cmn
+
+    comm = cmn.create_communicator(comm_name)
+    model = model_ctor()
+    x, y, init_arg = batch_fn(comm)
+    params = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
+    opt = cmn.create_multi_node_optimizer(
+        tx, comm, double_buffering=double_buffering
+    )
+    step = cmn.build_train_step(
+        comm, lambda p, b: loss_of(model, p, b), opt, donate=False
+    )
+    params, opt_state = step.place(params, opt.init(params))
+    bx = jax.device_put(x, step.batch_sharding)
+    by = jax.device_put(y, step.batch_sharding)
+    inner = step.get_jitted(params, opt_state)
+
+    @jax.jit
+    def ksteps(p, o, n):
+        def body(i, carry):
+            p, o, _ = carry
+            p, o, m = inner(p, o, (bx, by))
+            return p, o, m["loss"]
+
+        return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
+
+    dt, dts = _time_kloop(ksteps, params, opt_state)
+    _emit(name, dt, dts, int(x.shape[0]))
+
+
+def _run_bare(name, model_ctor, batch_fn, loss_of, tx):
+    """Machinery rung: identical loss/optimizer arithmetic, NO
+    communicator machinery at all — a bare jitted optax step on one
+    shard's worth of batch.  sync - bare = shard_map + multi-node
+    optimizer overhead (+ the exchange, where one exists)."""
+    import chainermn_tpu as cmn
+
+    comm = cmn.create_communicator("tpu")  # only for shard sizing
+    model = model_ctor()
+    x, y, init_arg = batch_fn(comm)
+    shard = x.shape[0] // comm.size
+    x, y = x[:shard], y[:shard]
+    params = model.init(jax.random.PRNGKey(0), init_arg)
+    opt_state = tx.init(params)
+
+    def one_step(p, o):
+        loss, grads = jax.value_and_grad(
+            lambda p: loss_of(model, p, (x, y))
+        )(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, loss
+
+    @jax.jit
+    def ksteps(p, o, n):
+        def body(i, carry):
+            p, o, _ = carry
+            return one_step(p, o)
+
+        return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
+
+    dt, dts = _time_kloop(ksteps, params, opt_state)
+    _emit(name, dt, dts, shard)
+
+
+# ---- model/config builders ------------------------------------------
+
+
+def _image_loss(model, p, b):
+    x, y = b
+    logits, _ = model.apply(
+        {"params": p["params"], "batch_stats": p.get("batch_stats", {})},
+        x, mutable=["batch_stats"],
+    )
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _image_loss_plain(model, p, b):
+    x, y = b
+    logits, _ = model.apply(p, x, mutable=["batch_stats"])
+    return optax.softmax_cross_entropy_with_integer_labels(logits, y).mean()
+
+
+def _resnet50_cfg():
+    from chainermn_tpu.models import ResNet50
+
+    def batch(comm):
+        b = 128 * comm.size
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(b, 224, 224, 3), jnp.bfloat16
+        )
+        y = jnp.asarray(
+            np.random.RandomState(1).randint(0, 1000, (b,)), jnp.int32
+        )
+        return x, y, jnp.zeros((1, 224, 224, 3), jnp.bfloat16)
+
+    return (lambda: ResNet50(train=True), batch,
+            optax.sgd(0.1, momentum=0.9))
+
+
+def _lm_cfg():
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    seq, vocab = 2048, 32768
+
+    def ctor():
+        return TransformerLM(
+            vocab_size=vocab, d_model=1024, n_heads=8, n_layers=8,
+            max_len=seq, attention_fn=flash_attention_fn(),
+        )
+
+    def batch(comm):
+        b = 8 * comm.size
+        toks = jnp.asarray(
+            np.random.RandomState(0).randint(0, vocab, (b, seq)), jnp.int32
+        )
+        return toks, toks, jnp.zeros((1, seq), jnp.int32)
+
+    def loss_of(model, p, b):
+        return lm_loss(model.apply(p, b[0]), b[0])
+
+    return ctor, batch, loss_of, optax.adamw(3e-4, weight_decay=0.01)
+
+
+def _mlp_cfg():
+    from chainermn_tpu.models import MLP
+
+    def ctor():
+        return MLP(n_units=1000, dtype=jnp.bfloat16)
+
+    def batch(comm):
+        b = 256 * comm.size
+        x = jnp.asarray(
+            np.random.RandomState(0).rand(b, 28, 28), jnp.float32
+        )
+        y = jnp.asarray(
+            np.random.RandomState(1).randint(0, 10, (b,)), jnp.int32
+        )
+        return x, y, jnp.zeros((1, 28, 28))
+
+    def loss_of(model, p, b):
+        logits = model.apply(p, b[0])
+        return optax.softmax_cross_entropy_with_integer_labels(
+            logits, b[1]
+        ).mean()
+
+    return ctor, batch, loss_of, optax.sgd(0.05)
+
+
+def _resnet18_cfg():
+    from chainermn_tpu.models import ResNet18
+
+    def batch(comm):
+        b = 16 * comm.size
+        x = jnp.asarray(
+            np.random.RandomState(0).randn(b, 32, 32, 3), jnp.bfloat16
+        )
+        y = jnp.asarray(
+            np.random.RandomState(1).randint(0, 10, (b,)), jnp.int32
+        )
+        return x, y, jnp.zeros((1, 32, 32, 3), jnp.bfloat16)
+
+    return (lambda: ResNet18(num_classes=10, train=True), batch,
+            optax.sgd(0.1, momentum=0.9))
+
+
+def _variants():
+    rn_ctor, rn_batch, rn_tx = _resnet50_cfg()
+    lm_ctor, lm_batch, lm_loss_of, lm_tx = _lm_cfg()
+    ml_ctor, ml_batch, ml_loss_of, ml_tx = _mlp_cfg()
+    r18_ctor, r18_batch, r18_tx = _resnet18_cfg()
+    return {
+        # real-chip tier.  *_dummy = DummyCommunicator at the compiled
+        # tier: the identical program minus the gradient exchange —
+        # (sync - dummy)/sync is the exposed-communication share.
+        # *_bare = no communicator machinery at all.
+        "resnet_sync": lambda: _run_sync(
+            "resnet_sync", rn_ctor, rn_batch, _image_loss, rn_tx),
+        "resnet_dummy": lambda: _run_sync(
+            "resnet_dummy", rn_ctor, rn_batch, _image_loss, rn_tx,
+            comm_name="dummy"),
+        "resnet_bare": lambda: _run_bare(
+            "resnet_bare", rn_ctor, rn_batch, _image_loss_plain, rn_tx),
+        "lm_sync": lambda: _run_sync(
+            "lm_sync", lm_ctor, lm_batch, lm_loss_of, lm_tx),
+        "lm_dummy": lambda: _run_sync(
+            "lm_dummy", lm_ctor, lm_batch, lm_loss_of, lm_tx,
+            comm_name="dummy"),
+        "lm_bare": lambda: _run_bare(
+            "lm_bare", lm_ctor, lm_batch, lm_loss_of, lm_tx),
+        # virtual-mesh tier (run with --cpu-mesh): the psum crosses ranks
+        "mesh_sync": lambda: _run_sync(
+            "mesh_sync", ml_ctor, ml_batch, ml_loss_of, ml_tx),
+        "mesh_dummy": lambda: _run_sync(
+            "mesh_dummy", ml_ctor, ml_batch, ml_loss_of, ml_tx,
+            comm_name="dummy"),
+        "mesh_db_on": lambda: _run_sync(
+            "mesh_db_on", ml_ctor, ml_batch, ml_loss_of, ml_tx,
+            double_buffering=True),
+        "mesh_db_off": lambda: _run_sync(
+            "mesh_db_off", ml_ctor, ml_batch, ml_loss_of, ml_tx),
+        "mesh_resnet_sync": lambda: _run_sync(
+            "mesh_resnet_sync", r18_ctor, r18_batch, _image_loss, r18_tx),
+        "mesh_resnet_dummy": lambda: _run_sync(
+            "mesh_resnet_dummy", r18_ctor, r18_batch, _image_loss, r18_tx,
+            comm_name="dummy"),
+        "mesh_resnet_db_on": lambda: _run_sync(
+            "mesh_resnet_db_on", r18_ctor, r18_batch, _image_loss, r18_tx,
+            double_buffering=True),
+        "mesh_resnet_db_off": lambda: _run_sync(
+            "mesh_resnet_db_off", r18_ctor, r18_batch, _image_loss,
+            r18_tx),
+        # communicator-variant A/B on identical grad-sync work: gives
+        # `two_dimensional` its first perf presence (VERDICT r3 #7) and
+        # validates each factorization's collective sequence end-to-end
+        "mesh_comm_flat": lambda: _run_sync(
+            "mesh_comm_flat", ml_ctor, ml_batch, ml_loss_of, ml_tx,
+            comm_name="flat"),
+        "mesh_comm_hierarchical": lambda: _run_sync(
+            "mesh_comm_hierarchical", ml_ctor, ml_batch, ml_loss_of,
+            ml_tx, comm_name="hierarchical"),
+        "mesh_comm_two_dimensional": lambda: _run_sync(
+            "mesh_comm_two_dimensional", ml_ctor, ml_batch, ml_loss_of,
+            ml_tx, comm_name="two_dimensional"),
+    }
+
+
+def main():
+    variants = _variants()
+    default = (
+        ["mesh_sync", "mesh_dummy", "mesh_db_off", "mesh_db_on",
+         "mesh_resnet_sync", "mesh_resnet_dummy", "mesh_resnet_db_off",
+         "mesh_resnet_db_on"]
+        if CPU_MESH else
+        ["resnet_sync", "resnet_dummy", "resnet_bare", "lm_sync",
+         "lm_dummy", "lm_bare"]
+    )
+    for name in (sys.argv[1:] or default):
+        try:
+            variants[name]()
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

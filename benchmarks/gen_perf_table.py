@@ -62,12 +62,47 @@ def _row(name, entry):
     )
 
 
+def _repair_truncated(record: dict) -> dict:
+    """Recover a round-3-style driver record whose final bench line
+    overflowed the driver's ~2000-char stdout tail: ``parsed`` is null
+    and ``tail`` holds the *end* of the line — the complete ``configs``
+    dict plus whatever headline fields survived.  Brace-match the
+    configs JSON and regex-scrape the surviving headline scalars."""
+    tail = record.get("tail", "")
+    i = tail.find('"configs": ')
+    if i < 0:
+        raise SystemExit("bench record is unparseable (no configs in tail)")
+    start = tail.index("{", i)
+    configs, _ = json.JSONDecoder().raw_decode(tail[start:])
+    parsed = {
+        "metric": "resnet50_train_images_per_sec_per_chip",
+        "value": None,
+        "unit": "images/sec/chip (headline value lost to tail truncation)",
+        "configs": configs,
+    }
+    for key in ("value", "vs_baseline", "step_time_ms", "mfu",
+                "model_tflops_per_step"):
+        m = re.search(rf'"{key}": ([\d.eE+-]+)', tail[:i])
+        if m:
+            parsed[key] = float(m.group(1))
+    return parsed
+
+
 def generate(bench_path: str) -> str:
     with open(bench_path) as f:
         # the bench file may hold the wrapped driver record or the raw line
         data = json.load(f)
     if "parsed" in data:
-        data = data["parsed"]
+        data = data["parsed"] if data["parsed"] is not None else (
+            _repair_truncated(data)
+        )
+    if "configs" not in data and "summary" in data:
+        # compact final-line record (round 4+)
+        data["configs"] = {
+            k: {"metric": k, "value": s.get("v"), "unit": s.get("u", ""),
+                "step_time_ms": s.get("ms"), "mfu": s.get("mfu")}
+            for k, s in data["summary"].items()
+        }
     lines = [
         "| config | metric | value | unit | step ms | MFU |",
         "|---|---|---|---|---|---|",

@@ -1,0 +1,170 @@
+#!/usr/bin/env python
+"""Decode/generation throughput across the sampling tiers.
+
+Round 3 shipped TP-native and MoE KV-cache generation but only the
+dense tier had a measured number (11.2k tok/s vs 2.2k recompute on
+v5e).  This script gives every tier a number (VERDICT r3 #6):
+
+real-chip tier (default):
+    dense_cache / dense_recompute   TransformerLM 8L/1024d, b8,
+                                    prompt 128 -> +128 greedy tokens
+    moe_cache / moe_recompute       MoeTransformerLM (8 experts, top-2
+                                    every other block), same shapes —
+                                    the routing machinery in the decode
+                                    loop, EP exchange degenerate on one
+                                    chip
+
+virtual-mesh tier (--cpu-mesh; 8 devices, CPU-confounded — relative
+numbers only):
+    tp2_cache                       the same dense LM decoded through
+                                    generate(comm=, param_specs=) on a
+                                    tp=2 hybrid mesh (head-sharded KV,
+                                    one row-parallel psum per token)
+    mesh_dense_cache                single-device dense decode on the
+                                    same host, the comparison point
+
+Each line reports new tokens/sec (prompt prefill included in the time).
+
+Usage:
+    python benchmarks/generate_bench.py [variants...]
+    python benchmarks/generate_bench.py --cpu-mesh
+"""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu-mesh" in sys.argv:
+    sys.argv.remove("--cpu-mesh")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+    CPU_MESH = True
+else:
+    CPU_MESH = False
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.utils.benchmarking import time_steps
+
+VOCAB, D, LAYERS, HEADS = 32768, 1024, 8, 8
+B, PROMPT, NEW = 8, 128, 128
+STEPS = int(os.environ.get("GEN_STEPS", "2" if CPU_MESH else "5"))
+BURN = float(os.environ.get("BENCH_BURN_S", "0" if CPU_MESH else "8"))
+
+if CPU_MESH:  # CPU-sized shapes: relative A/B only
+    VOCAB, D, LAYERS, HEADS = 1024, 128, 2, 4
+    B, PROMPT, NEW = 4, 16, 16
+
+
+def _prompt():
+    return jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (B, PROMPT)), jnp.int32
+    )
+
+
+def _dense_model(**kw):
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    return TransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        max_len=PROMPT + NEW, **kw,
+    )
+
+
+def _moe_model():
+    from chainermn_tpu.models.moe_transformer import MoeTransformerLM
+
+    return MoeTransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        n_experts=8 if not CPU_MESH else 2, moe_every=2, k=2,
+        max_len=PROMPT + NEW,
+    )
+
+
+def _time_generate(name, model, params, *, use_cache, comm=None,
+                   param_specs=None):
+    from chainermn_tpu.models.transformer import generate
+
+    prompt = _prompt()
+
+    def run():
+        return generate(
+            model, params, prompt, NEW, use_cache=use_cache,
+            comm=comm, param_specs=param_specs,
+        )
+
+    dt = time_steps(run, STEPS, warmup=1, burn_seconds=BURN)
+    print(json.dumps({
+        "variant": name,
+        "new_tokens_per_sec": round(B * NEW / dt, 1),
+        "sec_per_generate": round(dt, 4),
+        "batch": B, "prompt": PROMPT, "new_tokens": NEW,
+        "config": f"{LAYERS}L/{D}d h{HEADS} v{VOCAB}",
+    }), flush=True)
+
+
+def dense(use_cache, name):
+    model = _dense_model()
+    params = model.init(jax.random.PRNGKey(0), _prompt())
+    _time_generate(name, model, params, use_cache=use_cache)
+
+
+def moe(use_cache, name):
+    model = _moe_model()
+    params = model.init(jax.random.PRNGKey(0), _prompt())
+    _time_generate(name, model, params, use_cache=use_cache)
+
+
+def tp2_cache():
+    import chainermn_tpu as cmn
+    from chainermn_tpu.parallel import megatron_param_specs, sharded_init
+    from jax.sharding import PartitionSpec as P
+
+    comm = cmn.create_communicator("hybrid", tp_size=2)
+    model = _dense_model(tp_axis="mn_model")
+    params, specs = sharded_init(
+        lambda t: model.init(jax.random.PRNGKey(0), t),
+        comm.mesh, (P(),),
+        lambda p: megatron_param_specs(p, model_axis="mn_model"),
+        _prompt(),
+    )
+    _time_generate("tp2_cache", model, params, use_cache=True,
+                   comm=comm, param_specs=specs)
+
+
+VARIANTS = {
+    "dense_cache": lambda: dense(True, "dense_cache"),
+    "dense_recompute": lambda: dense(False, "dense_recompute"),
+    "moe_cache": lambda: moe(True, "moe_cache"),
+    "moe_recompute": lambda: moe(False, "moe_recompute"),
+    "tp2_cache": tp2_cache,
+    "mesh_dense_cache": lambda: dense(True, "mesh_dense_cache"),
+}
+
+
+def main():
+    default = (
+        ["mesh_dense_cache", "tp2_cache"]
+        if CPU_MESH else
+        ["dense_cache", "dense_recompute", "moe_cache", "moe_recompute"]
+    )
+    for name in (sys.argv[1:] or default):
+        try:
+            VARIANTS[name]()
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

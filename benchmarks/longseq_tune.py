@@ -1,0 +1,193 @@
+#!/usr/bin/env python
+"""seq-8192 tier tuning ladder (VERDICT r3 #4).
+
+The `transformer_lm_long` bench config (8L/1024d dh=128, batch 1,
+seq 8192, flash attention) reported the weakest audited MFU.  Its flash
+block sizes (256x512) were tuned at seq 2048, chunked CE was never
+tried in its claimed regime (long sequence = big logits buffer), and
+remat-enabled larger batches were untested.  Each rung here isolates
+one lever with the k-in-one-fori_loop harness:
+
+  block sweep   bq x bk in {256,512,1024} x {512,1024,2048} at b1
+  batch         b2 / b4 (no remat) — does amortizing fixed costs help?
+  remat         b2 / b4 with jax.checkpoint
+  chunked CE    fused linear+CE at b1 / b2 (the (b,s,32k) fp32 logits
+                buffer is 1 GB at b1 s8192 — exactly its claimed regime)
+  no_attn       attention removed: how much of the step is attention?
+  no_head       vocab-8 twin: how much is the LM head?
+
+Usage: python benchmarks/longseq_tune.py [variants...]
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+
+from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+K = int(os.environ.get("HUNT_K", "8"))
+VOCAB, D, LAYERS, HEADS = 32768, 1024, 8, 8
+SEQ = int(os.environ.get("TUNE_SEQ", "8192"))  # 2048 re-checks the
+# short-seq tier under the same sweep
+PEAK = 197e12
+
+
+def _attn_tflops(batch):
+    # 14*b*h*s^2*dh causal-halved, per layer (bench.py formula)
+    return 14.0 * batch * HEADS * SEQ * SEQ * (D // HEADS) / 2 * LAYERS / 1e12
+
+
+def _readback(x):
+    return float(np.asarray(x).ravel()[0])
+
+
+def time_variant(name, *, batch=None, loss="lm", attention="flash",
+                 block_q=256, block_k=512, remat=False):
+    if batch is None:
+        batch = int(os.environ.get("TUNE_BATCH", "1"))
+    attn = {
+        "flash": flash_attention_fn(block_q=block_q, block_k=block_k),
+        "none": lambda q, k, v, causal, scale: q,
+    }[attention]
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+        max_len=SEQ, attention_fn=attn,
+    )
+    toks = jnp.asarray(
+        np.random.RandomState(0).randint(0, VOCAB, (batch, SEQ)), jnp.int32
+    )
+    params = model.init(jax.random.PRNGKey(0), toks[:1])
+    tx = optax.adamw(3e-4, weight_decay=0.01)
+    opt_state = tx.init(params)
+
+    if loss == "lm":
+        def loss_fn(p):
+            return lm_loss(model.apply(p, toks), toks)
+    elif loss == "chunked":
+        from chainermn_tpu.ops import chunked_lm_loss
+
+        def loss_fn(p):
+            return chunked_lm_loss(model, p, toks, n_chunks=16)
+    elif loss == "no_head":
+        small = TransformerLM(
+            vocab_size=8, d_model=D, n_heads=HEADS, n_layers=LAYERS,
+            max_len=SEQ, attention_fn=attn,
+        )
+        stoks = toks % 8
+        params = small.init(jax.random.PRNGKey(0), stoks[:1])
+        opt_state = tx.init(params)
+
+        def loss_fn(p):
+            return lm_loss(small.apply(p, stoks), stoks)
+    else:
+        raise ValueError(loss)
+
+    if remat:
+        loss_fn = jax.checkpoint(loss_fn)
+
+    def one_step(p, o):
+        l, grads = jax.value_and_grad(loss_fn)(p)
+        updates, o = tx.update(grads, o, p)
+        return optax.apply_updates(p, updates), o, l
+
+    @jax.jit
+    def ksteps(p, o, n):
+        def body(i, carry):
+            p, o, _ = carry
+            return one_step(p, o)
+
+        return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
+
+    flops = None
+    try:
+        an = jax.jit(one_step).lower(
+            params, opt_state
+        ).compile().cost_analysis()
+        if isinstance(an, (list, tuple)):
+            an = an[0]
+        flops = float(an.get("flops", 0.0)) or None
+    except Exception:
+        pass
+
+    p, o, l = ksteps(params, opt_state, 2)
+    _readback(l)
+
+    def timed(n):
+        t0 = time.perf_counter()
+        _, _, l = ksteps(params, opt_state, n)
+        _readback(l)
+        return time.perf_counter() - t0
+
+    dts = []
+    for _ in range(2):
+        t1, t2 = timed(K), timed(2 * K)
+        dts.append((t2 - t1) / K)
+    dt = min(d for d in dts if d > 0) if any(d > 0 for d in dts) else dts[-1]
+    out = {
+        "variant": name,
+        "batch": batch,
+        "step_time_ms": round(dt * 1e3, 2),
+        "tokens_per_sec": round(batch * SEQ / dt, 1),
+        "samples": [round(d * 1e3, 2) for d in dts],
+    }
+    if flops:
+        attn_tf = _attn_tflops(batch) if attention == "flash" else 0.0
+        total = flops / 1e12 + attn_tf
+        out["tflops_per_step"] = round(total, 3)
+        out["mfu"] = round(total * 1e12 / dt / PEAK, 4)
+        out["mfu_xla_counted"] = round(flops / dt / PEAK, 4)
+    print(json.dumps(out), flush=True)
+
+
+VARIANTS = {}
+for bq in (256, 512, 1024):
+    for bk in (512, 1024, 2048):
+        VARIANTS[f"bq{bq}_bk{bk}"] = (
+            lambda bq=bq, bk=bk: time_variant(
+                f"bq{bq}_bk{bk}", block_q=bq, block_k=bk)
+        )
+VARIANTS.update({
+    "b2": lambda: time_variant("b2", batch=2),
+    "b4": lambda: time_variant("b4", batch=4),
+    # winners of the b1 block sweep, re-run at batch 2/4
+    "b2_bq1024_bk1024": lambda: time_variant(
+        "b2_bq1024_bk1024", batch=2, block_q=1024, block_k=1024),
+    "b2_bq256_bk2048": lambda: time_variant(
+        "b2_bq256_bk2048", batch=2, block_q=256, block_k=2048),
+    "b4_bq1024_bk1024": lambda: time_variant(
+        "b4_bq1024_bk1024", batch=4, block_q=1024, block_k=1024),
+    "chunked_bq1024_bk1024": lambda: time_variant(
+        "chunked_bq1024_bk1024", loss="chunked", block_q=1024,
+        block_k=1024),
+    "b2_remat": lambda: time_variant("b2_remat", batch=2, remat=True),
+    "b4_remat": lambda: time_variant("b4_remat", batch=4, remat=True),
+    "chunked": lambda: time_variant("chunked", loss="chunked"),
+    "chunked_b2": lambda: time_variant("chunked_b2", batch=2,
+                                       loss="chunked"),
+    "no_attn": lambda: time_variant("no_attn", attention="none"),
+    "no_head": lambda: time_variant("no_head", loss="no_head"),
+})
+
+
+def main():
+    for name in (sys.argv[1:] or list(VARIANTS)):
+        try:
+            VARIANTS[name]()
+        except Exception as e:
+            print(json.dumps({"variant": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

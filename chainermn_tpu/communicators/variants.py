@@ -492,7 +492,19 @@ class DummyCommunicator(NaiveCommunicator):
 
     Parity: ``DummyCommunicator`` (dummy_communicator.py), used to measure
     the communication-free throughput upper bound by subtraction.
+
+    Works at the compiled tier too: ``build_train_step(dummy, ...)``
+    builds the IDENTICAL sharded program (same mesh, batch sharding,
+    loss pmean) with only the gradient exchange omitted
+    (``no_exchange`` — optimizers._no_exchange), so
+    ``t_sync - t_dummy`` on the same config is the exposed cost of
+    gradient sync, every other byte of the program held equal.
+    Data-parallel path only: the hybrid ``param_specs`` path generates
+    its collectives inside autodiff (nothing to omit), so
+    ``build_train_step`` rejects the combination loudly.
     """
+
+    no_exchange = True
 
     def allreduce(self, x, op: str = "sum"):
         return jnp.asarray(self._check(x).copy())

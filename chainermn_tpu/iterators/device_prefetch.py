@@ -35,10 +35,18 @@ class _DevicePrefetcher:
         self._place = place_fn
         self._depth = depth
         self._buf: collections.deque = collections.deque()
+        # Serialize-state snapshot taken just before each buffered batch
+        # was drawn, aligned 1:1 with _buf.  Checkpointing through the
+        # prefetcher must not skip buffered-but-unconsumed batches: the
+        # resumable position is where the *oldest unconsumed* batch was
+        # fetched, not where the underlying iterator has raced ahead to.
+        self._states: collections.deque = collections.deque()
+        self._can_serialize = hasattr(it, "serialize")
         self._done = False
 
     def _top_up(self) -> None:
         while len(self._buf) < self._depth and not self._done:
+            state = self._it.serialize() if self._can_serialize else None
             try:
                 host = next(self._it)
             except StopIteration:
@@ -47,6 +55,7 @@ class _DevicePrefetcher:
             # async dispatch: returns a jax.Array immediately, the copy
             # proceeds while the caller's current step computes
             self._buf.append(self._place(host))
+            self._states.append(state)
 
     def __iter__(self):
         return self
@@ -56,6 +65,7 @@ class _DevicePrefetcher:
         if not self._buf:
             raise StopIteration
         out = self._buf.popleft()
+        self._states.popleft()
         # queue the replacement transfer NOW, behind the step the caller
         # is about to dispatch with `out`
         self._top_up()
@@ -63,9 +73,35 @@ class _DevicePrefetcher:
 
     next = __next__
 
+    # serialize/restore are exposed via __getattr__ (not class methods)
+    # so hasattr() feature detection keeps working: a wrapped iterator
+    # without serialize() must leave the prefetcher without one too
+    # (Trainer.state_dict treats that as "no iterator state", a
+    # graceful no-op).  When the underlying iterator HAS them, ours win
+    # — the naive passthrough would serialize the raced-ahead position
+    # and silently drop the buffered batches at resume.
+    def _serialize(self):
+        if self._states:
+            return self._states[0]
+        return self._it.serialize()
+
+    def _restore(self, state):
+        self._it.restore(state)
+        self._buf.clear()
+        self._states.clear()
+        self._done = False
+
     def __getattr__(self, name):
-        # bookkeeping passthrough (epoch, batches_per_epoch, ...)
-        return getattr(self._it, name)
+        it = self.__dict__.get("_it")
+        if it is None:  # mid-construction / unpickling
+            raise AttributeError(name)
+        if name == "serialize" and self.__dict__.get("_can_serialize"):
+            return self._serialize
+        if name == "restore" and hasattr(it, "restore"):
+            return self._restore
+        # bookkeeping passthrough (epoch, batches_per_epoch, ...);
+        # raises AttributeError naturally for absent names
+        return getattr(it, name)
 
 
 def prefetch_to_device(iterator: Iterator, place_fn: Callable,

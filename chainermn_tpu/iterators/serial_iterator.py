@@ -79,11 +79,17 @@ class SerialIterator:
     next = __next__
 
     def serialize(self):
+        """Cheap state snapshot — called per *batch* by
+        prefetch_to_device (checkpoint-rewind bookkeeping), so it must
+        not do O(dataset) work: ``_order`` is returned by reference
+        (``_new_order`` replaces it each epoch, never mutates in
+        place), and arrays beat giant Python lists in the orbax
+        checkpoint path anyway (one leaf vs one leaf per element)."""
         return {
             "epoch": self.epoch,
             "pos": self._pos,
-            "order": self._order.tolist(),
-            "rng": self._rng.get_state()[1].tolist(),
+            "order": self._order,
+            "rng": self._rng.get_state()[1].copy(),
         }
 
     def restore(self, state):

@@ -537,7 +537,9 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
       for capacity-routed MoE models (e.g. dense-mode
       ``MoeTransformerLM``) the twin's expert capacity is raised to the
       no-drop bound so a pad token's route can never evict a real
-      token's (see :func:`_recompute_twin`).
+      token's (see :func:`_recompute_twin`).  An explicitly pinned
+      ``model.capacity`` is therefore *not honored* during generation —
+      a ``UserWarning`` is emitted when one gets raised.
 
     Both compiled loops are cached per (model config, shapes,
     temperature).  Tensor-parallel models sample natively: pass ``comm``
@@ -673,9 +675,33 @@ def _recompute_twin(model, batch: int, total: int):
             for f in dataclasses.fields(twin)
             if f.name not in ("parent", "name")
         }
+        _warn_capacity_override(fields.get("capacity"), batch * total)
+        # dense path: per-call no-drop capacity (cap = this call's token
+        # count); EP path keeps the static prefill-sized bound
         fields["capacity"] = batch * total
+        if "no_drop" in names:
+            fields["no_drop"] = True
         twin = type(twin)(**fields)
     return twin
+
+
+def _warn_capacity_override(pinned, no_drop: int) -> None:
+    """Generation overrides a user-pinned MoE ``capacity`` with the
+    no-drop bound (padding-exactness needs it), which means sampling
+    routes tokens through a *less drop-constrained* model than the one
+    trained.  Outputs stay deterministic and the two generate tiers
+    agree with each other — but not necessarily with train-time routing,
+    so say so rather than diverge silently."""
+    if pinned is not None and pinned != no_drop:
+        import warnings
+
+        warnings.warn(
+            f"generate(): model.capacity={pinned} is overridden to the "
+            f"no-drop bound {no_drop} for padding-exact generation; "
+            "sampled routing may differ from the capacity-constrained "
+            "routing seen in training",
+            stacklevel=3,
+        )
 
 
 def _decode_twin(model, cache_len: int, batch: Optional[int] = None):
@@ -703,7 +729,13 @@ def _decode_twin(model, cache_len: int, batch: Optional[int] = None):
     if "cache_len" in fields:
         fields["cache_len"] = cache_len
     if "capacity" in fields and batch is not None:
+        _warn_capacity_override(fields.get("capacity"), batch * cache_len)
+        # dense path: no_drop sizes each call's expert queues to its own
+        # token count — the prefill routes batch*prompt tokens but each
+        # decode step routes only batch, so queues shrink ~cache_len-fold
         fields["capacity"] = batch * cache_len
+        if "no_drop" in fields:
+            fields["no_drop"] = True
     return type(model)(**fields)
 
 

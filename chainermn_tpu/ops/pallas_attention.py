@@ -62,6 +62,21 @@ def _effective_q_block(block_q: int, s_q: int, interpret: bool) -> int:
     return bq
 
 
+def _clamp_blocks_for_dim(block_q: int, block_k: int, d: int):
+    """Head-dim-aware block clamp.  The backward kernel holds three
+    (bq, bk) fp32 score tiles plus d-proportional operand/accumulator
+    tiles in scoped VMEM (16 MB hard limit; 1024x2048 at d=128 already
+    exceeds it — measured, benchmarks/longseq_tune.py).  The 1024x1024
+    default was validated at d <= 128; beyond that the d-proportional
+    share grows, so bigger head dims shrink the blocks to keep roughly
+    the same VMEM budget."""
+    if d > 128:
+        shrink = d // 128  # 256 -> /2, 512 -> /4
+        block_q = max(block_q // shrink, 256)
+        block_k = max(block_k // shrink, 256)
+    return block_q, block_k
+
+
 # ----------------------------------------------------------------------
 # Flash attention — forward kernel
 # ----------------------------------------------------------------------
@@ -145,6 +160,7 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
 def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
+    block_q, block_k = _clamp_blocks_for_dim(block_q, block_k, d)
     bq = _effective_q_block(block_q, s_q, interpret)
     bk = min(block_k, _round_up(s_k, 8))
 
@@ -325,6 +341,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     reused unchanged."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
+    block_q, block_k = _clamp_blocks_for_dim(block_q, block_k, d)
     bq = _effective_q_block(block_q, s_q, interpret)
     bk = min(block_k, _round_up(s_k, 8))
 
@@ -413,12 +430,21 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 # ----------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=256, block_k=512, interpret=None):
+                    block_q=1024, block_k=1024, interpret=None):
     """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
 
     Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
     online softmax).  ``interpret=None`` auto-selects: compiled on TPU,
     interpreter elsewhere.
+
+    Default blocks 1024x1024 (round-4 sweep, benchmarks/longseq_tune.py
+    at dh=128 on v5e: vs the old 256x512 defaults this measured +7.5 %
+    end-to-end at seq 2048 b8 and +24 % at seq 8192 b1; 1024x2048
+    exceeds the 16 MB scoped-vmem limit in the backward).  Blocks are
+    clamped to the (padded) sequence length, so short sequences are
+    unaffected, and shrunk proportionally for head dims > 128
+    (``_clamp_blocks_for_dim``) so the backward stays inside scoped
+    VMEM at geometries the sweep did not cover.
     """
     if not PALLAS_AVAILABLE:
         raise ImportError(
@@ -489,7 +515,7 @@ def _dense_attention_with_lse(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=256, block_k=512, interpret=None):
+                             block_q=1024, block_k=1024, interpret=None):
     """Flash attention returning ``(out, lse)`` with BOTH outputs
     differentiable — ``lse`` is the per-row log-sum-exp of the scaled
     scores, shaped (b, s_q, h).
@@ -551,7 +577,7 @@ flash_attention_with_lse.defvjp(
 )
 
 
-def flash_attention_fn(block_q: int = 256, block_k: int = 512,
+def flash_attention_fn(block_q: int = 1024, block_k: int = 1024,
                        interpret: Optional[bool] = None):
     """Adapter producing the ``attention_fn`` signature used by
     ``ulysses_attention``: ``(q, k, v, causal, scale)``."""

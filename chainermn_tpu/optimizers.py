@@ -62,6 +62,15 @@ def _axes_bound(axis_names) -> bool:
         return False
 
 
+def _no_exchange(comm) -> bool:
+    """DummyCommunicator at the compiled tier: the step program is built
+    identically (shard_map, batch sharding, loss pmean) but the gradient
+    exchange is omitted — the reference's subtraction methodology
+    (``DummyCommunicator``, SURVEY.md section 5.1) applied to the jitted
+    path.  `(t_sync - t_dummy)` is the exposed cost of gradient sync."""
+    return bool(getattr(comm, "no_exchange", False))
+
+
 def _sync_grads(grads, comm, comm_dtype=None, axes=None):
     """pmean gradients over mesh axes (compiled path).
 
@@ -120,7 +129,7 @@ class _MultiNodeOptimizer:
         (hybrid steps whose autodiff already produced global grads)."""
         comm = self._comm
         axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
-        if axes and _axes_bound(axes):
+        if axes and _axes_bound(axes) and not _no_exchange(comm):
             grads = _sync_grads(
                 grads, comm, comm.allreduce_grad_dtype, axes=axes
             )
@@ -160,7 +169,7 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
         comm = self._comm
         prev = state.prev_grads
         axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
-        if axes and _axes_bound(axes):
+        if axes and _axes_bound(axes) and not _no_exchange(comm):
             prev = _sync_grads(
                 prev, comm, comm.allreduce_grad_dtype, axes=axes
             )
@@ -409,6 +418,15 @@ def build_train_step(
             "vma-checked autodiff at full precision; create the hybrid "
             "communicator without a wire dtype"
         )
+    if hybrid and _no_exchange(comm):
+        raise ValueError(
+            "a no-exchange (dummy) communicator cannot drive the hybrid "
+            "param_specs path: its gradient collectives are generated "
+            "by autodiff from the in-loss pmean, so there is no "
+            "exchange to omit — the 'subtraction' would silently "
+            "measure zero.  Use the dummy communicator on the "
+            "data-parallel path only."
+        )
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
@@ -579,7 +597,8 @@ def build_train_step(
             if is_mn:
                 updates, opt_state = optimizer.update(grads, opt_state, params)
             else:
-                grads = _sync_grads(grads, comm)
+                if not _no_exchange(comm):
+                    grads = _sync_grads(grads, comm)
                 updates, opt_state = optimizer.update(grads, opt_state, params)
             params = optax.apply_updates(params, updates)
             if aux is not None and merge_aux is not None:
@@ -669,8 +688,21 @@ def build_train_step(
         return jax.device_put(batch, batch_sharding)
 
     def _is_placed(batch):
+        """True iff every leaf is already a global array laid out per
+        this step's batch sharding — only then is re-placement safely
+        skippable.  A default-device jnp array is a jax.Array too, but
+        NOT 'placed' (it still needs the shard layout), so the check
+        compares shardings, not just types."""
+        def ok(l):
+            if not isinstance(l, jax.Array):
+                return False
+            try:
+                return l.sharding.is_equivalent_to(batch_sharding, l.ndim)
+            except Exception:
+                return l.sharding == batch_sharding
+
         leaves = jax.tree_util.tree_leaves(batch)
-        return leaves and all(isinstance(l, jax.Array) for l in leaves)
+        return bool(leaves) and all(ok(l) for l in leaves)
 
     compiled: dict = {}
 
@@ -718,6 +750,7 @@ def build_train_step(
 
     checked_step.place = place
     checked_step.place_batch = place_batch
+    checked_step.is_placed = _is_placed
     checked_step.batch_sharding = batch_sharding
     checked_step.replicated_sharding = rep
     checked_step.get_jitted = _get_step

@@ -49,8 +49,16 @@ class Updater:
     def update(self) -> None:
         batch = next(self.iterator)
         place_batch = getattr(self.step_fn, "place_batch", None)
+        # build_train_step exposes its own placement predicate; a batch
+        # already laid out per the step's sharding (prefetch_to_device
+        # output) must NOT be re-placed — in multi-process runs
+        # make_array_from_process_local_data on a non-fully-addressable
+        # global array crashes.  An explicit batch_sharding always goes
+        # through device_put (a no-op when already right).
+        is_placed = getattr(self.step_fn, "is_placed", None)
         if place_batch is not None and not self._explicit_sharding:
-            batch = place_batch(batch)
+            if not (is_placed is not None and is_placed(batch)):
+                batch = place_batch(batch)
         elif self.batch_sharding is not None:
             batch = jax.device_put(batch, self.batch_sharding)
         self.params, self.opt_state, self.last_metrics = self.step_fn(

@@ -135,9 +135,9 @@ def main(argv=None):
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-side input double-buffering depth: batch "
                         "i+1's host->device transfer is dispatched while "
-                        "step i computes (0 disables; the input pipeline "
-                        "runs up to this many batches ahead, which a "
-                        "checkpoint resume reflects)")
+                        "step i computes (0 disables; checkpoint resume "
+                        "rewinds to the oldest unconsumed buffered batch, "
+                        "so no data is skipped)")
     p.add_argument("--cpu-mesh", action="store_true")
     p.add_argument("--checkpoint", default=None)
     args = p.parse_args(argv)

@@ -202,6 +202,64 @@ class TestDummy:
         x = jnp.arange(8.0).reshape(8, 1)
         np.testing.assert_allclose(np.asarray(comm.allreduce(x)), np.asarray(x))
 
+    def test_dummy_compiled_tier_skips_exchange(self, devices8):
+        """build_train_step(dummy) must be the real step's exact twin
+        minus the gradient exchange (the reference's subtraction
+        methodology at the compiled tier): (a) the first step's loss —
+        computed before any update — matches the synced step bit-for-
+        bit; (b) after that step, ranks hold *diverged* params under
+        dummy (each applied only its local grads) while the synced step
+        keeps them replicated-equal."""
+        import optax
+
+        import chainermn_tpu as cmn
+        from chainermn_tpu.models import MLP
+
+        def build(name):
+            comm = create_communicator(name, devices=devices8)
+            model = MLP(n_units=16, n_out=4, dtype=jnp.float32)
+            params = model.init(
+                jax.random.PRNGKey(0), jnp.zeros((1, 8, 8))
+            )
+            opt = cmn.create_multi_node_optimizer(optax.sgd(0.5), comm)
+
+            def loss_fn(p, b):
+                x, y = b
+                logits = model.apply(p, x)
+                return optax.softmax_cross_entropy_with_integer_labels(
+                    logits, y
+                ).mean()
+
+            step = cmn.build_train_step(comm, loss_fn, opt, donate=False)
+            params, opt_state = step.place(params, opt.init(params))
+            rng = np.random.RandomState(0)
+            # rank-varying batch so local grads genuinely differ
+            x = jnp.asarray(rng.randn(16, 8, 8), jnp.float32)
+            y = jnp.asarray(rng.randint(0, 4, (16,)), jnp.int32)
+            return step, params, opt_state, (x, y)
+
+        step_s, p_s, o_s, batch = build("tpu")
+        step_d, p_d, o_d, _ = build("dummy")
+        p_s2, o_s2, m_s = step_s(p_s, o_s, batch)
+        p_d2, o_d2, m_d = step_d(p_d, o_d, batch)
+        # (a) pre-update loss identical: same forward, same pmean
+        assert float(m_s["loss"]) == pytest.approx(
+            float(m_d["loss"]), rel=1e-6
+        )
+
+        def shards(tree):
+            leaf = jax.tree_util.tree_leaves(tree)[0]
+            return [np.asarray(s.data) for s in leaf.addressable_shards]
+
+        # (b) sync keeps params replicated; dummy lets ranks diverge
+        s_shards = shards(p_s2)
+        d_shards = shards(p_d2)
+        for sh in s_shards[1:]:
+            np.testing.assert_array_equal(sh, s_shards[0])
+        assert any(
+            not np.array_equal(sh, d_shards[0]) for sh in d_shards[1:]
+        )
+
 
 class TestNonCudaAwareContract:
     def test_every_collective_stages_through_host(self, devices8,

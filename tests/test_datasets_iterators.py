@@ -208,3 +208,64 @@ class TestDevicePrefetch:
         base = SerialIterator(list(range(16)), 4, shuffle=False)
         it = prefetch_to_device(base, lambda x: x)
         assert it.batch_size == 4
+
+    def test_serialize_rewinds_to_oldest_buffered(self):
+        """Checkpoint resume must not skip the buffered-but-unconsumed
+        batches the prefetcher raced ahead on: serialize() returns the
+        state as of the oldest unconsumed batch, so a fresh prefetcher
+        restored from it replays exactly the not-yet-consumed stream."""
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        ds = list(range(16))
+        base = SerialIterator(ds, 4, shuffle=False)
+        it = prefetch_to_device(base, lambda x: x, depth=2)
+        consumed = [next(it), next(it)]  # buffer holds batches 2,3
+        assert [b[0] for b in consumed] == [0, 4]
+        state = it.serialize()
+
+        base2 = SerialIterator(ds, 4, shuffle=False)
+        it2 = prefetch_to_device(base2, lambda x: x, depth=2)
+        it2.restore(state)
+        resumed = [next(it2), next(it2)]
+        # batches 8 and 12 — not 16-wrapped past the raced-ahead point
+        assert [b[0] for b in resumed] == [8, 12]
+
+    def test_no_serialize_stays_undetectable(self):
+        """A wrapped iterator without serialize() must leave the
+        prefetcher without one too — Trainer.state_dict feature-detects
+        with hasattr and treats absence as a graceful no-op; growing a
+        serialize() that raises would turn that into a checkpoint-time
+        crash."""
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        it = prefetch_to_device(iter(self._batches(2)), lambda x: x)
+        assert not hasattr(it, "serialize")
+        assert not hasattr(it, "restore")
+
+    def test_serialize_without_buffer_passthrough(self):
+        """Exhausted prefetcher (empty buffer): serialize() falls back
+        to the wrapped iterator's current state.  Uses a FINITE
+        serializable iterator — SerialIterator repeats forever, so
+        list() on it would never terminate."""
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        class FiniteIt:
+            def __init__(self):
+                self.pos = 0
+
+            def __next__(self):
+                if self.pos >= 3:
+                    raise StopIteration
+                self.pos += 1
+                return self.pos
+
+            def __iter__(self):
+                return self
+
+            def serialize(self):
+                return {"pos": self.pos}
+
+        base = FiniteIt()
+        it = prefetch_to_device(base, lambda x: x, depth=4)
+        assert list(it) == [1, 2, 3]  # exhaust: buffer empty
+        assert it.serialize() == {"pos": 3}

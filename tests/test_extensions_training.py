@@ -70,6 +70,32 @@ class TestTrainerLoop:
         trainer.run()
         assert trainer.iteration == 5
 
+    def test_prefetched_batches_not_replaced(self, comm):
+        """Feeding the Updater prefetch_to_device output (already-placed
+        global jax.Arrays) must NOT go through place_batch again — in
+        multi-process runs re-placing a non-fully-addressable global
+        array crashes.  The guard: placed batches pass straight through."""
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        model, it, step, params, opt_state = _make_training(comm)
+        calls = {"n": 0}
+        real_place = step.place_batch
+
+        def counting_place(batch):
+            calls["n"] += 1
+            return real_place(batch)
+
+        step.place_batch = counting_place
+        feed = prefetch_to_device(it, real_place, depth=2)
+        trainer = Trainer(
+            Updater(feed, step, params, opt_state),
+            stop_trigger=(3, "iteration"),
+        )
+        trainer.run()
+        assert trainer.iteration == 3
+        # the prefetcher placed them; the Updater must not re-place
+        assert calls["n"] == 0
+
 
 class TestEvaluator:
     def test_global_metrics(self, comm):

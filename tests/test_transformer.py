@@ -467,6 +467,34 @@ class TestGenerate:
             buf = jnp.concatenate([buf, nxt[:, None]], axis=1)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(buf))
 
+    def test_pinned_capacity_override_warns(self):
+        """Raising a user-pinned capacity to the no-drop bound changes
+        effective routing vs training — generate() must say so, not
+        diverge silently (and must stay quiet when nothing was pinned)."""
+        import warnings
+
+        from chainermn_tpu.models.moe_transformer import MoeTransformerLM
+        from chainermn_tpu.models.transformer import generate
+
+        moe = MoeTransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
+            capacity=2,
+        )
+        prompt = _tokens(b=1, s=4, seed=11)
+        params = moe.init(jax.random.PRNGKey(0), prompt)
+        with pytest.warns(UserWarning, match="no-drop bound"):
+            generate(moe, params, prompt, 2, use_cache=False)
+
+        unpinned = MoeTransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=HEADS, n_layers=2,
+            n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
+        )
+        params2 = unpinned.init(jax.random.PRNGKey(0), prompt)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error", UserWarning)
+            generate(unpinned, params2, prompt, 2, use_cache=False)
+
     def test_parallel_model_rejected(self):
         from chainermn_tpu.models.transformer import (
             TransformerLM,

@@ -43,7 +43,6 @@ Usage:
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -66,30 +65,16 @@ import numpy as np
 import optax
 from jax import lax
 
+from chainermn_tpu.utils.benchmarking import time_kloop
+
 K = int(os.environ.get("HUNT_K", "8" if CPU_MESH else "40"))
 REPEATS = int(os.environ.get("HUNT_REPEATS", "2"))
 
 
-def _readback(x):
-    return float(np.asarray(x).ravel()[0])
-
-
 def _time_kloop(ksteps, params, opt_state):
-    """(t_2k - t_k)/k with everything inside one dispatch."""
-    p, o, l = ksteps(params, opt_state, 2)  # compile + warm
-    _readback(l)
-    dts = []
-    for _ in range(REPEATS):
-        t0 = time.perf_counter()
-        _, _, l = ksteps(params, opt_state, K)
-        _readback(l)
-        t1 = time.perf_counter()
-        _, _, l = ksteps(params, opt_state, 2 * K)
-        _readback(l)
-        t2 = time.perf_counter()
-        dts.append(((t2 - t1) - (t1 - t0)) / K)
-    dt = min(d for d in dts if d > 0) if any(d > 0 for d in dts) else dts[-1]
-    return dt, dts
+    return time_kloop(
+        lambda n: ksteps(params, opt_state, n)[2], K, REPEATS
+    )
 
 
 def _emit(name, dt, dts, batch):

@@ -22,7 +22,6 @@ Usage: python benchmarks/longseq_tune.py [variants...]
 import json
 import os
 import sys
-import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -32,23 +31,20 @@ import numpy as np
 import optax
 from jax import lax
 
+from bench import _peak_flops
 from chainermn_tpu.models.transformer import TransformerLM, lm_loss
 from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+from chainermn_tpu.utils.benchmarking import time_kloop
 
 K = int(os.environ.get("HUNT_K", "8"))
 VOCAB, D, LAYERS, HEADS = 32768, 1024, 8, 8
 SEQ = int(os.environ.get("TUNE_SEQ", "8192"))  # 2048 re-checks the
 # short-seq tier under the same sweep
-PEAK = 197e12
 
 
 def _attn_tflops(batch):
     # 14*b*h*s^2*dh causal-halved, per layer (bench.py formula)
     return 14.0 * batch * HEADS * SEQ * SEQ * (D // HEADS) / 2 * LAYERS / 1e12
-
-
-def _readback(x):
-    return float(np.asarray(x).ravel()[0])
 
 
 def time_variant(name, *, batch=None, loss="lm", attention="flash",
@@ -119,20 +115,9 @@ def time_variant(name, *, batch=None, loss="lm", attention="flash",
     except Exception:
         pass
 
-    p, o, l = ksteps(params, opt_state, 2)
-    _readback(l)
-
-    def timed(n):
-        t0 = time.perf_counter()
-        _, _, l = ksteps(params, opt_state, n)
-        _readback(l)
-        return time.perf_counter() - t0
-
-    dts = []
-    for _ in range(2):
-        t1, t2 = timed(K), timed(2 * K)
-        dts.append((t2 - t1) / K)
-    dt = min(d for d in dts if d > 0) if any(d > 0 for d in dts) else dts[-1]
+    dt, dts = time_kloop(
+        lambda n: ksteps(params, opt_state, n)[2], K, repeats=2
+    )
     out = {
         "variant": name,
         "batch": batch,
@@ -140,12 +125,13 @@ def time_variant(name, *, batch=None, loss="lm", attention="flash",
         "tokens_per_sec": round(batch * SEQ / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
     }
-    if flops:
+    peak = _peak_flops(jax.devices()[0])
+    if flops and peak:
         attn_tf = _attn_tflops(batch) if attention == "flash" else 0.0
         total = flops / 1e12 + attn_tf
         out["tflops_per_step"] = round(total, 3)
-        out["mfu"] = round(total * 1e12 / dt / PEAK, 4)
-        out["mfu_xla_counted"] = round(flops / dt / PEAK, 4)
+        out["mfu"] = round(total * 1e12 / dt / peak, 4)
+        out["mfu_xla_counted"] = round(flops / dt / peak, 4)
     print(json.dumps(out), flush=True)
 
 

@@ -84,18 +84,36 @@ class SerialIterator:
         not do O(dataset) work: ``_order`` is returned by reference
         (``_new_order`` replaces it each epoch, never mutates in
         place), and arrays beat giant Python lists in the orbax
-        checkpoint path anyway (one leaf vs one leaf per element)."""
+        checkpoint path anyway (one leaf vs one leaf per element).
+
+        The FULL RNG state is captured so a resumed run reshuffles
+        identically to the uninterrupted one — with ``shuffle=True``,
+        an epoch boundary crossed after restore calls ``_new_order()``,
+        which must draw the same permutation."""
+        kind, keys, pos, has_gauss, cached = self._rng.get_state()
         return {
             "epoch": self.epoch,
             "pos": self._pos,
             "order": self._order,
-            "rng": self._rng.get_state()[1].copy(),
+            "rng_kind": kind,
+            "rng_keys": keys.copy(),
+            "rng_pos": pos,
+            "rng_has_gauss": has_gauss,
+            "rng_cached": cached,
         }
 
     def restore(self, state):
         self.epoch = state["epoch"]
         self._pos = state["pos"]
         self._order = np.asarray(state["order"])
+        if "rng_keys" in state:
+            self._rng.set_state((
+                str(state.get("rng_kind", "MT19937")),
+                np.asarray(state["rng_keys"], np.uint32),
+                int(state["rng_pos"]),
+                int(state.get("rng_has_gauss", 0)),
+                float(state.get("rng_cached", 0.0)),
+            ))
 
 
 class EpochIterator:

@@ -71,9 +71,12 @@ def _clamp_blocks_for_dim(block_q: int, block_k: int, d: int):
     share grows, so bigger head dims shrink the blocks to keep roughly
     the same VMEM budget."""
     if d > 128:
-        shrink = d // 128  # 256 -> /2, 512 -> /4
-        block_q = max(block_q // shrink, 256)
-        block_k = max(block_k // shrink, 256)
+        shrink = -(-d // 128)  # ceil: 192 -> /2, 256 -> /2, 512 -> /4
+
+        def down(b):
+            return max(b // shrink // 128 * 128, 256)
+
+        block_q, block_k = down(block_q), down(block_k)
     return block_q, block_k
 
 

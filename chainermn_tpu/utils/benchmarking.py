@@ -63,3 +63,32 @@ def time_steps(run_fn, steps: int, warmup: int = 1,
     if dt <= 0:  # noise floor: fall back to the long run's average
         dt = t2 / (2 * steps)
     return dt
+
+
+def time_kloop(run_k, k: int, repeats: int = 2):
+    """Seconds per step for a k-steps-in-ONE-dispatch harness.
+
+    ``run_k(n)`` must execute n steps inside a single device dispatch
+    (e.g. a jitted ``fori_loop`` with a traced trip count) and return an
+    array depending on every step.  Times paired k / 2k dispatches and
+    returns ``(dt, samples)`` where dt is the min positive paired
+    difference — per-dispatch link noise that plagues step-at-a-time
+    timing cancels because one dispatch covers seconds of device time
+    (benchmarks/resnet_mfu_loop.py's methodology, shared here so the
+    benchmark scripts can't drift apart).  Falls back to the long run's
+    average when every paired difference is non-positive (noise floor).
+    """
+    force_completion(run_k(2))  # compile + warm
+    dts = []
+    t2k_last = None
+    for _ in range(max(int(repeats), 1)):
+        t0 = time.perf_counter()
+        force_completion(run_k(k))
+        t1 = time.perf_counter()
+        force_completion(run_k(2 * k))
+        t2 = time.perf_counter()
+        dts.append(((t2 - t1) - (t1 - t0)) / k)
+        t2k_last = t2 - t1
+    positive = [d for d in dts if d > 0]
+    dt = min(positive) if positive else t2k_last / (2 * k)
+    return dt, dts

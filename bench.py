@@ -789,9 +789,46 @@ def config_seq2seq_mp():
     return out
 
 
+def _probe_device(timeout_s: int) -> bool:
+    """Backend reachability probe in a SUBPROCESS.
+
+    When the tunneled TPU's relay dies, any `jax.devices()` call blocks
+    indefinitely inside the PJRT client (a C call — even SIGALRM can't
+    interrupt it), so a wedged tunnel would leave the whole bench hung
+    with zero output and the driver would capture nothing.  A subprocess
+    probe can be killed from outside; on failure the harness emits a
+    parseable error record instead of hanging."""
+    import subprocess
+
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True,
+        )
+        return r.returncode == 0
+    except subprocess.TimeoutExpired:
+        return False
+
+
 def main():
     headline = None
     extras = {}
+    if not SMOKE and not os.environ.get("BENCH_SKIP_PROBE"):
+        probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
+        if not _probe_device(probe_s):
+            print(json.dumps({
+                "metric": "resnet50_train_images_per_sec_per_chip",
+                "value": None,
+                "unit": "images/sec/chip",
+                "vs_baseline": None,
+                "error": (
+                    f"device backend unreachable (probe timed out after "
+                    f"{probe_s}s — tunneled TPU relay down?); see "
+                    "BENCH_r04_local.json for the committed local "
+                    "capture of this revision"
+                ),
+            }), flush=True)
+            return
     secondary = [
         ("mnist", config_mnist_flat),
         ("vgg16_db", config_vgg16_double_buffering),

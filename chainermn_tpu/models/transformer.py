@@ -545,9 +545,13 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
     temperature).  Tensor-parallel models sample natively: pass ``comm``
     (whose mesh binds ``model.tp_axis``) and ``param_specs`` — the whole
     loop then runs in one ``shard_map`` with head-sharded KV caches and
-    a row-parallel psum per decoded token.  Sequence-/vocab-parallel
-    variants are for training; materialize a dense/TP model (same param
-    tree for ``seq_axis=None``) to sample.
+    a row-parallel psum per decoded token.  Vocab-parallel models
+    (``vocab_parallel=True``) sample natively too: the embedding/tied
+    head stay vocab-sharded and only the frontier logits row is
+    all-gathered per decoded token (b x V floats — never the
+    (b, s, V) tensor), making tokens identical to the dense head's.
+    Sequence-parallel is training-only; materialize a ``seq_axis=None``
+    model (same param tree) to sample.
 
     Args:
       prompt: (batch, prompt_len) int32 token ids.
@@ -576,14 +580,12 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
     if rng is None:
         rng = jax.random.PRNGKey(0)  # unused in greedy mode
     tp_axis = getattr(model, "tp_axis", None)
-    if (
-        getattr(model, "seq_axis", None) is not None
-        or getattr(model, "vocab_parallel", False)
-    ):
+    vocab_parallel = getattr(model, "vocab_parallel", False)
+    if getattr(model, "seq_axis", None) is not None:
         raise ValueError(
-            "generate() samples from dense (optionally tensor-parallel) "
-            "models; construct one with seq_axis=None, "
-            "vocab_parallel=False (the param tree is compatible)"
+            "generate() samples from dense (optionally tensor-/vocab-"
+            "parallel) models; construct one with seq_axis=None (the "
+            "param tree is compatible)"
         )
     if tp_axis is not None and (comm is None or param_specs is None):
         raise ValueError(
@@ -592,12 +594,16 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
             "(the parameter PartitionSpec tree, e.g. "
             "megatron_param_specs/moe_param_specs)"
         )
+    # vocab_parallel implies tp_axis (enforced at model construction),
+    # so the TP-tier requirements above already hold; sampling gathers
+    # only the frontier logits row per token (_full_vocab).
+    vp_axis = tp_axis if vocab_parallel else None
     if use_cache is None:
         use_cache = _has_decode_field(model)
     if use_cache:
         loop = _cached_decode_loop(
             _decode_twin(model, total, batch=b), s0, max_new_tokens,
-            float(temperature),
+            float(temperature), vp_axis=vp_axis,
         )
         run, args = loop, (params, prompt, rng)
     else:
@@ -605,7 +611,7 @@ def generate(model: TransformerLM, params, prompt: jnp.ndarray,
         buf0 = lax.dynamic_update_slice(buf0, prompt, (0, 0))
         loop = _generate_loop(
             _recompute_twin(model, b, total), s0, max_new_tokens,
-            float(temperature)
+            float(temperature), vp_axis=vp_axis,
         )
         run = lambda p, buf, key: loop(p, buf, key)[0]
         args = (params, buf0, rng)
@@ -739,6 +745,19 @@ def _decode_twin(model, cache_len: int, batch: Optional[int] = None):
     return type(model)(**fields)
 
 
+def _full_vocab(step_logits, vp_axis):
+    """Vocab-parallel models emit the LOCAL vocab block; sampling needs
+    the full row.  One tiled all_gather of the (b, V/n) frontier row —
+    shard r holds global rows [r*V/n, (r+1)*V/n), so concatenation in
+    axis order IS global vocab order and the downstream `_sample` is
+    token-identical to the dense head's.  Only the sampled position is
+    gathered (b x V floats per token), never the (b, s, V) tensor the
+    vp training path exists to avoid."""
+    if vp_axis is None:
+        return step_logits
+    return lax.all_gather(step_logits, vp_axis, axis=-1, tiled=True)
+
+
 def _sample(step_logits, key, temperature: float):
     """One sampling decision — shared by both generate tiers so their
     pinned numerical identity can't drift (same key-split order)."""
@@ -752,7 +771,7 @@ def _sample(step_logits, key, temperature: float):
 
 @functools.lru_cache(maxsize=32)
 def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
-                        temperature: float):
+                        temperature: float, vp_axis=None):
     """Compiled KV-cache sampling: prefill the prompt, then scan one
     token at a time against the caches."""
 
@@ -765,7 +784,9 @@ def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
         out, mut = dmodel.apply(params, prompt, mutable=["cache"])
         cache = mut["cache"]
         nxt, key = _sample(
-            logits_of(out)[:, -1].astype(jnp.float32), key, temperature
+            _full_vocab(
+                logits_of(out)[:, -1].astype(jnp.float32), vp_axis
+            ), key, temperature
         )
 
         def body(carry, _):
@@ -775,8 +796,9 @@ def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
                 mutable=["cache"],
             )
             nxt, key = _sample(
-                logits_of(out)[:, -1].astype(jnp.float32), key,
-                temperature
+                _full_vocab(
+                    logits_of(out)[:, -1].astype(jnp.float32), vp_axis
+                ), key, temperature
             )
             return (mut["cache"], nxt, key), nxt
 
@@ -793,7 +815,7 @@ def _cached_decode_loop(dmodel, s0: int, max_new_tokens: int,
 
 @functools.lru_cache(maxsize=32)
 def _generate_loop(model, s0: int, max_new_tokens: int,
-                   temperature: float):
+                   temperature: float, vp_axis=None):
     """Compiled sampling loop, cached per (model config, shapes,
     temperature) so repeated generate() calls reuse the executable
     (flax modules are frozen/hashable; a fresh jit per call would
@@ -807,9 +829,10 @@ def _generate_loop(model, s0: int, max_new_tokens: int,
             logits = out[0] if isinstance(out, tuple) else out
             step_logits = lax.dynamic_index_in_dim(
                 logits, s0 + i - 1, axis=1, keepdims=False
-            )  # (b, V) at the frontier position
+            )  # (b, V) at the frontier position ((b, V/n) under vp)
             nxt, key = _sample(
-                step_logits.astype(jnp.float32), key, temperature
+                _full_vocab(step_logits.astype(jnp.float32), vp_axis),
+                key, temperature
             )
             buf = lax.dynamic_update_slice(
                 buf, nxt[:, None], (0, s0 + i)

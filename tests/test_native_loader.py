@@ -317,12 +317,14 @@ class TestLoaderThroughput:
         """Native-input evidence (VERDICT r3 #3): measure what the
         loader+host-cast pipeline alone produces at bench shapes
         (128x224x224x3 uint8 -> crop/flip/normalize -> bf16 host cast,
-        no device in the loop).  On a multi-core host the worker
-        threads must clear the measured tunnel-link ceiling (~400
-        img/s, benchmarks/h2d_bench.py) with margin; on the 1-core
-        bench host the pipeline is itself host-bound, which is part of
-        the documented native-input story (docs/performance.md) — there
-        only a sanity floor is asserted."""
+        no device in the loop).  The measured tunnel-link input ceiling
+        is ~160 img/s at image-like entropy and varies by run
+        (benchmarks/h2d_bench.py; docs/performance.md 'Native-input
+        pipeline' has the full table) — on a multi-core host the worker
+        threads clear it easily, while on the 1-core bench host the
+        pipeline is itself host-bound, which is part of the documented
+        story.  Only a sanity floor is asserted here (wall-clock
+        throughput assertions don't belong in a unit suite)."""
         import time
 
         import ml_dtypes

@@ -569,6 +569,71 @@ class TestGenerate:
         np.testing.assert_array_equal(np.asarray(a), np.asarray(bb))
         assert np.asarray(a).max() < VOCAB and np.asarray(a).min() >= 0
 
+    def test_vocab_parallel_generate_matches_dense(self, devices8):
+        """Vocab-parallel sampling: embedding/tied head stay sharded,
+        only the frontier logits row is all-gathered per token — the
+        emitted tokens must be IDENTICAL to a dense model holding the
+        same global weights (shard order concatenates to global vocab
+        order), on both tiers, greedy and sampled."""
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.models.transformer import generate
+        from chainermn_tpu.parallel import (
+            megatron_param_specs,
+            sharded_init,
+        )
+
+        model = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=4, n_layers=2,
+            max_len=32, dtype=jnp.float32, tp_axis="mn_model",
+            vocab_parallel=True,
+        )
+        prompt = _tokens(b=2, s=4, seed=33)
+        comm = cmn.create_communicator("hybrid", devices=devices8,
+                                       tp_size=4)
+        params, specs = sharded_init(
+            lambda t: model.init(jax.random.PRNGKey(0), t),
+            comm.mesh, (P(),),
+            lambda p: megatron_param_specs(p, model_axis="mn_model"),
+            prompt,
+        )
+        fast = generate(model, params, prompt, 5, use_cache=True,
+                        comm=comm, param_specs=specs)
+        slow = generate(model, params, prompt, 5, use_cache=False,
+                        comm=comm, param_specs=specs)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+        # non-vp TP twin with the SAME global weights: identical
+        # Column/RowParallel modules, only the embed differs — the vp
+        # embedding's global (V, d) table becomes the dense nn.Embed
+        # table.  vp sampling must emit the same tokens (the gathered
+        # frontier row equals the dense head's row).
+        host = jax.tree_util.tree_map(np.asarray, params)
+        p = dict(host["params"])
+        vp_key = next(k for k in p if "VocabParallelEmbed" in k)
+        p["embed"] = {"embedding": p.pop(vp_key)["embedding"]}
+        nonvp = TransformerLM(
+            vocab_size=VOCAB, d_model=D, n_heads=4, n_layers=2,
+            max_len=32, dtype=jnp.float32, tp_axis="mn_model",
+        )
+        from chainermn_tpu.parallel import megatron_param_specs as mps
+
+        nonvp_params = {"params": p}
+        nonvp_specs = mps(nonvp_params, model_axis="mn_model")
+        want = generate(nonvp, nonvp_params, prompt, 5, use_cache=True,
+                        comm=comm, param_specs=nonvp_specs)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(want))
+
+        # sampled tier: same key stream -> same tokens as the twin
+        key = jax.random.PRNGKey(11)
+        vp_s = generate(model, params, prompt, 5, temperature=0.7,
+                        rng=key, use_cache=True, comm=comm,
+                        param_specs=specs)
+        dn_s = generate(nonvp, nonvp_params, prompt, 5,
+                        temperature=0.7, rng=key, use_cache=True,
+                        comm=comm, param_specs=nonvp_specs)
+        np.testing.assert_array_equal(np.asarray(vp_s), np.asarray(dn_s))
+
     def test_overflow_and_missing_rng_rejected(self):
         from chainermn_tpu.models.transformer import generate
 
@@ -577,6 +642,53 @@ class TestGenerate:
             generate(model, params, prompt, 40)
         with pytest.raises(ValueError, match="rng"):
             generate(model, params, prompt, 2, temperature=0.5)
+
+    def test_vocab_parallel_moe_generate(self, devices8):
+        """vp sampling composed with MoE: the frontier-row gather sits
+        after the (logits, aux) unwrap and coexists with the no-drop
+        capacity override — the vp MoE's tokens must match the non-vp
+        twin holding the same global weights."""
+        from jax.sharding import PartitionSpec as P
+
+        from chainermn_tpu.models.moe_transformer import (
+            MoeTransformerLM,
+            moe_param_specs,
+        )
+        from chainermn_tpu.models.transformer import generate
+        from chainermn_tpu.parallel import sharded_init
+
+        def mk(vp):
+            return MoeTransformerLM(
+                vocab_size=VOCAB, d_model=D, n_heads=4, n_layers=2,
+                n_experts=2, d_ff=32, max_len=32, dtype=jnp.float32,
+                tp_axis="mn_model", expert_axis="mn_model",
+                vocab_parallel=vp,
+            )
+
+        prompt = _tokens(b=2, s=4, seed=44)
+        comm = cmn.create_communicator("hybrid", devices=devices8,
+                                       tp_size=2)
+        vp_model = mk(True)
+        params, specs = sharded_init(
+            lambda t: vp_model.init(jax.random.PRNGKey(0), t),
+            comm.mesh, (P(),), moe_param_specs, prompt,
+        )
+        fast = generate(vp_model, params, prompt, 4, use_cache=True,
+                        comm=comm, param_specs=specs)
+        slow = generate(vp_model, params, prompt, 4, use_cache=False,
+                        comm=comm, param_specs=specs)
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(slow))
+
+        host = jax.tree_util.tree_map(np.asarray, params)
+        p = dict(host["params"])
+        vp_key = next(k for k in p if "VocabParallelEmbed" in k)
+        p["embed"] = {"embedding": p.pop(vp_key)["embedding"]}
+        nonvp = mk(False)
+        nonvp_params = {"params": p}
+        want = generate(nonvp, nonvp_params, prompt, 4, use_cache=True,
+                        comm=comm,
+                        param_specs=moe_param_specs(nonvp_params))
+        np.testing.assert_array_equal(np.asarray(fast), np.asarray(want))
 
 
 class TestTraining:

@@ -789,7 +789,7 @@ def config_seq2seq_mp():
     return out
 
 
-def _probe_device(timeout_s: int) -> bool:
+def _probe_device(timeout_s: int):
     """Backend reachability probe in a SUBPROCESS.
 
     When the tunneled TPU's relay dies, any `jax.devices()` call blocks
@@ -797,33 +797,45 @@ def _probe_device(timeout_s: int) -> bool:
     interrupt it), so a wedged tunnel would leave the whole bench hung
     with zero output and the driver would capture nothing.  A subprocess
     probe can be killed from outside; on failure the harness emits a
-    parseable error record instead of hanging."""
+    parseable error record instead of hanging.  Returns None on
+    success, else a human-readable failure description (a fast non-zero
+    exit is a backend/install error, NOT a tunnel timeout — the two
+    need different debugging)."""
     import subprocess
 
     try:
         r = subprocess.run(
             [sys.executable, "-c", "import jax; jax.devices()"],
-            timeout=timeout_s, capture_output=True,
+            timeout=timeout_s, capture_output=True, text=True,
         )
-        return r.returncode == 0
     except subprocess.TimeoutExpired:
-        return False
+        return (
+            f"probe timed out after {timeout_s}s — tunneled device "
+            "relay down / claim unreleased?"
+        )
+    if r.returncode != 0:
+        return (
+            f"probe exited {r.returncode} (backend init error, not a "
+            f"timeout): {r.stderr.strip()[-500:]}"
+        )
+    return None
 
 
 def main():
     headline = None
     extras = {}
-    if not SMOKE and not os.environ.get("BENCH_SKIP_PROBE"):
+    if not SMOKE and not bool(int(os.environ.get("BENCH_SKIP_PROBE",
+                                                 "0"))):
         probe_s = int(os.environ.get("BENCH_PROBE_TIMEOUT_S", "240"))
-        if not _probe_device(probe_s):
+        failure = _probe_device(probe_s)
+        if failure:
             print(json.dumps({
                 "metric": "resnet50_train_images_per_sec_per_chip",
                 "value": None,
                 "unit": "images/sec/chip",
                 "vs_baseline": None,
                 "error": (
-                    f"device backend unreachable (probe timed out after "
-                    f"{probe_s}s — tunneled TPU relay down?); see "
+                    f"device backend unreachable: {failure}; see "
                     "BENCH_r04_local.json for the committed local "
                     "capture of this revision"
                 ),

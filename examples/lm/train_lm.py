@@ -67,6 +67,11 @@ def main(argv=None):
     p.add_argument("--report-every", type=int, default=20)
     p.add_argument("--flash", action="store_true",
                    help="use the Pallas flash-attention kernel (TPU)")
+    p.add_argument("--vocab-parallel", action="store_true",
+                   help="shard the embedding + tied head over the TP "
+                        "axis (train with vp_lm_loss; sampling gathers "
+                        "only the frontier logits row per token); "
+                        "requires --tp > 1")
     p.add_argument("--generate", type=int, default=32,
                    help="tokens to sample after training (0 disables)")
     p.add_argument("--temperature", type=float, default=0.0)
@@ -96,6 +101,7 @@ def main(argv=None):
         generate,
         lm_loss,
         sp_lm_loss,
+        vp_lm_loss,
     )
     from chainermn_tpu.parallel import megatron_param_specs, sharded_init
 
@@ -120,11 +126,14 @@ def main(argv=None):
             max_len=args.seq_len, dropout_rate=args.dropout,
             deterministic=deterministic, seq_axis=seq_axis,
             tp_axis=tp_axis, sp_impl=args.sp_impl,
+            vocab_parallel=args.vocab_parallel,
             attention_fn=attention_fn,
         )
 
     seq_axis = "mn_seq" if args.sp > 1 else None
     tp_axis = "mn_model" if args.tp > 1 else None
+    if args.vocab_parallel and tp_axis is None:
+        p.error("--vocab-parallel requires --tp > 1")
     model = make_model(seq_axis, tp_axis)
 
     batch = args.batchsize or 2 * comm.dp_size
@@ -155,13 +164,28 @@ def main(argv=None):
         logits = model.apply(
             p, b, rngs={"dropout": jax.random.PRNGKey(0)}
         )
-        if seq_axis is not None:
+        if args.vocab_parallel:
+            # vocab-sharded logits: softmax statistics assembled with
+            # collectives, the full-vocab row never materializes (the
+            # psums also make the loss mn_model-invariant)
+            main = vp_lm_loss(logits, b, tp_axis, seq_axis=seq_axis)
+        elif seq_axis is not None:
             main = sp_lm_loss(logits, b, seq_axis)
         else:
             main = lm_loss(logits, b)
-        if tp_axis is not None:
-            # replicated over TP; certify to vma-checked autodiff
-            main = jax.lax.pmean(main, tp_axis)
+        # Certify replication to vma-checked autodiff over every mesh
+        # axis the loss wasn't reduced over: unused (size-1) axes still
+        # shard the batch spec, so vma tracks them as varying — the
+        # pmean over a size-1 axis is a free identity.
+        certify = []
+        if seq_axis is None:
+            certify.append(comm.seq_axis_name)
+        if tp_axis is None:
+            certify.append(comm.model_axis_name)
+        elif not args.vocab_parallel:
+            certify.append(tp_axis)
+        for ax in certify:
+            main = jax.lax.pmean(main, ax)
         return main
 
     step = cmn.build_train_step(
@@ -204,7 +228,11 @@ def main(argv=None):
         )
         out = np.asarray(out)
         if chief:
-            tier = "tp-sharded" if tp_axis is not None else "dense"
+            tier = (
+                "vocab-parallel" if args.vocab_parallel
+                else "tp-sharded" if tp_axis is not None
+                else "dense"
+            )
             print(f"sampled ({tier} KV-cache decode): "
                   f"{out[0].tolist()}")
     return last_loss

@@ -199,15 +199,15 @@ def main(argv=None):
               f"(uniform would be {np.log(args.vocab):.3f}; the Markov "
               "corpus floor is log 4 = 1.386)")
 
-    if args.generate > 0 and not args.vocab_parallel:
+    if args.generate > 0:
         # Sample from the SAME sharded parameter tree: sequence
         # parallelism is training-only, so the generation twin drops
-        # seq_axis but KEEPS the tensor/expert sharding — generate()
-        # runs the whole KV-cache loop in one shard_map over the mesh
-        # (head-sharded caches, expert all_to_all per step, routing at
-        # the no-drop capacity bound).  (--vocab-parallel models have
-        # no sampling tier yet: the vocab-sharded head would need a
-        # psum-argmax; materialize a dense head to sample from those.)
+        # seq_axis but KEEPS the tensor/expert (and vocab) sharding —
+        # generate() runs the whole KV-cache loop in one shard_map over
+        # the mesh (head-sharded caches, expert all_to_all per step,
+        # routing at the per-call no-drop capacity bound; with
+        # --vocab-parallel only the frontier logits row is all-gathered
+        # per decoded token).
         from chainermn_tpu.models.transformer import generate
 
         gen_model = MoeTransformerLM(
@@ -216,6 +216,7 @@ def main(argv=None):
             n_experts=args.n_experts, moe_every=2, k=2,
             capacity_factor=1.25, max_len=args.seq_len,
             tp_axis="mn_model", expert_axis="mn_model",
+            vocab_parallel=args.vocab_parallel,
         )
         prompt = jnp.asarray(corpus[:2, :8])
         out = np.asarray(generate(
@@ -223,7 +224,8 @@ def main(argv=None):
             comm=comm, param_specs=specs,
         ))
         if chief:
-            print(f"sampled (tp/ep-sharded MoE KV-cache decode): "
+            tier = "vp+tp/ep" if args.vocab_parallel else "tp/ep"
+            print(f"sampled ({tier}-sharded MoE KV-cache decode): "
                   f"{out[0].tolist()}")
     return last_loss
 

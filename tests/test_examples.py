@@ -90,9 +90,12 @@ class TestExampleScripts:
             "moe_lm/train_moe_lm.py", "--cpu-mesh", "--sp", "2",
             "--tp", "2", "--steps", "6", "--report-every", "3",
             "--seq-len", "32", "--d-model", "32", "--n-layers", "2",
-            "--vocab", "64", "--vocab-parallel", tmp_path=tmp_path,
+            "--vocab", "64", "--vocab-parallel", "--generate", "8",
+            tmp_path=tmp_path,
         )
         assert "final:" in out
+        # the vocab-parallel head samples natively (frontier-row gather)
+        assert "sampled (vp+tp/ep-sharded MoE KV-cache decode)" in out
 
     def test_moe_lm_composed_sampling(self, tmp_path):
         # train sharded (SP x TP x EP), then sample through the
@@ -114,6 +117,18 @@ class TestExampleScripts:
         )
         assert "final:" in out
         assert "sampled (tp-sharded KV-cache decode)" in out
+
+    def test_lm_vocab_parallel_train_and_sample(self, tmp_path):
+        """vp tier end-to-end: vp_lm_loss training + native vp decode
+        (the embedding/tied head stay sharded through sampling)."""
+        out = _run(
+            "lm/train_lm.py", "--cpu-mesh", "--tp", "2",
+            "--vocab-parallel", "--steps", "6", "--report-every", "3",
+            "--seq-len", "32", "--d-model", "32", "--n-layers", "2",
+            "--vocab", "64", "--generate", "8", tmp_path=tmp_path,
+        )
+        assert "final:" in out
+        assert "sampled (vocab-parallel KV-cache decode)" in out
 
     def test_mnist_checkpoint_resume(self, tmp_path):
         args = (

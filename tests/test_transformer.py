@@ -616,10 +616,10 @@ class TestGenerate:
             vocab_size=VOCAB, d_model=D, n_heads=4, n_layers=2,
             max_len=32, dtype=jnp.float32, tp_axis="mn_model",
         )
-        from chainermn_tpu.parallel import megatron_param_specs as mps
-
         nonvp_params = {"params": p}
-        nonvp_specs = mps(nonvp_params, model_axis="mn_model")
+        nonvp_specs = megatron_param_specs(
+            nonvp_params, model_axis="mn_model"
+        )
         want = generate(nonvp, nonvp_params, prompt, 5, use_cache=True,
                         comm=comm, param_specs=nonvp_specs)
         np.testing.assert_array_equal(np.asarray(fast), np.asarray(want))

@@ -217,3 +217,83 @@ class TestFusedCastScale:
         x = jnp.zeros((0,), jnp.float32)
         got = fused_cast_scale(x, 0.5, jnp.bfloat16, interpret=True)
         assert got.shape == (0,) and got.dtype == jnp.bfloat16
+
+
+class TestBlockClamp:
+    def test_dim_clamp_table(self):
+        """VMEM block clamp (pallas_attention._clamp_blocks_for_dim):
+        d <= 128 untouched; every d > 128 shrinks by ceil(d/128) —
+        including the 128 < d < 256 range a floor division would have
+        left unshrunk — with results floored to lane multiples."""
+        from chainermn_tpu.ops.pallas_attention import (
+            _clamp_blocks_for_dim,
+        )
+
+        assert _clamp_blocks_for_dim(1024, 1024, 64) == (1024, 1024)
+        assert _clamp_blocks_for_dim(1024, 1024, 128) == (1024, 1024)
+        assert _clamp_blocks_for_dim(1024, 1024, 192) == (512, 512)
+        assert _clamp_blocks_for_dim(1024, 1024, 256) == (512, 512)
+        assert _clamp_blocks_for_dim(1024, 1024, 512) == (256, 256)
+        # floor: never below 256, and always a lane multiple
+        bq, bk = _clamp_blocks_for_dim(1024, 1024, 384)
+        assert bq >= 256 and bq % 128 == 0
+        assert _clamp_blocks_for_dim(256, 512, 512) == (256, 256)
+
+    def test_flash_matches_oracle_at_d192(self):
+        """The clamp path (d=192: previously unshrunk) must stay
+        numerically exact vs the dense oracle."""
+        import jax
+
+        from chainermn_tpu.ops import multi_head_attention
+        from chainermn_tpu.ops.pallas_attention import flash_attention
+
+        rng = np.random.RandomState(0)
+        q, k, v = (
+            jnp.asarray(rng.randn(1, 256, 2, 192), jnp.float32)
+            for _ in range(3)
+        )
+        out = flash_attention(q, k, v, causal=True)
+        want = multi_head_attention(q, k, v, causal=True)
+        np.testing.assert_allclose(
+            np.asarray(out), np.asarray(want), rtol=2e-5, atol=2e-5
+        )
+
+
+class TestAnalyticAttnFlops:
+    def test_formula(self):
+        """bench.py's analytic flash-attention FLOP term (the part XLA
+        cannot see): fwd = 4*b*h*s^2*dh, training = 3.5x fwd, causal
+        halves — stated in the docstring, pinned here."""
+        import bench
+
+        b, h, s, dh, L = 2, 8, 1024, 128, 4
+        full = bench._flash_attn_tflops(b, h, s, dh, L, causal=False)
+        assert full == pytest.approx(14.0 * b * h * s * s * dh * L / 1e12)
+        causal = bench._flash_attn_tflops(b, h, s, dh, L, causal=True)
+        assert causal == pytest.approx(full / 2)
+
+
+class TestTimeKloop:
+    def test_measures_and_fallback(self):
+        """time_kloop returns a positive per-step time from paired k/2k
+        calls, and falls back to the long run's average (never a
+        negative paired difference) when timings are noise-dominated."""
+        import time as _time
+
+        from chainermn_tpu.utils.benchmarking import time_kloop
+
+        calls = []
+
+        def run_k(n):
+            calls.append(n)
+            _time.sleep(0.001 * n)
+            return np.zeros(1)
+
+        dt, samples = time_kloop(run_k, k=10, repeats=2)
+        assert calls[0] == 2  # warm call
+        assert dt > 0
+        assert len(samples) == 2
+
+        # degenerate timings (instant run_k): fallback stays positive
+        dt2, _ = time_kloop(lambda n: np.zeros(1), k=4, repeats=1)
+        assert dt2 >= 0

@@ -150,6 +150,12 @@ def _kloop_step_time(step, params, opt_state, batch, k, repeats=2):
     import jax.numpy as jnp
     from jax import lax
 
+    if getattr(step, "donate", False):
+        raise ValueError(
+            "_kloop_step_time requires a step built with donate=False: "
+            "the k-loop re-enters with the same buffers, and a donated "
+            "step consumes params/opt_state on the warm call"
+        )
     inner = step.get_jitted(params, opt_state)
 
     @jax.jit
@@ -329,10 +335,19 @@ def config_resnet50_hierarchical():
 
 def config_resnet50_native_input():
     """Config 2 variant: the C++ input pipeline feeds real host batches
-    (crop/flip/normalize off the GIL) instead of a resident device batch
-    — the end-to-end number including input."""
+    (crop/flip off the GIL) instead of a resident device batch — the
+    end-to-end number including input.
+
+    uint8 over the wire (VERDICT r4 #2): the loader ships raw uint8
+    crops — 1/2 of bf16's bytes, and far more compressible on the
+    entropy-sensitive tunnel transport (benchmarks/h2d_bench.py's uint8
+    row states the ceiling) — and mean/std/bf16-cast runs INSIDE the
+    jitted step (device_normalize fuses into the first conv).  Timing
+    is min-of-N (N=3) with the spread reported, because this
+    transport-bound config measured 6x run-to-run swings in round 4."""
     from chainermn_tpu.utils.native_loader import (
         NativeImageLoader,
+        device_normalize,
         native_available,
     )
 
@@ -358,10 +373,11 @@ def config_resnet50_native_input():
         0, 256, size=(n_data, image + 8, image + 8, 3), dtype=np.uint8
     )
     labels = rng.randint(0, 1000, size=(n_data,)).astype(np.int32)
+    mean, std = (123.7, 116.3, 103.5), (58.4, 57.1, 57.4)
     loader = NativeImageLoader(
         images, labels, batch, crop=(image, image), n_threads=8,
-        seed=0, shuffle=True, train=True,
-        mean=(123.7, 116.3, 103.5), std=(58.4, 57.1, 57.4),
+        seed=0, shuffle=True, train=True, mean=mean, std=std,
+        wire="uint8",
     )
 
     model_cls = ResNet18 if SMOKE else ResNet50
@@ -375,7 +391,8 @@ def config_resnet50_native_input():
     opt = cmn.create_multi_node_optimizer(optax.sgd(0.1, momentum=0.9), comm)
 
     def loss_fn(p, b):
-        x, y = b
+        x_u8, y = b
+        x = device_normalize(x_u8, mean, std, dtype=jnp.bfloat16)
         logits, _ = model.apply(
             {"params": p["params"], "batch_stats": p["batch_stats"]},
             x, mutable=["batch_stats"],
@@ -383,8 +400,6 @@ def config_resnet50_native_input():
         return optax.softmax_cross_entropy_with_integer_labels(
             logits, y
         ).mean()
-
-    import ml_dtypes
 
     from chainermn_tpu.iterators import prefetch_to_device
 
@@ -396,9 +411,9 @@ def config_resnet50_native_input():
         while True:
             slot, xv, yv = loader.acquire()
             try:
-                # cast to bf16 on the HOST: the transfer ships half the
-                # bytes, and the copy detaches from the zero-copy slot
-                yield (xv.astype(ml_dtypes.bfloat16), np.array(yv))
+                # plain copies detach from the zero-copy slot; the wire
+                # stays uint8 (half of bf16's bytes, no host-side cast)
+                yield (np.array(xv), np.array(yv))
             finally:
                 loader.release(slot)
 
@@ -410,28 +425,42 @@ def config_resnet50_native_input():
         state["p"], state["o"], m = step(state["p"], state["o"], next(it))
         return m["loss"]
 
+    # min-of-N: first pass carries the burn-in, the rest re-measure the
+    # same resident pipeline; the best pass is the number (transport
+    # noise only ADDS time) and the spread is reported alongside.
+    n_meas = _env("BENCH_NATIVE_REPEATS", 1 if SMOKE else 3)
+    dts = []
     try:
-        dt = _time_steps(run, steps, warmup=1)
+        for i in range(n_meas):
+            dts.append(_time_steps_raw(
+                run, steps, warmup=1, burn_seconds=_BURN_S if i == 0 else 0,
+            ))
     finally:
         it.close()  # retire the generator's held slot before the loader
         loader.close()
+    dt = min(dts)
     return {
         "metric": "resnet50_native_input_images_per_sec_per_chip",
         "value": round(batch / dt / comm.size, 2),
-        "unit": "images/sec/chip (incl. C++ input pipeline, "
-                "double-buffered H2D)",
+        "unit": "images/sec/chip (incl. C++ input pipeline, uint8 wire, "
+                "double-buffered H2D; min of N)",
         "step_time_ms": round(dt * 1e3, 2),
+        "n_measurements": n_meas,
+        "spread_max_over_min": round(max(dts) / min(dts), 2),
+        "all_images_per_sec_per_chip": [
+            round(batch / d / comm.size, 1) for d in dts
+        ],
         "config_fingerprint": _fingerprint(
             arch=model_cls.__name__, b=batch, img=image,
-            loader="native_cpp", prefetch=2,
+            loader="native_cpp", wire="uint8", prefetch=2,
         ),
         "note": (
-            "per-step host->device transfer overlapped with compute via "
-            "prefetch_to_device; on a tunneled/remote device the link "
-            "bandwidth bounds this config and VARIES RUN TO RUN "
-            "(measured 41-371 img/s across captures; see "
-            "docs/performance.md 'Native-input pipeline' for the "
-            "measured link numbers)"
+            "TRANSPORT-BOUND, indicative only: on a tunneled/remote "
+            "device the link bandwidth bounds this config and varies "
+            "run to run (r4 measured 41-371 img/s across captures of "
+            "the bf16-wire variant); uint8 wire halves the bytes and "
+            "min-of-N bounds the noise from above — see "
+            "docs/performance.md 'Native-input pipeline'"
         ),
     }
 
@@ -716,6 +745,20 @@ def config_moe_lm():
 
 
 def config_seq2seq_mp():
+    """Seq2seq model-parallel — re-expressed honestly (VERDICT r4 #4).
+
+    Three measurements, each named for what it is:
+    1. the one-chip WHOLE-STEP-JITTED chain (both stages share the only
+       chip — a dispatch-cost number, so NO MFU field: the placement is
+       degenerate and an MFU would imply a model-parallel efficiency
+       this config cannot measure);
+    2. the chain's native eager per-stage dispatch (the reference's
+       fill-drain ergonomics) — the cost whole-step jit removes;
+    3. the same enc|dec split through the REAL pipeline tier
+       (parallel.build_pipeline_train_step, 2 stages, GPipe) in a CPU
+       virtual-mesh subprocess — a structure/convergence record (twin
+       equality is pinned by tests/test_parallel.py), not a TPU number.
+    """
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -788,23 +831,67 @@ def config_seq2seq_mp():
     k = steps * (2 if SMOKE else 10)
     step_time = _burned_kloop(lambda n: ksteps(params, state, n)[2], k)
     tokens = batch * seqlen * 2  # enc + dec
+
+    # 2. eager per-stage dispatch (the chain's ergonomic tier): each
+    # stage + the optimizer dispatches separately, paying the link RTT
+    # per dispatch — the cost the whole-step jit removes.  Few steps,
+    # no burn: this is an illustration of dispatch overhead (+-20 % is
+    # fine), not a throughput claim.
+    def eager_run():
+        nonlocal params, state
+        loss, grads = vag(params, (src, tgt), tgt)
+        params, state = opt.update(grads, state, params)
+        return loss
+
+    eager_dt = _time_steps_raw(eager_run, 2 if SMOKE else 3, warmup=1)
+
+    # 3. the REAL pipeline: enc|dec through build_pipeline_train_step
+    # on a CPU virtual mesh in a subprocess (it must never touch the
+    # TPU this process holds; the script forces the cpu platform
+    # before any backend query).
+    pipeline_rec = None
+    if not SMOKE:
+        import subprocess
+
+        env = dict(os.environ)
+        env.pop("JAX_PLATFORMS", None)
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        try:
+            r = subprocess.run(
+                [sys.executable,
+                 os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                              "benchmarks", "pipeline_seq2seq.py"),
+                 "--steps", "8", "--batch", str(batch),
+                 "--unit", str(units), "--seqlen", str(seqlen),
+                 "--vocab", str(vocab)],
+                capture_output=True, text=True, timeout=600, env=env,
+            )
+            lines = r.stdout.strip().splitlines()
+            if r.returncode != 0 or not lines:
+                pipeline_rec = {
+                    "error": f"exit {r.returncode}: "
+                             f"{(r.stderr or r.stdout)[-300:]}"
+                }
+            else:
+                pipeline_rec = json.loads(lines[-1])
+        except Exception as e:
+            pipeline_rec = {"error": f"{type(e).__name__}: {e}"}
+
     out = {
         "metric": "seq2seq_mp_tokens_per_sec_per_chip",
         "value": round(tokens / step_time / comm.size, 1),
-        "unit": "tokens/sec/chip (MultiNodeChainList enc|dec split; on "
-                "one chip both stages share it)",
+        "unit": "tokens/sec/chip (enc|dec chain, WHOLE step jitted, "
+                "both stages on the ONE chip - a dispatch-cost "
+                "measurement, not a pipeline)",
         "step_time_ms": round(step_time * 1e3, 2),
+        "eager_per_stage_step_ms": round(eager_dt * 1e3, 1),
+        "eager_vs_jit_dispatch_cost_x": round(eager_dt / step_time, 1),
+        "pipeline_2stage_virtual_mesh": pipeline_rec,
         "n_chips": comm.size,
         "config_fingerprint": _fingerprint(
             arch="seq2seq_gru2", b=batch, s=seqlen, units=units, v=vocab
         ),
     }
-    flops = _flops_of(whole_step, params, state)
-    peak = _peak_flops(comm.devices[0])
-    if flops:
-        out["model_tflops_per_step"] = round(flops / 1e12, 2)
-        if peak:
-            out["mfu"] = round(flops / step_time / (peak * comm.size), 4)
     return out
 
 

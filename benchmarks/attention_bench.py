@@ -29,11 +29,26 @@ import numpy as np
 
 from chainermn_tpu.ops.attention import multi_head_attention
 from chainermn_tpu.ops.pallas_attention import flash_attention
-from chainermn_tpu.utils.benchmarking import time_steps
+from chainermn_tpu.utils.benchmarking import force_completion, time_steps
 
 
 def _time(fn, *args, steps=20):
     return time_steps(lambda: fn(*args), steps, warmup=1)
+
+
+def burn_in(seconds=10.0):
+    """Stabilize the tunneled backend before ANY timing: the first
+    executable timed in a fresh process under/over-measures by 20-50 %
+    (utils/benchmarking.time_steps docstring) — an un-burned sweep's
+    first row measured flash fwd 8.2 ms where the warmed value is ~1 ms."""
+    import time
+
+    x = jnp.ones((2048, 2048), jnp.bfloat16)
+    f = jax.jit(lambda a: (a @ a).sum())
+    force_completion(f(x))
+    t_end = time.perf_counter() + seconds
+    while time.perf_counter() < t_end:
+        force_completion(f(x))
 
 
 def bench_seq(seq, batch, heads, dim, causal, steps):
@@ -42,6 +57,24 @@ def bench_seq(seq, batch, heads, dim, causal, steps):
     q = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
     k = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
     v = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
+
+    # Correctness ON THE REAL CHIP before any timing: the d > 128 block
+    # clamp (VMEM ladder) was unit-tested in interpret mode only
+    # (VERDICT r4 #8); this validates the compiled kernel's numerics at
+    # every geometry the sweep times.  Guarded like the timing variants:
+    # one OOM geometry (the dense oracle materializes the (b,h,s,s)
+    # score tensor) must not abort the remaining rows.
+    try:
+        got = np.asarray(flash_attention(q, k, v, causal=causal),
+                         dtype=np.float32)
+        want = np.asarray(multi_head_attention(q, k, v, causal=causal),
+                          dtype=np.float32)
+        max_err = float(np.max(np.abs(got - want)))
+    except Exception as e:
+        msg = str(e)
+        max_err = ("OOM" if ("memory" in msg or "hbm" in msg.lower()
+                             or "RESOURCE_EXHAUSTED" in msg)
+                   else f"error: {type(e).__name__}")
 
     flash_f = jax.jit(
         lambda q, k, v: flash_attention(q, k, v, causal=causal).sum()
@@ -92,6 +125,7 @@ def bench_seq(seq, batch, heads, dim, causal, steps):
                 "OOM" if ("memory" in msg or "hbm" in msg.lower())
                 else f"error: {type(e).__name__}"
             )
+    res["max_abs_err_vs_xla"] = max_err
     return res
 
 
@@ -101,13 +135,16 @@ def main():
                    default=[1024, 2048, 4096])
     p.add_argument("--batch", type=int, default=4)
     p.add_argument("--heads", type=int, default=8)
-    p.add_argument("--dim", type=int, default=128)
+    p.add_argument("--dims", type=int, nargs="+", default=[128],
+                   help="head dims to sweep; 192/256 exercise the "
+                        "compiled d>128 block-clamp path")
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
     args = p.parse_args()
 
     dev = jax.devices()[0]
+    burn_in()
 
     def fmt(v):
         return round(v, 3) if isinstance(v, float) else v
@@ -118,21 +155,27 @@ def main():
         return None
 
     for seq in args.seqs:
-        r = bench_seq(seq, args.batch, args.heads, args.dim,
-                      args.causal, args.steps)
-        print(json.dumps({
-            "metric": "flash_attention_vs_xla",
-            "device": dev.device_kind,
-            "seq": seq,
-            "batch": args.batch, "heads": args.heads, "dim": args.dim,
-            "causal": args.causal,
-            "fwd_flash_ms": fmt(r["fwd_flash_ms"]),
-            "fwd_xla_ms": fmt(r["fwd_xla_ms"]),
-            "fwd_speedup": ratio(r["fwd_xla_ms"], r["fwd_flash_ms"]),
-            "bwd_flash_ms": fmt(r["bwd_flash_ms"]),
-            "bwd_xla_ms": fmt(r["bwd_xla_ms"]),
-            "bwd_speedup": ratio(r["bwd_xla_ms"], r["bwd_flash_ms"]),
-        }), flush=True)
+        for dim in args.dims:
+            r = bench_seq(seq, args.batch, args.heads, dim,
+                          args.causal, args.steps)
+            print(json.dumps({
+                "metric": "flash_attention_vs_xla",
+                "device": dev.device_kind,
+                "seq": seq,
+                "batch": args.batch, "heads": args.heads, "dim": dim,
+                "causal": args.causal,
+                "max_abs_err_vs_xla": (
+                    round(r["max_abs_err_vs_xla"], 5)
+                    if isinstance(r["max_abs_err_vs_xla"], float)
+                    else r["max_abs_err_vs_xla"]
+                ),
+                "fwd_flash_ms": fmt(r["fwd_flash_ms"]),
+                "fwd_xla_ms": fmt(r["fwd_xla_ms"]),
+                "fwd_speedup": ratio(r["fwd_xla_ms"], r["fwd_flash_ms"]),
+                "bwd_flash_ms": fmt(r["bwd_flash_ms"]),
+                "bwd_xla_ms": fmt(r["bwd_xla_ms"]),
+                "bwd_speedup": ratio(r["bwd_xla_ms"], r["bwd_flash_ms"]),
+            }), flush=True)
 
 
 if __name__ == "__main__":

@@ -1,17 +1,29 @@
 #!/usr/bin/env python
-"""TransformerLM MFU ablation (round 3): where do the 200 ms go?
+"""TransformerLM step attribution at the CURRENT bench config.
 
-The bench config (8L/1024d, seq 2048, batch 8, flash attention, adamw)
-measures MFU 0.335.  Each rung isolates one component's cost with the
-same k-in-one-fori_loop timing as resnet_mfu_loop.py:
+Round 3 built this ladder at the 16-head/dh-64 era; round 4 moved the
+bench to 8 heads (dh=128, the MXU lane width) + 1024x1024 flash blocks
+and reached MFU 0.65 — making the old table stale (VERDICT r4 #3).
+This version anchors every rung at the shipping config and reports
+attention-INCLUSIVE MFU (same accounting as bench.py: analytic flash
+FLOPs added to XLA's count, which can't see inside pallas_call), so
+rows are directly comparable to the bench table.
 
-  full        the bench config
-  batch16     is the MXU under-fed at batch 8?
-  no_head     lm_loss replaced by a mean over hidden states: removes the
-              32k-vocab logits matmul AND the fp32 (b, s, V) logits
-              materialization + softmax CE traffic (2.1 GB at batch 8)
-  no_attn     attention_fn returns q: isolates attention cost
-  sgd         adamw -> sgd: optimizer-state traffic share
+Rungs (all deltas vs `full` = the bench config: b8, heads8/dh128,
+flash 1024x1024, adamw, fused lm_loss):
+
+  no_attn     attention_fn returns q — the attention share
+  no_head     vocab-8 twin — the 32k logits matmul + fp32 (b,s,V)
+              CE traffic share
+  sgd         adamw -> sgd — optimizer-state traffic share
+  ln_bf16     LayerNorm in bf16 instead of fp32 — the LN/residual share
+  chunked     fused chunked linear+CE — logits never materialize
+  b16_remat   batch 16 + remat — is the MXU under-fed at b8?
+  blocks256x512  the r03 flash block geometry — the tuning delta
+  xla_attn    XLA's fused attention instead of the Pallas kernel
+  legacy_heads16 the r03 16-head/dh64 config — cross-round anchor
+
+Usage: python benchmarks/transformer_mfu.py [rung ...]   (TPU)
 """
 
 import json
@@ -27,6 +39,7 @@ import numpy as np
 import optax
 from jax import lax
 
+from bench import _flash_attn_tflops, _peak_flops
 from chainermn_tpu.models.transformer import TransformerLM, lm_loss
 from chainermn_tpu.ops.pallas_attention import flash_attention_fn
 
@@ -34,21 +47,31 @@ K = int(os.environ.get("HUNT_K", "10"))
 VOCAB, D, LAYERS, SEQ = 32768, 1024, 8, 2048
 
 
+def _peak():
+    """Device-kind peak lookup (same as bench.py) so the ladder's MFU
+    rows stay comparable to the bench table on any chip generation.
+    LAZY on purpose: jax.devices() at module scope would make the
+    multi-rung parent claim the single-claim tunneled TPU and deadlock
+    its per-rung subprocesses."""
+    return _peak_flops(jax.devices()[0]) or 197e12
+
+
 def _readback(x):
     return float(np.asarray(x).ravel()[0])
 
 
 def time_variant(name, *, batch=8, loss="lm", attention="flash",
-                 opt="adamw", n_heads=None, remat=False):
+                 opt="adamw", n_heads=None, remat=False,
+                 block_q=None, block_k=None, ln_dtype=jnp.float32):
+    heads = n_heads or D // 128  # dh=128: the shipping config
     attn = {
-        "flash": flash_attention_fn(),
+        "flash": flash_attention_fn(block_q=block_q, block_k=block_k),
         "none": lambda q, k, v, causal, scale: q,
         "xla": None,
     }[attention]
     model = TransformerLM(
-        vocab_size=VOCAB, d_model=D,
-        n_heads=n_heads or D // 64, n_layers=LAYERS,
-        max_len=SEQ, attention_fn=attn,
+        vocab_size=VOCAB, d_model=D, n_heads=heads, n_layers=LAYERS,
+        max_len=SEQ, attention_fn=attn, ln_dtype=ln_dtype,
     )
     toks = jnp.asarray(
         np.random.RandomState(0).randint(0, VOCAB, (batch, SEQ)), jnp.int32
@@ -70,8 +93,8 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         # vocab-8 twin: the transformer blocks are identical, the 32k
         # head matmul and the fp32 (b, s, 32k) logits/CE traffic vanish
         small = TransformerLM(
-            vocab_size=8, d_model=D, n_heads=D // 64, n_layers=LAYERS,
-            max_len=SEQ, attention_fn=attn,
+            vocab_size=8, d_model=D, n_heads=heads, n_layers=LAYERS,
+            max_len=SEQ, attention_fn=attn, ln_dtype=ln_dtype,
         )
         stoks = toks % 8
         params = small.init(jax.random.PRNGKey(0), stoks[:1])
@@ -109,6 +132,10 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         flops = float(an.get("flops", 0.0)) or None
     except Exception:
         pass
+    attn_tf = (
+        _flash_attn_tflops(batch, heads, SEQ, D // heads, LAYERS)
+        if attention == "flash" else 0.0
+    )
 
     p, o, l = ksteps(params, opt_state, 2)
     _readback(l)
@@ -132,44 +159,60 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         "samples": [round(d * 1e3, 2) for d in dts],
     }
     if flops:
-        out["tflops_per_step"] = round(flops / 1e12, 3)
-        out["mfu"] = round(flops / dt / 197e12, 4)
+        total = flops + attn_tf * 1e12
+        out["tflops_per_step"] = round(total / 1e12, 3)
+        peak = _peak()
+        out["mfu"] = round(total / dt / peak, 4)
+        if attn_tf:
+            out["mfu_xla_counted"] = round(flops / dt / peak, 4)
     print(json.dumps(out), flush=True)
+    return out
 
 
 VARIANTS = {
     "full": lambda: time_variant("full"),
-    "batch16": lambda: time_variant("batch16", batch=16),
-    "no_head": lambda: time_variant("no_head", loss="no_head"),
     "no_attn": lambda: time_variant("no_attn", attention="none"),
+    "no_head": lambda: time_variant("no_head", loss="no_head"),
     "sgd": lambda: time_variant("sgd", opt="sgd"),
-    # head-geometry rungs: dh = d_model/n_heads is the flash kernel's
-    # MXU lane dimension; dh=64 leaves half the lanes idle
-    "heads8": lambda: time_variant("heads8", n_heads=8),
-    "heads8_b16_remat": lambda: time_variant(
-        "heads8_b16_remat", n_heads=8, batch=16, remat=True),
-    "heads8_b32_remat": lambda: time_variant(
-        "heads8_b32_remat", n_heads=8, batch=32, remat=True),
-    # chunked fused linear+CE: the (b, s, 32k) fp32 logits never
-    # materialize — the memory wall that made batch 16 OOM
-    "chunked": lambda: time_variant("chunked", n_heads=8,
-                                    loss="chunked"),
-    "chunked_b16": lambda: time_variant("chunked_b16", n_heads=8,
-                                        batch=16, loss="chunked"),
-    "chunked_b16_remat": lambda: time_variant(
-        "chunked_b16_remat", n_heads=8, batch=16, loss="chunked",
-        remat=True),
-    "chunked_b32_remat": lambda: time_variant(
-        "chunked_b32_remat", n_heads=8, batch=32, loss="chunked",
-        remat=True),
-    "heads8_xla": lambda: time_variant("heads8_xla", n_heads=8,
-                                       attention="xla"),
+    "ln_bf16": lambda: time_variant("ln_bf16", ln_dtype=jnp.bfloat16),
+    "chunked": lambda: time_variant("chunked", loss="chunked"),
+    "b16_remat": lambda: time_variant("b16_remat", batch=16, remat=True),
+    "blocks256x512": lambda: time_variant(
+        "blocks256x512", block_q=256, block_k=512),
     "xla_attn": lambda: time_variant("xla_attn", attention="xla"),
+    "legacy_heads16": lambda: time_variant("legacy_heads16", n_heads=16),
 }
 
 
 def main():
-    for name in (sys.argv[1:] or list(VARIANTS)):
+    names = sys.argv[1:] or list(VARIANTS)
+    if len(names) > 1:
+        # One subprocess per rung: compiled executables + params of
+        # earlier rungs otherwise stay live in jax's caches and HBM
+        # fragments — the tail of a full sweep used to die
+        # RESOURCE_EXHAUSTED (observed r5: 4 of 10 rungs lost).
+        import subprocess
+
+        for name in names:
+            try:
+                r = subprocess.run(
+                    [sys.executable, os.path.abspath(__file__), name],
+                    capture_output=True, text=True, timeout=1800,
+                )
+            except subprocess.TimeoutExpired:
+                # one hung rung must not abort the rest of the sweep
+                print(json.dumps({"variant": name,
+                                  "error": "timeout after 1800s"}),
+                      flush=True)
+                continue
+            out = [l for l in r.stdout.splitlines()
+                   if l.startswith("{")]
+            print("\n".join(out) if out else json.dumps(
+                {"variant": name,
+                 "error": f"exit {r.returncode}: {r.stderr[-300:]}"}
+            ), flush=True)
+        return
+    for name in names:
         try:
             VARIANTS[name]()
         except Exception as e:

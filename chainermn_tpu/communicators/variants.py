@@ -435,10 +435,21 @@ class NaiveCommunicator(CommunicatorBase):
         return jnp.asarray(self._check(x).copy())
 
     def gather(self, x, root: int = 0):
-        return self.allgather(x)
+        # Root-materialized, mirroring the XLA tier (gather puts the full
+        # stack on ``devices[root]``): the naive oracle must be able to
+        # catch a root-placement bug there, not blur it into allgather.
+        return jax.device_put(self._check(x).copy(), self.devices[root])
 
     def scatter(self, x, root: int = 0):
-        return jnp.asarray(self._check(x).copy())
+        # Row-per-rank placement, mirroring the XLA tier's `_put`: the
+        # compute is still pure NumPy; only the final placement is
+        # device-aware so the oracle can catch placement bugs.
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        return jax.device_put(
+            jnp.asarray(self._check(x).copy()),
+            NamedSharding(self.mesh, PartitionSpec("mn")),
+        )
 
     def alltoall(self, x):
         x = np.asarray(x)

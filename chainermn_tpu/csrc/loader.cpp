@@ -46,6 +46,7 @@ namespace {
 
 struct Slot {
   std::vector<float> x;
+  std::vector<uint8_t> x8;  // uint8 wire mode (image loader only)
   std::vector<int32_t> y;
   long long ready_batch = -1;  // which ticket's data this slot holds
   long long next_fill = 0;     // the only ticket allowed to fill next —
@@ -212,23 +213,40 @@ struct ImageLoader : RingLoader {
   int n, h, w, c;
   int batch, crop_h, crop_w;
   bool train;
+  bool u8_out = false;  // uint8 wire mode: crop/flip only, normalize
+                        // happens on device (half the bytes of bf16,
+                        // and uint8 image data compresses better on
+                        // entropy-sensitive transports)
   std::vector<float> mean, stddev;
 
   void size_slot(Slot& s) override {
-    s.x.resize(static_cast<size_t>(batch) * crop_h * crop_w * c);
+    size_t px = static_cast<size_t>(batch) * crop_h * crop_w * c;
+    if (u8_out) s.x8.resize(px); else s.x.resize(px);
     s.y.resize(batch);
+  }
+
+  // Shared crop/flip geometry; the augmentation RNG is keyed on
+  // (seed, sample ordinal) so float and uint8 modes produce the SAME
+  // crops and flips for the same seed — the uint8 path normalized on
+  // device is elementwise-equal (mod dtype) to the float path.
+  void sample_geometry(uint64_t sample_ordinal, int* off_h, int* off_w,
+                       bool* flip) {
+    *off_h = (h - crop_h) / 2;
+    *off_w = (w - crop_w) / 2;
+    *flip = false;
+    if (train) {
+      std::mt19937_64 rng(seed ^ (0xc2b2ae3d27d4eb4fULL * (sample_ordinal + 1)));
+      if (h > crop_h) *off_h = static_cast<int>(rng() % (h - crop_h + 1));
+      if (w > crop_w) *off_w = static_cast<int>(rng() % (w - crop_w + 1));
+      *flip = (rng() & 1) != 0;
+    }
   }
 
   void fill_sample(float* dst, uint32_t src_idx, uint64_t sample_ordinal) {
     const uint8_t* img = data + static_cast<size_t>(src_idx) * h * w * c;
-    int off_h = (h - crop_h) / 2, off_w = (w - crop_w) / 2;
-    bool flip = false;
-    if (train) {
-      std::mt19937_64 rng(seed ^ (0xc2b2ae3d27d4eb4fULL * (sample_ordinal + 1)));
-      if (h > crop_h) off_h = static_cast<int>(rng() % (h - crop_h + 1));
-      if (w > crop_w) off_w = static_cast<int>(rng() % (w - crop_w + 1));
-      flip = (rng() & 1) != 0;
-    }
+    int off_h, off_w;
+    bool flip;
+    sample_geometry(sample_ordinal, &off_h, &off_w, &flip);
     for (int i = 0; i < crop_h; ++i) {
       const uint8_t* row = img + ((i + off_h) * w + off_w) * c;
       float* out_row = dst + static_cast<size_t>(i) * crop_w * c;
@@ -242,16 +260,38 @@ struct ImageLoader : RingLoader {
     }
   }
 
+  void fill_sample_u8(uint8_t* dst, uint32_t src_idx,
+                      uint64_t sample_ordinal) {
+    const uint8_t* img = data + static_cast<size_t>(src_idx) * h * w * c;
+    int off_h, off_w;
+    bool flip;
+    sample_geometry(sample_ordinal, &off_h, &off_w, &flip);
+    for (int i = 0; i < crop_h; ++i) {
+      const uint8_t* row = img + ((i + off_h) * w + off_w) * c;
+      uint8_t* out_row = dst + static_cast<size_t>(i) * crop_w * c;
+      if (!flip) {  // contiguous row: one memcpy instead of px loops
+        std::memcpy(out_row, row, static_cast<size_t>(crop_w) * c);
+        continue;
+      }
+      for (int j = 0; j < crop_w; ++j)
+        std::memcpy(out_row + j * c, row + (crop_w - 1 - j) * c, c);
+    }
+  }
+
   void fill_batch(Slot& s, long long ticket) override {
     long long e = ticket / batches_per_epoch;
     long long b_in_epoch = ticket % batches_per_epoch;
     const std::vector<uint32_t>& p = perm_for_epoch(e);
+    size_t px = static_cast<size_t>(crop_h) * crop_w * c;
     for (int i = 0; i < batch; ++i) {
       long long ordinal = b_in_epoch * batch + i;
       uint32_t idx = p[ordinal];
       s.y[i] = labels[idx];
-      fill_sample(s.x.data() + static_cast<size_t>(i) * crop_h * crop_w * c,
-                  idx, static_cast<uint64_t>(e) * n + ordinal);
+      uint64_t so = static_cast<uint64_t>(e) * n + ordinal;
+      if (u8_out)
+        fill_sample_u8(s.x8.data() + static_cast<size_t>(i) * px, idx, so);
+      else
+        fill_sample(s.x.data() + static_cast<size_t>(i) * px, idx, so);
     }
   }
 };
@@ -290,7 +330,8 @@ void* cmn_loader_create(const uint8_t* data, const int32_t* labels, int n,
                         int h, int w, int c, int batch, int crop_h,
                         int crop_w, int n_threads, int ring_size,
                         uint64_t seed, int shuffle, int train,
-                        const float* mean, const float* stddev) {
+                        const float* mean, const float* stddev,
+                        int u8_out) {
   if (!data || !labels || n <= 0 || batch <= 0 || batch > n ||
       crop_h > h || crop_w > w || n_threads <= 0 || ring_size <= 0)
     return nullptr;
@@ -302,6 +343,7 @@ void* cmn_loader_create(const uint8_t* data, const int32_t* labels, int n,
   L->seed = seed;
   L->shuffle = shuffle != 0;
   L->train = train != 0;
+  L->u8_out = u8_out != 0;
   L->mean.assign(mean, mean + c);
   L->stddev.assign(stddev, stddev + c);
   L->batches_per_epoch = n / batch;  // drop-last semantics
@@ -340,6 +382,17 @@ int cmn_loader_acquire(void* handle, float** x, int32_t** y) {
   int slot = L->acquire(&s);
   if (slot < 0) return -1;
   if (x) *x = s->x.empty() ? nullptr : s->x.data();
+  if (y) *y = s->y.data();
+  return slot;
+}
+
+// uint8-wire variant of acquire (image loaders created with u8_out=1).
+int cmn_loader_acquire_u8(void* handle, uint8_t** x, int32_t** y) {
+  RingLoader* L = static_cast<RingLoader*>(handle);
+  Slot* s = nullptr;
+  int slot = L->acquire(&s);
+  if (slot < 0) return -1;
+  if (x) *x = s->x8.empty() ? nullptr : s->x8.data();
   if (y) *y = s->y.data();
   return slot;
 }

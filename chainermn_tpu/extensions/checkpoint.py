@@ -36,13 +36,33 @@ class _MultiNodeCheckpointer:
 
     def __init__(self, name: str, comm, path: str = "checkpoints",
                  trigger=(1, "epoch"), keep: int = 3,
-                 use_orbax: bool = True):
+                 use_orbax: bool = True, use_async: bool = False):
+        """``use_async``: snapshot through ``ocp.AsyncCheckpointer`` —
+        ``save()`` returns once the arrays are copied to host and the
+        serialization/write continues on a background thread, so a
+        snapshot does not stall training (measured:
+        benchmarks/checkpoint_bench.py; docs/performance.md "Checkpoint
+        performance").  Commit stays atomic (tmp dir + rename), so the
+        agreement protocol is unaffected: an in-flight save is simply
+        not visible yet.  Call :meth:`wait_until_finished` (or
+        ``finalize``) before reading the snapshot back or exiting."""
         self._name = name
         self._comm = comm
         self._root = os.path.join(path, name)
+        if comm.process_count > 1 and not use_orbax:
+            # Per-rank local-npz tier: every process writes its OWN
+            # snapshots (the reference's per-rank storage model).  The
+            # root is namespaced by process index so a path that happens
+            # to be on a shared filesystem can never make two ranks race
+            # on the same state.npz; on genuinely rank-local disks the
+            # extra directory level is harmless.
+            self._root = os.path.join(
+                self._root, f"rank_{comm.process_index}"
+            )
         self.trigger = trigger
         self._keep = keep
         self._use_orbax = use_orbax
+        self._use_async = use_async
         self._ckptr = None
         os.makedirs(self._root, exist_ok=True)
 
@@ -51,8 +71,21 @@ class _MultiNodeCheckpointer:
         if self._ckptr is None:
             import orbax.checkpoint as ocp
 
-            self._ckptr = ocp.PyTreeCheckpointer()
+            if self._use_async:
+                self._ckptr = ocp.AsyncCheckpointer(
+                    ocp.PyTreeCheckpointHandler()
+                )
+            else:
+                self._ckptr = ocp.PyTreeCheckpointer()
         return self._ckptr
+
+    def wait_until_finished(self) -> None:
+        """Block until any in-flight async save has committed (no-op for
+        the sync checkpointer or before the first save)."""
+        if self._ckptr is not None and hasattr(
+            self._ckptr, "wait_until_finished"
+        ):
+            self._ckptr.wait_until_finished()
 
     def _step_dir(self, step: int) -> str:
         return os.path.join(self._root, f"step_{step:012d}")
@@ -88,18 +121,37 @@ class _MultiNodeCheckpointer:
         mutations of shared directories are chief-only with barriers.
         """
         target = self._step_dir(step)
+        # Back-to-back saves serialize here (an in-flight async write of
+        # an older step must commit before we mutate the directory
+        # listing); save-vs-TRAINING overlap is unaffected.
+        self.wait_until_finished()
+        if self._multiproc and not self._use_orbax:
+            # The reference's own storage model: each rank snapshots
+            # PROCESS-LOCAL state to LOCAL disk (per-rank npz), and the
+            # agreement protocol reconciles divergent inventories at
+            # resume.  Valid only for fully-addressable leaves — a
+            # cross-process global array cannot materialize here.
+            for leaf in jax.tree_util.tree_leaves(state):
+                if hasattr(leaf, "is_fully_addressable") and \
+                        not leaf.is_fully_addressable:
+                    raise ValueError(
+                        "use_orbax=False under multi-process requires "
+                        "process-local (fully addressable) state; leaf "
+                        f"with sharding {leaf.sharding} spans processes "
+                        "— use the orbax tier for global arrays"
+                    )
+            if os.path.exists(target):
+                shutil.rmtree(target)
+            self._save_np(target, state)
+            self._gc_local()
+            return
         if self._multiproc:
-            if not self._use_orbax:
-                raise ValueError(
-                    "use_orbax=False is single-controller only: the npz "
-                    "fallback cannot materialize non-addressable shards "
-                    "of multi-process global arrays"
-                )
             if self._is_chief and os.path.exists(target):
                 shutil.rmtree(target)
             self._comm.barrier()
             self._orbax().save(os.path.abspath(target), state)
-            self._comm.barrier()
+            if not self._use_async:
+                self._comm.barrier()
         else:
             if os.path.exists(target):
                 shutil.rmtree(target)
@@ -107,6 +159,8 @@ class _MultiNodeCheckpointer:
                 try:
                     self._orbax().save(os.path.abspath(target), state)
                 except Exception:
+                    if self._use_async:
+                        raise  # async failures must not silently degrade
                     # Degraded single-controller path; see _save_np.
                     self._save_np(target, state)
             else:
@@ -120,17 +174,27 @@ class _MultiNodeCheckpointer:
         the *original pytree structure* so ``restore_trainer`` can index
         ``state["params"]`` etc.  Leaves are stored as indexed npz entries
         and the treedef is pickled alongside (treedefs of standard
-        containers and NamedTuples pickle fine).  Single-controller only:
-        leaves are materialized via ``np.asarray``.
+        containers and NamedTuples pickle fine).  Leaves are materialized
+        via ``np.asarray`` (process-local state only).
+
+        Commit is ATOMIC (tmp dir + rename), matching what
+        ``_is_complete`` assumes: a rank killed mid-write leaves only a
+        tmp dir the step scan ignores, so the agreement protocol can
+        never elect a torn snapshot.
         """
-        os.makedirs(target, exist_ok=True)
+        tmp = f"{target}.tmp{os.getpid()}"
+        shutil.rmtree(tmp, ignore_errors=True)
+        os.makedirs(tmp)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         np.savez(
-            os.path.join(target, "state.npz"),
+            os.path.join(tmp, "state.npz"),
             **{f"leaf_{i}": np.asarray(l) for i, l in enumerate(leaves)},
         )
-        with open(os.path.join(target, "treedef.pkl"), "wb") as f:
+        with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
+        # a failed orbax attempt may have left droppings at target
+        shutil.rmtree(target, ignore_errors=True)
+        os.rename(tmp, target)
 
     # -- agreement + resume --------------------------------------------
     def newest_common_step(self) -> Optional[int]:
@@ -146,6 +210,7 @@ class _MultiNodeCheckpointer:
     def resume(self, like: Optional[Dict[str, Any]] = None):
         """Load the newest common snapshot; returns (step, state) or
         (None, None) when no checkpoint exists."""
+        self.wait_until_finished()  # async: the in-flight save counts
         step = self.newest_common_step()
         if step is None:
             return None, None
@@ -195,7 +260,18 @@ class _MultiNodeCheckpointer:
         )
         return step, state
 
+    def _gc_local(self) -> None:
+        """GC for the per-rank local-disk tier: every process owns its
+        own directory, so deletion is local and barrier-free (a barrier
+        here would turn one dead rank into a hang for all)."""
+        steps = self._available_steps()
+        for s in steps[: -self._keep] if self._keep else []:
+            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+
     def _gc(self) -> None:
+        if self._multiproc and not self._use_orbax:
+            self._gc_local()
+            return
         if self._multiproc:
             # shared-FS deletes are chief-only; peers wait so a stale dir
             # never reappears in a subsequent scan
@@ -210,7 +286,10 @@ class _MultiNodeCheckpointer:
             shutil.rmtree(self._step_dir(s), ignore_errors=True)
 
     def finalize(self, trainer=None) -> None:
-        """Parity: the reference's finalize/GC of stale snapshots."""
+        """Parity: the reference's finalize/GC of stale snapshots (plus,
+        async tier: drain the in-flight save so process exit cannot
+        truncate a snapshot)."""
+        self.wait_until_finished()
         self._gc()
 
     # -- trainer-extension protocol ------------------------------------

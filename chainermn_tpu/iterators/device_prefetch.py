@@ -28,7 +28,8 @@ from typing import Callable, Iterator, Optional
 
 
 class _DevicePrefetcher:
-    def __init__(self, it: Iterator, place_fn: Callable, depth: int):
+    def __init__(self, it: Iterator, place_fn: Callable, depth: int,
+                 snapshot_states: bool = True):
         if depth < 1:
             raise ValueError(f"depth must be >= 1, got {depth}")
         self._it = it
@@ -40,8 +41,15 @@ class _DevicePrefetcher:
         # prefetcher must not skip buffered-but-unconsumed batches: the
         # resumable position is where the *oldest unconsumed* batch was
         # fetched, not where the underlying iterator has raced ahead to.
+        # COST CONTRACT: this calls the wrapped iterator's serialize()
+        # once per batch drawn, so it must be O(1) (SerialIterator's is);
+        # pass snapshot_states=False for iterators with an expensive
+        # serialize() — checkpointing through the prefetcher is then
+        # disabled rather than silently wrong (a naive passthrough would
+        # serialize the raced-ahead position and drop buffered batches
+        # at resume).
         self._states: collections.deque = collections.deque()
-        self._can_serialize = hasattr(it, "serialize")
+        self._can_serialize = snapshot_states and hasattr(it, "serialize")
         self._done = False
 
     def _top_up(self) -> None:
@@ -95,8 +103,13 @@ class _DevicePrefetcher:
         it = self.__dict__.get("_it")
         if it is None:  # mid-construction / unpickling
             raise AttributeError(name)
-        if name == "serialize" and self.__dict__.get("_can_serialize"):
-            return self._serialize
+        if name == "serialize":
+            if self.__dict__.get("_can_serialize"):
+                return self._serialize
+            # NEVER fall through to the wrapped iterator's serialize:
+            # with snapshotting disabled it would record the raced-ahead
+            # position and silently drop buffered batches at resume.
+            raise AttributeError(name)
         if name == "restore" and hasattr(it, "restore"):
             return self._restore
         # bookkeeping passthrough (epoch, batches_per_epoch, ...);
@@ -105,7 +118,8 @@ class _DevicePrefetcher:
 
 
 def prefetch_to_device(iterator: Iterator, place_fn: Callable,
-                       depth: int = 2) -> Iterator:
+                       depth: int = 2,
+                       snapshot_states: bool = True) -> Iterator:
     """Wrap ``iterator`` so ``depth`` placed batches are always in
     flight.  ``place_fn`` maps one host batch to device array(s) —
     usually ``step.place_batch`` (which shards over the data mesh) or a
@@ -119,5 +133,14 @@ def prefetch_to_device(iterator: Iterator, place_fn: Callable,
     until ``place_fn`` returns (``place_fn`` hands the bytes to the
     runtime); zero-copy loader views should be copied or cast (e.g. the
     bf16 host cast) before being yielded.
+
+    ``snapshot_states``: when the wrapped iterator has ``serialize()``,
+    it is called once per batch drawn so a checkpoint resumes at the
+    oldest *unconsumed* batch — that call must be O(1) (SerialIterator's
+    is).  Pass ``False`` for third-party iterators whose serialize is
+    O(dataset): per-batch snapshotting stops, and the prefetcher exposes
+    no ``serialize()`` at all (Trainer then records no iterator state)
+    instead of silently recording the raced-ahead position.
     """
-    return _DevicePrefetcher(iter(iterator), place_fn, depth)
+    return _DevicePrefetcher(iter(iterator), place_fn, depth,
+                             snapshot_states=snapshot_states)

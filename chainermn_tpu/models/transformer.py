@@ -282,10 +282,13 @@ class TransformerBlock(nn.Module):
     dropout_rate: float = 0.0
     deterministic: bool = False
     attention_fn: Optional[Callable] = None
+    # fp32 LayerNorm is the numerics-safe default; bf16 exists as a
+    # measured perf knob (benchmarks/transformer_mfu.py `ln_bf16` rung)
+    ln_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, x):
-        ln = lambda: nn.LayerNorm(dtype=jnp.float32)
+        ln = lambda: nn.LayerNorm(dtype=self.ln_dtype)
 
         def drop(h):
             return _stream_dropout(
@@ -376,6 +379,9 @@ class TransformerLM(nn.Module):
     # vocab chunk at a time, so the (b, s, V) logits never materialize.
     return_hidden: bool = False
     attention_fn: Optional[Callable] = None
+    # fp32 LayerNorm is the numerics-safe default; bf16 is a measured
+    # perf knob (benchmarks/transformer_mfu.py `ln_bf16` rung)
+    ln_dtype: Any = jnp.float32
 
     @nn.compact
     def __call__(self, tokens):
@@ -432,8 +438,9 @@ class TransformerLM(nn.Module):
                 dropout_rate=self.dropout_rate,
                 deterministic=self.deterministic,
                 attention_fn=self.attention_fn,
+                ln_dtype=self.ln_dtype,
             )(x)
-        x = nn.LayerNorm(dtype=jnp.float32)(x)
+        x = nn.LayerNorm(dtype=self.ln_dtype)(x)
         if self.return_hidden:
             return x.astype(jnp.float32)
         # Weight-tied head.

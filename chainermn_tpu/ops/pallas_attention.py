@@ -62,21 +62,50 @@ def _effective_q_block(block_q: int, s_q: int, interpret: bool) -> int:
     return bq
 
 
-def _clamp_blocks_for_dim(block_q: int, block_k: int, d: int):
-    """Head-dim-aware block clamp.  The backward kernel holds three
-    (bq, bk) fp32 score tiles plus d-proportional operand/accumulator
-    tiles in scoped VMEM (16 MB hard limit; 1024x2048 at d=128 already
-    exceeds it — measured, benchmarks/longseq_tune.py).  The 1024x1024
-    default was validated at d <= 128; beyond that the d-proportional
-    share grows, so bigger head dims shrink the blocks to keep roughly
-    the same VMEM budget."""
+# Default block geometry (round-4 sweep, benchmarks/longseq_tune.py).
+# Public entry points take block_q/block_k=None so "caller passed
+# nothing" is distinguishable from "caller asked for exactly 1024".
+_DEFAULT_BLOCK = 1024
+_warned_geometries: set = set()
+
+
+def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True):
+    """Head-dim-aware block clamp (``None`` block = the default).  The
+    backward kernel holds three (bq, bk) fp32 score tiles plus
+    d-proportional operand/accumulator tiles in scoped VMEM (16 MB hard
+    limit; 1024x2048 at d=128 already exceeds it — measured,
+    benchmarks/longseq_tune.py).  The 1024x1024 default was validated at
+    d <= 128; beyond that the d-proportional share grows, so bigger head
+    dims shrink the blocks to keep roughly the same VMEM budget.
+
+    Explicitly requested blocks that get shrunk emit a ``UserWarning``
+    (once per geometry, forward pass only — ``warn=False`` in the
+    backward avoids a fwd+bwd double fire) so a tuning sweep at d > 128
+    can see its requested geometry was overridden rather than silently
+    measuring the clamp.  Defaults clamp silently."""
+    explicit = block_q is not None or block_k is not None
+    block_q = _DEFAULT_BLOCK if block_q is None else block_q
+    block_k = _DEFAULT_BLOCK if block_k is None else block_k
     if d > 128:
         shrink = -(-d // 128)  # ceil: 192 -> /2, 256 -> /2, 512 -> /4
 
         def down(b):
             return max(b // shrink // 128 * 128, 256)
 
-        block_q, block_k = down(block_q), down(block_k)
+        new_q, new_k = down(block_q), down(block_k)
+        if warn and explicit and (new_q, new_k) != (block_q, block_k):
+            key = (block_q, block_k, d)
+            if key not in _warned_geometries:
+                _warned_geometries.add(key)
+                import warnings
+
+                warnings.warn(
+                    f"flash_attention: requested blocks "
+                    f"{block_q}x{block_k} clamped to {new_q}x{new_k} "
+                    f"for head dim {d} (VMEM budget extrapolated from "
+                    "dh<=128 sweeps; pass blocks that fit to silence)"
+                )
+        block_q, block_k = new_q, new_k
     return block_q, block_k
 
 
@@ -168,7 +197,7 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     bk = min(block_k, _round_up(s_k, 8))
 
     def to_bh(x, s, blk):
-        # (b, s, h, d) -> (b*h, s_padded_to_blk, d)
+        # (b, s, h, d) -> (b*h, s_padded_to_blk, d) [fwd]
         x = jnp.moveaxis(x, 2, 1).reshape(b * h, s, d)
         pad = _round_up(s, blk) - s
         if pad:
@@ -344,7 +373,8 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     reused unchanged."""
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
-    block_q, block_k = _clamp_blocks_for_dim(block_q, block_k, d)
+    block_q, block_k = _clamp_blocks_for_dim(block_q, block_k, d,
+                                             warn=False)
     bq = _effective_q_block(block_q, s_q, interpret)
     bk = min(block_k, _round_up(s_k, 8))
 
@@ -433,21 +463,22 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 # ----------------------------------------------------------------------
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=1024, block_k=1024, interpret=None):
+                    block_q=None, block_k=None, interpret=None):
     """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
 
     Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
     online softmax).  ``interpret=None`` auto-selects: compiled on TPU,
     interpreter elsewhere.
 
-    Default blocks 1024x1024 (round-4 sweep, benchmarks/longseq_tune.py
-    at dh=128 on v5e: vs the old 256x512 defaults this measured +7.5 %
-    end-to-end at seq 2048 b8 and +24 % at seq 8192 b1; 1024x2048
-    exceeds the 16 MB scoped-vmem limit in the backward).  Blocks are
-    clamped to the (padded) sequence length, so short sequences are
-    unaffected, and shrunk proportionally for head dims > 128
-    (``_clamp_blocks_for_dim``) so the backward stays inside scoped
-    VMEM at geometries the sweep did not cover.
+    Default blocks (``None``) resolve to 1024x1024 (round-4 sweep,
+    benchmarks/longseq_tune.py at dh=128 on v5e: vs the old 256x512
+    defaults this measured +7.5 % end-to-end at seq 2048 b8 and +24 %
+    at seq 8192 b1; 1024x2048 exceeds the 16 MB scoped-vmem limit in
+    the backward).  Blocks are clamped to the (padded) sequence length,
+    so short sequences are unaffected, and shrunk proportionally for
+    head dims > 128 (``_clamp_blocks_for_dim``) so the backward stays
+    inside scoped VMEM at geometries the sweep did not cover —
+    explicitly passed blocks warn when shrunk; defaults clamp silently.
     """
     if not PALLAS_AVAILABLE:
         raise ImportError(
@@ -518,7 +549,7 @@ def _dense_attention_with_lse(q, k, v, causal, scale):
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=1024, block_k=1024, interpret=None):
+                             block_q=None, block_k=None, interpret=None):
     """Flash attention returning ``(out, lse)`` with BOTH outputs
     differentiable — ``lse`` is the per-row log-sum-exp of the scaled
     scores, shaped (b, s_q, h).
@@ -580,7 +611,8 @@ flash_attention_with_lse.defvjp(
 )
 
 
-def flash_attention_fn(block_q: int = 1024, block_k: int = 1024,
+def flash_attention_fn(block_q: Optional[int] = None,
+                       block_k: Optional[int] = None,
                        interpret: Optional[bool] = None):
     """Adapter producing the ``attention_fn`` signature used by
     ``ulysses_attention``: ``(q, k, v, causal, scale)``."""

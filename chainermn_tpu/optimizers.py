@@ -293,6 +293,15 @@ def create_multi_node_optimizer(
     Parity: ``chainermn.create_multi_node_optimizer``.  ``zero_redundancy``
     shards the optimizer state across the communicator (ZeRO-1) — a TPU-era
     capability beyond the reference's feature set.
+
+    ``double_buffering`` (stale-by-one gradients, reference parity):
+    LEAVE IT OFF unless you have measured a win on your topology.  On a
+    single chip and on the virtual mesh the A/B shows no benefit — on
+    chip the compiled psum already overlaps with backward compute, and
+    the virtual-mesh measurement was 16 % SLOWER with it on
+    (docs/performance.md "Double-buffering, measured"); its design
+    target (DCN-crossing topologies where gradient sync rides a slow
+    link) is the one place it can pay.
     """
     if zero_redundancy and double_buffering:
         raise ValueError(
@@ -754,4 +763,8 @@ def build_train_step(
     checked_step.batch_sharding = batch_sharding
     checked_step.replicated_sharding = rep
     checked_step.get_jitted = _get_step
+    # Exposed so timing harnesses that re-enter with the same buffers
+    # (k-steps-in-one-dispatch loops) can refuse a donated step, whose
+    # warm call would consume params/opt_state and corrupt later calls.
+    checked_step.donate = donate
     return checked_step

@@ -131,8 +131,8 @@ def ring_attention(
     causal: bool = False,
     scale: Optional[float] = None,
     use_flash: Optional[bool] = None,
-    block_q: int = 1024,
-    block_k: int = 1024,
+    block_q: Optional[int] = None,
+    block_k: Optional[int] = None,
     interpret: Optional[bool] = None,
 ) -> jnp.ndarray:
     """Exact attention over a sequence sharded along ``axis_name``.

@@ -110,6 +110,13 @@ def _load_library() -> ctypes.CDLL:
             ctypes.c_int, ctypes.c_int,
             ctypes.c_uint64, ctypes.c_int, ctypes.c_int,
             ctypes.POINTER(ctypes.c_float), ctypes.POINTER(ctypes.c_float),
+            ctypes.c_int,
+        ]
+        lib.cmn_loader_acquire_u8.restype = ctypes.c_int
+        lib.cmn_loader_acquire_u8.argtypes = [
+            ctypes.c_void_p,
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_uint8)),
+            ctypes.POINTER(ctypes.POINTER(ctypes.c_int32)),
         ]
         lib.cmn_token_loader_create.restype = ctypes.c_void_p
         lib.cmn_token_loader_create.argtypes = [
@@ -143,6 +150,24 @@ def native_available() -> bool:
         return False
 
 
+def device_normalize(x, mean, std, dtype=None):
+    """``(x - mean) / std`` for a uint8 wire batch, ON DEVICE.
+
+    Call inside the jitted train step with a ``wire="uint8"`` loader's
+    ``mean`` / ``std``: the subtraction/scale runs in fp32 (matching the
+    float32 wire path's host-side numerics) and fuses into the first
+    conv's input, so it is free next to the transfer bytes it saves.
+    ``dtype`` casts the result (``jnp.bfloat16`` for the standard
+    TPU input design).
+    """
+    import jax.numpy as jnp
+
+    mean = jnp.asarray(np.asarray(mean), jnp.float32)
+    inv_std = 1.0 / jnp.asarray(np.asarray(std), jnp.float32)
+    out = (x.astype(jnp.float32) - mean) * inv_std
+    return out.astype(dtype) if dtype is not None else out
+
+
 def _check_no_held(held: set, op: str) -> None:
     # the native seek quiesces and restarts workers, clearing in_use:
     # a still-held zero-copy view would be silently overwritten
@@ -157,11 +182,22 @@ def _check_no_held(held: set, op: str) -> None:
 class NativeImageLoader:
     """Threaded native batch loader over an in-memory uint8 image array.
 
-    Yields ``(x, y)``: x float32 (batch, crop_h, crop_w, c) normalized as
-    ``(pixel - mean) / std``, y int32 (batch,).  Batch order, shuffling and
-    augmentation are deterministic in ``seed`` for any ``n_threads``.
-    Drop-last epoch semantics (matches SerialIterator's guarantee that
-    batch sizes stay mesh-divisible).
+    Yields ``(x, y)``: y int32 (batch,) and x (batch, crop_h, crop_w, c)
+    in one of two wire formats:
+
+    * ``wire="float32"`` (default) — normalized ``(pixel - mean) / std``
+      float32, ready to cast and feed.
+    * ``wire="uint8"`` — raw cropped/flipped uint8; normalize ON DEVICE
+      inside the jitted step (:func:`device_normalize`).  A quarter of
+      float32's bytes over the host->device link — and uint8 image data
+      compresses far better on entropy-sensitive transports (measured:
+      benchmarks/h2d_bench.py) — which is the standard TPU input design.
+      Augmentation is keyed on (seed, sample ordinal), so both wire
+      modes produce identical crops/flips for the same seed.
+
+    Batch order, shuffling and augmentation are deterministic in
+    ``seed`` for any ``n_threads``.  Drop-last epoch semantics (matches
+    SerialIterator's guarantee that batch sizes stay mesh-divisible).
     """
 
     def __init__(self, images: np.ndarray, labels: np.ndarray,
@@ -170,8 +206,11 @@ class NativeImageLoader:
                  n_threads: int = 4, ring: int = 8, seed: int = 0,
                  shuffle: bool = True, train: bool = True,
                  mean: Sequence[float] = (0.0,),
-                 std: Sequence[float] = (255.0,)):
+                 std: Sequence[float] = (255.0,),
+                 wire: str = "float32"):
         lib = _load_library()
+        if wire not in ("float32", "uint8"):
+            raise ValueError(f"wire must be 'float32' or 'uint8', got {wire!r}")
         images = np.ascontiguousarray(images, dtype=np.uint8)
         labels = np.ascontiguousarray(labels, dtype=np.int32)
         if images.ndim != 4:
@@ -188,6 +227,7 @@ class NativeImageLoader:
         self._images, self._labels = images, labels
         self._mean, self._std = mean, std
         self._lib = lib
+        self._wire_u8 = wire == "uint8"
         self._shape = (batch_size, crop_h, crop_w, c)
         self._create_args = (n, h, w, c, batch_size, crop_h, crop_w,
                              int(n_threads), int(ring), int(seed),
@@ -195,6 +235,20 @@ class NativeImageLoader:
         self._handle = None
         self._held = set()
         self._create()
+
+    @property
+    def mean(self) -> np.ndarray:
+        """Per-channel mean — pass to :func:`device_normalize` in
+        ``wire="uint8"`` mode."""
+        return self._mean
+
+    @property
+    def std(self) -> np.ndarray:
+        return self._std
+
+    @property
+    def wire(self) -> str:
+        return "uint8" if self._wire_u8 else "float32"
 
     def _create(self):
         (n, h, w, c, batch, crop_h, crop_w, n_threads, ring, seed,
@@ -206,6 +260,7 @@ class NativeImageLoader:
             n_threads, ring, seed, shuffle, train,
             self._mean.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
             self._std.ctypes.data_as(ctypes.POINTER(ctypes.c_float)),
+            int(self._wire_u8),
         )
         if not self._handle:
             raise ValueError(
@@ -229,12 +284,20 @@ class NativeImageLoader:
     def acquire(self) -> Tuple[int, np.ndarray, np.ndarray]:
         """Zero-copy: (slot_id, x_view, y_view); views are valid until
         ``release(slot_id)``.  Feed them straight to ``device_put`` (which
-        copies to device memory) and release."""
-        xp = ctypes.POINTER(ctypes.c_float)()
-        yp = ctypes.POINTER(ctypes.c_int32)()
-        slot = self._lib.cmn_loader_acquire(
-            self._handle, ctypes.byref(xp), ctypes.byref(yp)
-        )
+        copies to device memory) and release.  ``x_view`` dtype follows
+        the wire format (float32 or uint8)."""
+        if self._wire_u8:
+            xp = ctypes.POINTER(ctypes.c_uint8)()
+            yp = ctypes.POINTER(ctypes.c_int32)()
+            slot = self._lib.cmn_loader_acquire_u8(
+                self._handle, ctypes.byref(xp), ctypes.byref(yp)
+            )
+        else:
+            xp = ctypes.POINTER(ctypes.c_float)()
+            yp = ctypes.POINTER(ctypes.c_int32)()
+            slot = self._lib.cmn_loader_acquire(
+                self._handle, ctypes.byref(xp), ctypes.byref(yp)
+            )
         if slot < 0:
             raise StopIteration
         self._held.add(slot)

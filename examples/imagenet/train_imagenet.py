@@ -132,6 +132,12 @@ def main(argv=None):
     p.add_argument("--native-loader", action="store_true",
                    help="use the C++ threaded loader (csrc/loader.cpp): "
                         "crop/flip/normalize in worker threads off the GIL")
+    p.add_argument("--native-wire", choices=["float32", "uint8"],
+                   default="uint8",
+                   help="loader wire format: uint8 ships raw crops (1/4 "
+                        "of float32's bytes; the standard TPU input "
+                        "design) and normalizes inside the jitted step; "
+                        "float32 normalizes on the host")
     p.add_argument("--prefetch", type=int, default=2,
                    help="device-side input double-buffering depth: batch "
                         "i+1's host->device transfer is dispatched while "
@@ -186,6 +192,9 @@ def main(argv=None):
             f"{comm.process_count} processes, multiple of "
             f"{local_shards} local chips)"
         )
+    def prep_x(x):  # default input prep; uint8 wire overrides below
+        return x.astype(jnp.bfloat16)
+
     if args.native_loader:
         from chainermn_tpu.utils.native_loader import NativeImageLoader
 
@@ -215,8 +224,15 @@ def main(argv=None):
             xs8, ys, batch_per_process,
             crop=(args.image_size, args.image_size),
             n_threads=4, seed=1, shuffle=True, train=True,
-            mean=mean, std=std,
+            mean=mean, std=std, wire=args.native_wire,
         )
+        if args.native_wire == "uint8":
+            # normalize ON DEVICE inside the jitted step (fuses into the
+            # first conv); the wire ships raw uint8 crops
+            from chainermn_tpu.utils.native_loader import device_normalize
+
+            def prep_x(x):
+                return device_normalize(x, mean, std, dtype=jnp.bfloat16)
     else:
         inner_it = SerialIterator(train, batch_per_process, shuffle=True,
                                   seed=1)
@@ -257,7 +273,7 @@ def main(argv=None):
         x, y, seeds = batch
         out, mut = model.apply(
             {"params": p["params"], "batch_stats": p["batch_stats"]},
-            x.astype(jnp.bfloat16),
+            prep_x(x),
             mutable=["batch_stats"],
             rngs={"dropout": jax.random.PRNGKey(seeds[0])},
         )

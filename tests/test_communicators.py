@@ -82,6 +82,21 @@ class TestCollectives:
         out = np.asarray(comm.gather(x, root=2))
         np.testing.assert_allclose(out, np.asarray(x), rtol=1e-6)
 
+    def test_gather_scatter_placement(self, comm):
+        """Placement contract across ALL tiers, incl. the naive oracle
+        (round-4 weak #7: naive.gather used to blur into allgather, so
+        it could not catch a root-placement bug in the XLA tier):
+        gather materializes the full stack on ``devices[root]`` ONLY;
+        scatter distributes one row per device over the comm's set."""
+        x = _stack(comm, seed=11)
+        g = comm.gather(x, root=2)
+        assert g.devices() == {comm.devices[2]}
+
+        s = comm.scatter(x)
+        assert s.devices() == set(comm.devices)
+        for sh in s.addressable_shards:
+            assert sh.data.shape[0] == 1  # exactly one row per device
+
     def test_alltoall(self, comm):
         x = jnp.arange(comm.size * comm.size * 2, dtype=jnp.float32).reshape(
             comm.size, comm.size, 2

@@ -288,3 +288,34 @@ class TestDevicePrefetch:
         it = prefetch_to_device(base, lambda x: x, depth=4)
         assert list(it) == [1, 2, 3]  # exhaust: buffer empty
         assert it.serialize() == {"pos": 3}
+
+    def test_snapshot_states_false_hides_serialize(self):
+        """snapshot_states=False (for wrapped iterators whose
+        serialize() is not O(1)): per-batch snapshotting stops AND the
+        prefetcher exposes no serialize() at all — a passthrough to the
+        wrapped iterator would checkpoint the raced-ahead position and
+        silently drop the buffered batches at resume (advisor r4)."""
+        from chainermn_tpu.iterators import prefetch_to_device
+
+        calls = []
+
+        class CountingIt:
+            def __init__(self):
+                self.pos = 0
+
+            def __next__(self):
+                self.pos += 1
+                return self.pos
+
+            def __iter__(self):
+                return self
+
+            def serialize(self):
+                calls.append(self.pos)
+                return {"pos": self.pos}
+
+        it = prefetch_to_device(CountingIt(), lambda x: x, depth=2,
+                                snapshot_states=False)
+        assert [next(it), next(it)] == [1, 2]
+        assert calls == []  # serialize never invoked per batch
+        assert not hasattr(it, "serialize")  # and not exposed either

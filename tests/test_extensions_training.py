@@ -190,6 +190,57 @@ class TestCheckpointer:
         assert int(restored["opt_state"][1]["count"]) == 5
         assert int(restored["trainer"]["iteration"]) == 7
 
+    def test_async_save_resume_equality(self, comm, tmp_path):
+        """The async tier (VERDICT r4 #5): save() returns before the
+        write commits; wait_until_finished/resume must still observe a
+        complete, byte-equal snapshot — including SHARDED leaves (a
+        ZeRO-style 1/N layout restored via the template)."""
+        from jax.sharding import NamedSharding, PartitionSpec as P
+
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t_async", comm, path=str(tmp_path), use_async=True
+        )
+        sharded = jax.device_put(
+            jnp.arange(comm.size * 4.0).reshape(comm.size, 4),
+            NamedSharding(comm.mesh, P(comm.axis_names)),
+        )
+        state = {
+            "params": {"w": jnp.arange(4.0), "shard": sharded},
+            "opt_state": (jnp.ones((3,)), {"count": jnp.asarray(5)}),
+        }
+        ckpt.save(3, state)
+        # an in-flight save is not yet visible to the directory scan...
+        ckpt.wait_until_finished()
+        # ...but counts after the drain; resume() drains internally too
+        assert ckpt.newest_common_step() == 3
+        step, restored = ckpt.resume(like=state)
+        assert step == 3
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["w"]), np.arange(4.0)
+        )
+        np.testing.assert_allclose(
+            np.asarray(restored["params"]["shard"]), np.asarray(sharded)
+        )
+        # the sharded leaf must come back SHARDED (template layout),
+        # not host-replicated
+        assert restored["params"]["shard"].sharding.is_equivalent_to(
+            sharded.sharding, sharded.ndim
+        )
+        assert int(restored["opt_state"][1]["count"]) == 5
+
+    def test_async_back_to_back_saves_serialize(self, comm, tmp_path):
+        """Two async saves in a row: the second must wait for the
+        first's commit (directory mutations would otherwise race), and
+        both snapshots must be resumable."""
+        ckpt = cmn.create_multi_node_checkpointer(
+            "t_async2", comm, path=str(tmp_path), use_async=True, keep=3
+        )
+        for s in (1, 2):
+            ckpt.save(s, {"x": jnp.full((2,), float(s))})
+        step, restored = ckpt.resume()
+        assert step == 2
+        np.testing.assert_allclose(np.asarray(restored["x"]), 2.0)
+
     def test_npz_fallback_explicit(self, comm, tmp_path):
         ckpt = cmn.create_multi_node_checkpointer(
             "t5", comm, path=str(tmp_path), use_orbax=False

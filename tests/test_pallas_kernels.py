@@ -224,20 +224,52 @@ class TestBlockClamp:
         """VMEM block clamp (pallas_attention._clamp_blocks_for_dim):
         d <= 128 untouched; every d > 128 shrinks by ceil(d/128) —
         including the 128 < d < 256 range a floor division would have
-        left unshrunk — with results floored to lane multiples."""
+        left unshrunk — with results floored to lane multiples.
+        ``None`` = the 1024 default (the sentinel is what lets the clamp
+        distinguish "caller passed nothing" from "caller asked for
+        exactly 1024")."""
+        import warnings as _w
+
         from chainermn_tpu.ops.pallas_attention import (
             _clamp_blocks_for_dim,
         )
 
-        assert _clamp_blocks_for_dim(1024, 1024, 64) == (1024, 1024)
-        assert _clamp_blocks_for_dim(1024, 1024, 128) == (1024, 1024)
-        assert _clamp_blocks_for_dim(1024, 1024, 192) == (512, 512)
-        assert _clamp_blocks_for_dim(1024, 1024, 256) == (512, 512)
-        assert _clamp_blocks_for_dim(1024, 1024, 512) == (256, 256)
-        # floor: never below 256, and always a lane multiple
-        bq, bk = _clamp_blocks_for_dim(1024, 1024, 384)
-        assert bq >= 256 and bq % 128 == 0
-        assert _clamp_blocks_for_dim(256, 512, 512) == (256, 256)
+        with _w.catch_warnings():
+            _w.simplefilter("error")  # defaults must clamp SILENTLY
+            assert _clamp_blocks_for_dim(None, None, 64) == (1024, 1024)
+            assert _clamp_blocks_for_dim(None, None, 128) == (1024, 1024)
+            assert _clamp_blocks_for_dim(None, None, 192) == (512, 512)
+            assert _clamp_blocks_for_dim(None, None, 256) == (512, 512)
+            assert _clamp_blocks_for_dim(None, None, 512) == (256, 256)
+            # floor: never below 256, and always a lane multiple
+            bq, bk = _clamp_blocks_for_dim(None, None, 384)
+            assert bq >= 256 and bq % 128 == 0
+
+    def test_explicit_blocks_warn_when_clamped(self):
+        """Explicitly requested blocks that get shrunk must WARN
+        (advisor r4: a tuning sweep at d > 128 would otherwise silently
+        measure the clamp, not its requested geometry) — including an
+        explicit 1024x1024, which value-equality default detection
+        would have missed.  warn=False (the backward's path) and
+        unclamped explicit blocks stay silent."""
+        import warnings as _w
+
+        from chainermn_tpu.ops import pallas_attention as pa
+
+        pa._warned_geometries.clear()
+        with pytest.warns(UserWarning, match="clamped"):
+            assert pa._clamp_blocks_for_dim(256, 512, 512) == (256, 256)
+        with pytest.warns(UserWarning, match="clamped"):
+            assert pa._clamp_blocks_for_dim(1024, 1024, 256) == (512, 512)
+        with _w.catch_warnings():
+            _w.simplefilter("error")
+            # once per geometry: a repeat stays silent
+            pa._clamp_blocks_for_dim(256, 512, 512)
+            # the backward pass never warns (fwd already did)
+            pa._clamp_blocks_for_dim(512, 512, 256, warn=False)
+            # explicit blocks that FIT are silent
+            pa._clamp_blocks_for_dim(256, 256, 64)
+        pa._warned_geometries.clear()
 
     def test_flash_matches_oracle_at_d192(self):
         """The clamp path (d=192: previously unshrunk) must stay

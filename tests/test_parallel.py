@@ -447,3 +447,64 @@ class TestPipelineTrainStep:
                 comm, self._stage_fn, self._loss_fn, optax.adam(0.1),
                 n_micro=4,
             )
+
+
+class TestSeq2SeqPipeline:
+    """The enc|dec split through the REAL pipeline tier (VERDICT r4 #4:
+    the bench's seq2seq row must measure an actual 2-stage pipeline).
+    Heterogeneous stages ride the homogeneous GPipe machinery via an
+    axis-index branch + packed fixed-width carry; the oracle is an
+    unpipelined single-program twin with identical params/loss/adam."""
+
+    def _build(self, devices8, **kw):
+        import os
+        import sys
+
+        import chainermn_tpu as cmn
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks",
+        ))
+        from pipeline_seq2seq import build_pipeline_seq2seq
+
+        comm = cmn.create_communicator("flat", devices=devices8[:2])
+        cfg = dict(vocab=64, units=16, seqlen=8, n_layers=2, n_micro=4,
+                   batch=8, lr=1e-2)
+        cfg.update(kw)
+        return build_pipeline_seq2seq(comm, **cfg)
+
+    def test_matches_unpipelined_twin_and_converges(self, devices8):
+        step, params, opt_state, batch, (twin, tp, ts) = self._build(
+            devices8
+        )
+        params, opt_state, batch = step.place(params, opt_state, batch)
+        pipe_losses, twin_losses = [], []
+        for _ in range(6):
+            params, opt_state, m = step(params, opt_state, batch)
+            pipe_losses.append(float(np.asarray(m["loss"])))
+            tp, ts, tl = twin(tp, ts)
+            twin_losses.append(float(np.asarray(tl)))
+        # Exact numerics: gradients flow through the transposed ppermute
+        # back into the encoder; any break shows as trajectory divergence
+        np.testing.assert_allclose(pipe_losses, twin_losses,
+                                   rtol=2e-4, atol=2e-4)
+        assert pipe_losses[-1] < pipe_losses[0], (
+            f"loss did not decrease: {pipe_losses}"
+        )
+
+    def test_bad_microbatch_count_rejected(self, devices8):
+        import os
+        import sys
+
+        sys.path.insert(0, os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            "benchmarks",
+        ))
+        import chainermn_tpu as cmn
+        from pipeline_seq2seq import build_pipeline_seq2seq
+
+        comm = cmn.create_communicator("flat", devices=devices8[:2])
+        with pytest.raises(ValueError, match="divisible"):
+            build_pipeline_seq2seq(comm, vocab=64, units=16, seqlen=8,
+                                   n_micro=3, batch=8)

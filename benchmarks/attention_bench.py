@@ -36,6 +36,16 @@ def _time(fn, *args, steps=20):
     return time_steps(lambda: fn(*args), steps, warmup=1)
 
 
+def _classify(e):
+    """One OOM/error classifier for every guarded measurement in a row
+    (was three slightly-different copies)."""
+    msg = str(e)
+    if "memory" in msg or "hbm" in msg.lower() or \
+            "RESOURCE_EXHAUSTED" in msg:
+        return "OOM"
+    return f"error: {type(e).__name__}"
+
+
 def burn_in(seconds=10.0):
     """Stabilize the tunneled backend before ANY timing: the first
     executable timed in a fresh process under/over-measures by 20-50 %
@@ -71,10 +81,7 @@ def bench_seq(seq, batch, heads, dim, causal, steps):
                           dtype=np.float32)
         max_err = float(np.max(np.abs(got - want)))
     except Exception as e:
-        msg = str(e)
-        max_err = ("OOM" if ("memory" in msg or "hbm" in msg.lower()
-                             or "RESOURCE_EXHAUSTED" in msg)
-                   else f"error: {type(e).__name__}")
+        max_err = _classify(e)
 
     flash_f = jax.jit(
         lambda q, k, v: flash_attention(q, k, v, causal=causal).sum()
@@ -120,11 +127,7 @@ def bench_seq(seq, batch, heads, dim, causal, steps):
         try:
             res[name] = _time(fn, *fargs, steps=steps) * 1e3
         except Exception as e:
-            msg = str(e)
-            res[name] = (
-                "OOM" if ("memory" in msg or "hbm" in msg.lower())
-                else f"error: {type(e).__name__}"
-            )
+            res[name] = _classify(e)
     res["max_abs_err_vs_xla"] = max_err
     return res
 

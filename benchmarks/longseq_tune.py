@@ -48,11 +48,14 @@ def _attn_tflops(batch):
 
 
 def time_variant(name, *, batch=None, loss="lm", attention="flash",
-                 block_q=256, block_k=512, remat=False):
+                 block_q=256, block_k=512, remat=False,
+                 bwd_block_q=None, bwd_block_k=None):
     if batch is None:
         batch = int(os.environ.get("TUNE_BATCH", "1"))
     attn = {
-        "flash": flash_attention_fn(block_q=block_q, block_k=block_k),
+        "flash": flash_attention_fn(block_q=block_q, block_k=block_k,
+                                    bwd_block_q=bwd_block_q,
+                                    bwd_block_k=bwd_block_k),
         "none": lambda q, k, v, causal, scale: q,
     }[attention]
     model = TransformerLM(
@@ -162,6 +165,22 @@ VARIANTS.update({
                                        loss="chunked"),
     "no_attn": lambda: time_variant("no_attn", attention="none"),
     "no_head": lambda: time_variant("no_head", loss="no_head"),
+    # round 5: SPLIT fwd/bwd block geometry — the scoped-VMEM limit
+    # binds only the backward (3 fp32 score tiles vs the forward's 1),
+    # so the forward can stream wider K/V blocks than the backward
+    # survives (1024x2048 OOM'd when shared)
+    "b2_fwd1024x2048_bwd1024x1024": lambda: time_variant(
+        "b2_fwd1024x2048_bwd1024x1024", batch=2, block_q=1024,
+        block_k=2048, bwd_block_q=1024, bwd_block_k=1024),
+    "b2_fwd2048x2048_bwd1024x1024": lambda: time_variant(
+        "b2_fwd2048x2048_bwd1024x1024", batch=2, block_q=2048,
+        block_k=2048, bwd_block_q=1024, bwd_block_k=1024),
+    "b2_fwd1024x4096_bwd1024x1024": lambda: time_variant(
+        "b2_fwd1024x4096_bwd1024x1024", batch=2, block_q=1024,
+        block_k=4096, bwd_block_q=1024, bwd_block_k=1024),
+    "b2_fwd1024x1024_bwd512x1024": lambda: time_variant(
+        "b2_fwd1024x1024_bwd512x1024", batch=2, block_q=1024,
+        block_k=1024, bwd_block_q=512, bwd_block_k=1024),
 })
 
 

@@ -49,11 +49,13 @@ VOCAB, D, LAYERS, SEQ = 32768, 1024, 8, 2048
 
 def _peak():
     """Device-kind peak lookup (same as bench.py) so the ladder's MFU
-    rows stay comparable to the bench table on any chip generation.
-    LAZY on purpose: jax.devices() at module scope would make the
-    multi-rung parent claim the single-claim tunneled TPU and deadlock
-    its per-rung subprocesses."""
-    return _peak_flops(jax.devices()[0]) or 197e12
+    rows stay comparable to the bench table on any chip generation —
+    including OMITTING mfu when the device kind is unknown, exactly as
+    bench.py does (a fabricated v5e fallback would print confidently
+    wrong MFU on new chips).  LAZY on purpose: jax.devices() at module
+    scope would make the multi-rung parent claim the single-claim
+    tunneled TPU and deadlock its per-rung subprocesses."""
+    return _peak_flops(jax.devices()[0])
 
 
 def _readback(x):
@@ -162,9 +164,10 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         total = flops + attn_tf * 1e12
         out["tflops_per_step"] = round(total / 1e12, 3)
         peak = _peak()
-        out["mfu"] = round(total / dt / peak, 4)
-        if attn_tf:
-            out["mfu_xla_counted"] = round(flops / dt / peak, 4)
+        if peak:
+            out["mfu"] = round(total / dt / peak, 4)
+            if attn_tf:
+                out["mfu_xla_counted"] = round(flops / dt / peak, 4)
     print(json.dumps(out), flush=True)
     return out
 
@@ -177,6 +180,12 @@ VARIANTS = {
     "ln_bf16": lambda: time_variant("ln_bf16", ln_dtype=jnp.bfloat16),
     "chunked": lambda: time_variant("chunked", loss="chunked"),
     "b16_remat": lambda: time_variant("b16_remat", batch=16, remat=True),
+    # can the chunked loss (no (b,s,32k) fp32 logits) buy batch 16 at
+    # the current config where the dense loss OOMs even with remat?
+    "chunked_b16": lambda: time_variant("chunked_b16", batch=16,
+                                        loss="chunked"),
+    "chunked_b16_remat": lambda: time_variant(
+        "chunked_b16_remat", batch=16, loss="chunked", remat=True),
     "blocks256x512": lambda: time_variant(
         "blocks256x512", block_q=256, block_k=512),
     "xla_attn": lambda: time_variant("xla_attn", attention="xla"),

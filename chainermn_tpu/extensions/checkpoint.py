@@ -140,8 +140,10 @@ class _MultiNodeCheckpointer:
                         f"with sharding {leaf.sharding} spans processes "
                         "— use the orbax tier for global arrays"
                     )
-            if os.path.exists(target):
-                shutil.rmtree(target)
+            # no pre-delete: _save_np writes to a tmp dir and atomically
+            # renames over target, so the PREVIOUS snapshot stays
+            # electable until the instant of the swap — a crash during
+            # the write must not leave the step with no snapshot at all
             self._save_np(target, state)
             self._gc_local()
             return

@@ -74,20 +74,25 @@ def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True):
     backward kernel holds three (bq, bk) fp32 score tiles plus
     d-proportional operand/accumulator tiles in scoped VMEM (16 MB hard
     limit; 1024x2048 at d=128 already exceeds it — measured,
-    benchmarks/longseq_tune.py).  The 1024x1024 default was validated at
-    d <= 128; beyond that the d-proportional share grows, so bigger head
-    dims shrink the blocks to keep roughly the same VMEM budget.
+    benchmarks/longseq_tune.py).
+
+    Threshold history: rounds 1-4 clamped every d > 128 on an
+    extrapolated VMEM model; the round-5 probe COMPILED AND RAN the
+    full 1024x1024 geometry (fwd and bwd) at d=192 and d=256 on v5e,
+    so the measured feasibility boundary is d <= 256 and the clamp now
+    engages only beyond it (ceil(d/256) shrink — still extrapolated
+    out there, stated honestly).
 
     Explicitly requested blocks that get shrunk emit a ``UserWarning``
     (once per geometry, forward pass only — ``warn=False`` in the
-    backward avoids a fwd+bwd double fire) so a tuning sweep at d > 128
-    can see its requested geometry was overridden rather than silently
-    measuring the clamp.  Defaults clamp silently."""
+    backward avoids a fwd+bwd double fire) so a tuning sweep at large
+    d can see its requested geometry was overridden rather than
+    silently measuring the clamp.  Defaults clamp silently."""
     explicit = block_q is not None or block_k is not None
     block_q = _DEFAULT_BLOCK if block_q is None else block_q
     block_k = _DEFAULT_BLOCK if block_k is None else block_k
-    if d > 128:
-        shrink = -(-d // 128)  # ceil: 192 -> /2, 256 -> /2, 512 -> /4
+    if d > 256:
+        shrink = -(-d // 256)  # ceil: 384 -> /2, 512 -> /2, 1024 -> /4
 
         def down(b):
             return max(b // shrink // 128 * 128, 256)
@@ -103,7 +108,8 @@ def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True):
                     f"flash_attention: requested blocks "
                     f"{block_q}x{block_k} clamped to {new_q}x{new_k} "
                     f"for head dim {d} (VMEM budget extrapolated from "
-                    "dh<=128 sweeps; pass blocks that fit to silence)"
+                    "dh<=256 measurements; pass blocks that fit to "
+                    "silence)"
                 )
         block_q, block_k = new_q, new_k
     return block_q, block_k
@@ -461,9 +467,10 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 # ----------------------------------------------------------------------
 # Public API
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention(q, k, v, causal=False, scale=None,
-                    block_q=None, block_k=None, interpret=None):
+                    block_q=None, block_k=None, interpret=None,
+                    bwd_block_q=None, bwd_block_k=None):
     """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
 
     Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
@@ -479,6 +486,13 @@ def flash_attention(q, k, v, causal=False, scale=None,
     head dims > 128 (``_clamp_blocks_for_dim``) so the backward stays
     inside scoped VMEM at geometries the sweep did not cover —
     explicitly passed blocks warn when shrunk; defaults clamp silently.
+
+    ``bwd_block_q`` / ``bwd_block_k``: SEPARATE backward block
+    geometry (``None`` = inherit the forward's).  The scoped-VMEM
+    limit binds only the backward (it holds three (bq, bk) fp32 score
+    tiles; the forward holds one), so the forward can stream wider K/V
+    blocks than the backward survives — e.g. fwd 1024x2048 with bwd
+    1024x1024 (measured: benchmarks/longseq_tune.py round-5 rows).
     """
     if not PALLAS_AVAILABLE:
         raise ImportError(
@@ -492,7 +506,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
     return out
 
 
-def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
+                    bwd_block_q=None, bwd_block_k=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
@@ -501,7 +516,7 @@ def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret):
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
-                    residuals, g):
+                    bwd_block_q, bwd_block_k, residuals, g):
     q, k, v, out, lse = residuals
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -520,8 +535,17 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
             q, k, v,
         )
         return vjp(g)
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, block_q,
-                           block_k, interp)
+    # the backward inherits the forward geometry unless overridden;
+    # EXPLICIT bwd overrides clamp with the warning here (inside
+    # _flash_backward the clamp is warn=False, tuned for the shared
+    # case where the forward already warned)
+    explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
+    bq = block_q if bwd_block_q is None else bwd_block_q
+    bk = block_k if bwd_block_k is None else bwd_block_k
+    if explicit_bwd:
+        bq, bk = _clamp_blocks_for_dim(bq, bk, q.shape[-1], warn=True)
+    return _flash_backward(q, k, v, out, lse, g, causal, scale, bq,
+                           bk, interp)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -547,9 +571,10 @@ def _dense_attention_with_lse(q, k, v, causal, scale):
     return out.astype(q.dtype), jnp.moveaxis(lse, 1, 2)  # lse (b, s_q, h)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
-                             block_q=None, block_k=None, interpret=None):
+                             block_q=None, block_k=None, interpret=None,
+                             bwd_block_q=None, bwd_block_k=None):
     """Flash attention returning ``(out, lse)`` with BOTH outputs
     differentiable — ``lse`` is the per-row log-sum-exp of the scaled
     scores, shaped (b, s_q, h).
@@ -567,7 +592,8 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
 
 
 def _flash_with_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k,
-                             interpret):
+                             interpret, bwd_block_q=None,
+                             bwd_block_k=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     interp = _should_interpret(interpret)
@@ -585,7 +611,7 @@ def _flash_with_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k,
 
 
 def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
-                             residuals, g):
+                             bwd_block_q, bwd_block_k, residuals, g):
     q, k, v, out, lse_bh = residuals
     g_out, g_lse = g
     if scale is None:
@@ -600,8 +626,13 @@ def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
         return vjp((g_out, g_lse))
     b, s_q, h, _ = q.shape
     g_lse_bh = jnp.moveaxis(g_lse, 1, 2).reshape(b * h, s_q)
+    explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
+    bq = block_q if bwd_block_q is None else bwd_block_q
+    bk = block_k if bwd_block_k is None else bwd_block_k
+    if explicit_bwd:
+        bq, bk = _clamp_blocks_for_dim(bq, bk, q.shape[-1], warn=True)
     return _flash_backward(
-        q, k, v, out, lse_bh, g_out, causal, scale, block_q, block_k,
+        q, k, v, out, lse_bh, g_out, causal, scale, bq, bk,
         _should_interpret(interpret), g_lse=g_lse_bh,
     )
 
@@ -613,13 +644,15 @@ flash_attention_with_lse.defvjp(
 
 def flash_attention_fn(block_q: Optional[int] = None,
                        block_k: Optional[int] = None,
-                       interpret: Optional[bool] = None):
+                       interpret: Optional[bool] = None,
+                       bwd_block_q: Optional[int] = None,
+                       bwd_block_k: Optional[int] = None):
     """Adapter producing the ``attention_fn`` signature used by
     ``ulysses_attention``: ``(q, k, v, causal, scale)``."""
 
     def fn(q, k, v, causal, scale):
         return flash_attention(q, k, v, causal, scale, block_q, block_k,
-                               interpret)
+                               interpret, bwd_block_q, bwd_block_k)
 
     return fn
 

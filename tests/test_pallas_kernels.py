@@ -75,6 +75,34 @@ class TestFlashAttention:
                 np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-4
             )
 
+    def test_split_fwd_bwd_blocks_gradients_exact(self):
+        """Separate backward block geometry (round 5: the scoped-VMEM
+        limit binds only the backward, so the forward can stream wider
+        K/V blocks): value AND gradients with asymmetric fwd/bwd blocks
+        must match the shared-block configuration exactly — the block
+        decomposition is numerically invisible."""
+        q, k, v = _qkv(s=32)
+
+        def f(bq, bk, bwd_bq, bwd_bk):
+            def loss(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, True, None, bq, bk, True,
+                                    bwd_bq, bwd_bk) ** 2
+                )
+
+            return jax.grad(loss, argnums=(0, 1, 2))(q, k, v)
+
+        g_shared = f(8, 8, None, None)
+        g_split = f(8, 32, 8, 8)       # wide fwd K blocks, narrow bwd
+        g_split2 = f(16, 16, 8, 32)    # and the reverse asymmetry
+        for a, b, c in zip(g_shared, g_split, g_split2):
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=1e-5, atol=1e-6
+            )
+            np.testing.assert_allclose(
+                np.asarray(c), np.asarray(a), rtol=1e-5, atol=1e-6
+            )
+
     @pytest.mark.parametrize("bq,bk,s_q,s_k", [
         (16, 24, 20, 20),   # blocks don't divide each other, ragged q
         (24, 16, 24, 17),   # ragged k against larger q block
@@ -222,9 +250,10 @@ class TestFusedCastScale:
 class TestBlockClamp:
     def test_dim_clamp_table(self):
         """VMEM block clamp (pallas_attention._clamp_blocks_for_dim):
-        d <= 128 untouched; every d > 128 shrinks by ceil(d/128) —
-        including the 128 < d < 256 range a floor division would have
-        left unshrunk — with results floored to lane multiples.
+        d <= 256 untouched — the round-5 probe compiled and ran the
+        full 1024x1024 geometry at d=192/256 on the real chip, so the
+        old d>128 clamp was over-conservative; beyond the measured
+        boundary d shrinks by ceil(d/256), floored to lane multiples.
         ``None`` = the 1024 default (the sentinel is what lets the clamp
         distinguish "caller passed nothing" from "caller asked for
         exactly 1024")."""
@@ -238,16 +267,20 @@ class TestBlockClamp:
             _w.simplefilter("error")  # defaults must clamp SILENTLY
             assert _clamp_blocks_for_dim(None, None, 64) == (1024, 1024)
             assert _clamp_blocks_for_dim(None, None, 128) == (1024, 1024)
-            assert _clamp_blocks_for_dim(None, None, 192) == (512, 512)
-            assert _clamp_blocks_for_dim(None, None, 256) == (512, 512)
-            assert _clamp_blocks_for_dim(None, None, 512) == (256, 256)
+            # measured feasible on-chip (round 5): no clamp
+            assert _clamp_blocks_for_dim(None, None, 192) == (1024, 1024)
+            assert _clamp_blocks_for_dim(None, None, 256) == (1024, 1024)
+            # beyond the measured boundary: extrapolated shrink
+            assert _clamp_blocks_for_dim(None, None, 512) == (512, 512)
             # floor: never below 256, and always a lane multiple
             bq, bk = _clamp_blocks_for_dim(None, None, 384)
+            assert bq >= 256 and bq % 128 == 0
+            bq, bk = _clamp_blocks_for_dim(None, None, 1024)
             assert bq >= 256 and bq % 128 == 0
 
     def test_explicit_blocks_warn_when_clamped(self):
         """Explicitly requested blocks that get shrunk must WARN
-        (advisor r4: a tuning sweep at d > 128 would otherwise silently
+        (advisor r4: a tuning sweep at large d would otherwise silently
         measure the clamp, not its requested geometry) — including an
         explicit 1024x1024, which value-equality default detection
         would have missed.  warn=False (the backward's path) and
@@ -258,17 +291,19 @@ class TestBlockClamp:
 
         pa._warned_geometries.clear()
         with pytest.warns(UserWarning, match="clamped"):
-            assert pa._clamp_blocks_for_dim(256, 512, 512) == (256, 256)
+            assert pa._clamp_blocks_for_dim(512, 512, 512) == (256, 256)
         with pytest.warns(UserWarning, match="clamped"):
-            assert pa._clamp_blocks_for_dim(1024, 1024, 256) == (512, 512)
+            assert pa._clamp_blocks_for_dim(1024, 1024, 512) == (512, 512)
         with _w.catch_warnings():
             _w.simplefilter("error")
             # once per geometry: a repeat stays silent
-            pa._clamp_blocks_for_dim(256, 512, 512)
+            pa._clamp_blocks_for_dim(512, 512, 512)
             # the backward pass never warns (fwd already did)
-            pa._clamp_blocks_for_dim(512, 512, 256, warn=False)
-            # explicit blocks that FIT are silent
+            pa._clamp_blocks_for_dim(1024, 512, 512, warn=False)
+            # explicit blocks that FIT are silent (incl. the measured
+            # d=256 boundary, which rounds 1-4 would have clamped)
             pa._clamp_blocks_for_dim(256, 256, 64)
+            pa._clamp_blocks_for_dim(1024, 1024, 256)
         pa._warned_geometries.clear()
 
     def test_flash_matches_oracle_at_d192(self):

@@ -50,15 +50,22 @@ def _md(s) -> str:
 
 def _row(name, entry):
     if "error" in entry:
-        return f"| {name} | {_md(entry.get('metric', name))} | error | — | — | — |"
+        return (f"| {name} | {_md(entry.get('metric', name))} | error "
+                "| — | — | — | — |")
     mfu = entry.get("mfu")
-    return "| {} | {} | {} | {} | {} | {} |".format(
+    # both accountings, always (advisor r4: flash configs' headline MFU
+    # includes the analytic attention term XLA cannot count; tables must
+    # carry the XLA-only figure alongside so cross-round comparisons can
+    # name which accounting they use)
+    mfu_x = entry.get("mfu_xla_counted")
+    return "| {} | {} | {} | {} | {} | {} | {} |".format(
         _md(name),
         _md(entry.get("metric", name)),
         _fmt_value(entry.get("value")),
         _md(entry.get("unit", "")),
         _fmt_value(entry.get("step_time_ms")),
         f"{mfu:.3f}" if isinstance(mfu, (int, float)) else "—",
+        f"{mfu_x:.3f}" if isinstance(mfu_x, (int, float)) else "—",
     )
 
 
@@ -97,15 +104,17 @@ def generate(bench_path: str) -> str:
             _repair_truncated(data)
         )
     if "configs" not in data and "summary" in data:
-        # compact final-line record (round 4+)
+        # compact final-line record (round 4+; "mfu_x" since round 5 so
+        # the both-accountings column survives a summary-only capture)
         data["configs"] = {
             k: {"metric": k, "value": s.get("v"), "unit": s.get("u", ""),
-                "step_time_ms": s.get("ms"), "mfu": s.get("mfu")}
+                "step_time_ms": s.get("ms"), "mfu": s.get("mfu"),
+                "mfu_xla_counted": s.get("mfu_x")}
             for k, s in data["summary"].items()
         }
     lines = [
-        "| config | metric | value | unit | step ms | MFU |",
-        "|---|---|---|---|---|---|",
+        "| config | metric | value | unit | step ms | MFU | MFU (XLA-counted) |",
+        "|---|---|---|---|---|---|---|",
         _row("resnet50 (headline)", data),
     ]
     for name, entry in data.get("configs", {}).items():

@@ -194,9 +194,18 @@ class _MultiNodeCheckpointer:
         )
         with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
-        # a failed orbax attempt may have left droppings at target
-        shutil.rmtree(target, ignore_errors=True)
+        # os.rename cannot replace a non-empty dir, so an existing
+        # target (a re-save, or a failed orbax attempt's droppings) is
+        # renamed ASIDE first — never deleted before the new snapshot
+        # is in place, so a kill at any point leaves either the old or
+        # the new snapshot electable, never neither.
+        old = None
+        if os.path.exists(target):
+            old = f"{target}.old{os.getpid()}"
+            os.rename(target, old)
         os.rename(tmp, target)
+        if old:
+            shutil.rmtree(old, ignore_errors=True)
 
     # -- agreement + resume --------------------------------------------
     def newest_common_step(self) -> Optional[int]:

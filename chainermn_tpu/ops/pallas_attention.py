@@ -482,10 +482,11 @@ def flash_attention(q, k, v, causal=False, scale=None,
     defaults this measured +7.5 % end-to-end at seq 2048 b8 and +24 %
     at seq 8192 b1; 1024x2048 exceeds the 16 MB scoped-vmem limit in
     the backward).  Blocks are clamped to the (padded) sequence length,
-    so short sequences are unaffected, and shrunk proportionally for
-    head dims > 128 (``_clamp_blocks_for_dim``) so the backward stays
-    inside scoped VMEM at geometries the sweep did not cover —
-    explicitly passed blocks warn when shrunk; defaults clamp silently.
+    so short sequences are unaffected, and shrunk for head dims beyond
+    the measured d <= 256 feasibility boundary
+    (``_clamp_blocks_for_dim``) so the backward stays inside scoped
+    VMEM at geometries no sweep has covered — explicitly passed blocks
+    warn when shrunk; defaults clamp silently.
 
     ``bwd_block_q`` / ``bwd_block_k``: SEPARATE backward block
     geometry (``None`` = inherit the forward's).  The scoped-VMEM
@@ -504,6 +505,20 @@ def flash_attention(q, k, v, causal=False, scale=None,
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
                             _should_interpret(interpret))
     return out
+
+
+def _resolve_bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k, d):
+    """Backward block geometry: inherit the forward's unless
+    overridden.  EXPLICIT bwd overrides clamp with the warning here
+    (inside ``_flash_backward`` the clamp is warn=False, tuned for the
+    shared case where the forward already warned).  Shared by both
+    backward rules so the policy cannot diverge between entry points."""
+    explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
+    bq = block_q if bwd_block_q is None else bwd_block_q
+    bk = block_k if bwd_block_k is None else bwd_block_k
+    if explicit_bwd:
+        bq, bk = _clamp_blocks_for_dim(bq, bk, d, warn=True)
+    return bq, bk
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
@@ -535,15 +550,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
             q, k, v,
         )
         return vjp(g)
-    # the backward inherits the forward geometry unless overridden;
-    # EXPLICIT bwd overrides clamp with the warning here (inside
-    # _flash_backward the clamp is warn=False, tuned for the shared
-    # case where the forward already warned)
-    explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
-    bq = block_q if bwd_block_q is None else bwd_block_q
-    bk = block_k if bwd_block_k is None else bwd_block_k
-    if explicit_bwd:
-        bq, bk = _clamp_blocks_for_dim(bq, bk, q.shape[-1], warn=True)
+    bq, bk = _resolve_bwd_blocks(block_q, block_k, bwd_block_q,
+                                 bwd_block_k, q.shape[-1])
     return _flash_backward(q, k, v, out, lse, g, causal, scale, bq,
                            bk, interp)
 
@@ -626,11 +634,8 @@ def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
         return vjp((g_out, g_lse))
     b, s_q, h, _ = q.shape
     g_lse_bh = jnp.moveaxis(g_lse, 1, 2).reshape(b * h, s_q)
-    explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
-    bq = block_q if bwd_block_q is None else bwd_block_q
-    bk = block_k if bwd_block_k is None else bwd_block_k
-    if explicit_bwd:
-        bq, bk = _clamp_blocks_for_dim(bq, bk, q.shape[-1], warn=True)
+    bq, bk = _resolve_bwd_blocks(block_q, block_k, bwd_block_q,
+                                 bwd_block_k, q.shape[-1])
     return _flash_backward(
         q, k, v, out, lse_bh, g_out, causal, scale, bq, bk,
         _should_interpret(interpret), g_lse=g_lse_bh,

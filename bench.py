@@ -618,10 +618,19 @@ def config_transformer_lm():
     seq = 128 if SMOKE else 2048
     batch = _env("BENCH_LM_BATCH", 2 if SMOKE else 8) * comm.size
     heads = _lm_heads(d_model)
+    # Split fwd/bwd flash geometry (round-5 sweep, confirmed twice in
+    # swapped order): fwd 1024x2048 + bwd 1024x1024 measures 120.3/
+    # 120.9 ms vs 123.2/123.4 shared — +2% at seq 2048 (the backward's
+    # scoped-VMEM limit does not bind the forward).  seq 8192 prefers
+    # shared 1024x1024 (its config below keeps it).
+    fbq, fbk, bbq, bbk = 1024, 2048, 1024, 1024
     model = TransformerLM(
         vocab_size=vocab, d_model=d_model, n_heads=heads,
         n_layers=n_layers, max_len=seq,
-        attention_fn=None if SMOKE else flash_attention_fn(),
+        attention_fn=None if SMOKE else flash_attention_fn(
+            block_q=fbq, block_k=fbk,
+            bwd_block_q=bbq, bwd_block_k=bbk,
+        ),
     )
     attn = None if SMOKE else _flash_attn_tflops(
         batch, heads, seq, d_model // heads, n_layers
@@ -642,7 +651,11 @@ def config_transformer_lm():
         "n_heads": model.n_heads,
         "config_fingerprint": _fingerprint(
             arch="dense_lm", b=batch, s=seq, d=d_model, L=n_layers,
-            h=heads, v=vocab, attn="flash" if not SMOKE else "xla",
+            h=heads, v=vocab,
+            # derived from the SAME variables passed to the kernel so a
+            # retune cannot silently desynchronize the recorded geometry
+            attn=(f"flash_f{fbq}x{fbk}_b{bbq}x{bbk}"
+                  if not SMOKE else "xla"),
         ),
         **extra,
     }

@@ -64,10 +64,13 @@ def _readback(x):
 
 def time_variant(name, *, batch=8, loss="lm", attention="flash",
                  opt="adamw", n_heads=None, remat=False,
-                 block_q=None, block_k=None, ln_dtype=jnp.float32):
+                 block_q=None, block_k=None, bwd_block_q=None,
+                 bwd_block_k=None, ln_dtype=jnp.float32):
     heads = n_heads or D // 128  # dh=128: the shipping config
     attn = {
-        "flash": flash_attention_fn(block_q=block_q, block_k=block_k),
+        "flash": flash_attention_fn(block_q=block_q, block_k=block_k,
+                                    bwd_block_q=bwd_block_q,
+                                    bwd_block_k=bwd_block_k),
         "none": lambda q, k, v, causal, scale: q,
         "xla": None,
     }[attention]
@@ -188,6 +191,16 @@ VARIANTS = {
         "chunked_b16_remat", batch=16, loss="chunked", remat=True),
     "blocks256x512": lambda: time_variant(
         "blocks256x512", block_q=256, block_k=512),
+    # causal diagonal-waste geometry at seq 2048: with bq=bk=1024 the
+    # kernel computes 3/4 of the full score grid (2x2 blocks, 3 live);
+    # bq=512 cuts that to 5/8 at finer-grid cost — never swept at 2048
+    "blocks512x512": lambda: time_variant(
+        "blocks512x512", block_q=512, block_k=512),
+    "blocks512x1024": lambda: time_variant(
+        "blocks512x1024", block_q=512, block_k=1024),
+    "blocks1024x2048_fwd_only": lambda: time_variant(
+        "blocks1024x2048_fwd_only", block_q=1024, block_k=2048,
+        bwd_block_q=1024, bwd_block_k=1024),
     "xla_attn": lambda: time_variant("xla_attn", attention="xla"),
     "legacy_heads16": lambda: time_variant("legacy_heads16", n_heads=16),
 }

@@ -184,8 +184,11 @@ class _MultiNodeCheckpointer:
         tmp dir the step scan ignores, so the agreement protocol can
         never elect a torn snapshot.
         """
+        import glob as _glob
+
         tmp = f"{target}.tmp{os.getpid()}"
-        shutil.rmtree(tmp, ignore_errors=True)
+        for stale in _glob.glob(f"{target}.tmp*"):  # crashed past saves
+            shutil.rmtree(stale, ignore_errors=True)
         os.makedirs(tmp)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         np.savez(
@@ -196,16 +199,22 @@ class _MultiNodeCheckpointer:
             pickle.dump(treedef, f)
         # os.rename cannot replace a non-empty dir, so an existing
         # target (a re-save, or a failed orbax attempt's droppings) is
-        # renamed ASIDE first — never deleted before the new snapshot
-        # is in place, so a kill at any point leaves either the old or
-        # the new snapshot electable, never neither.
-        old = None
+        # renamed ASIDE first.  The old snapshot survives until the new
+        # one is fully written; the residual risk is a kill in the
+        # instants BETWEEN the two renames, which loses only this
+        # step's snapshot — the agreement protocol then resumes one
+        # step earlier, which is safe.  Stale .old/.tmp dirs from
+        # crashed saves are invisible to the step scan (the regex
+        # matches step_<digits> exactly) and are swept here on the next
+        # save of the same step, so they cannot accumulate or make the
+        # rename-aside fail with ENOTEMPTY.
+        old = f"{target}.old{os.getpid()}"
+        for stale in _glob.glob(f"{target}.old*"):
+            shutil.rmtree(stale, ignore_errors=True)
         if os.path.exists(target):
-            old = f"{target}.old{os.getpid()}"
             os.rename(target, old)
         os.rename(tmp, target)
-        if old:
-            shutil.rmtree(old, ignore_errors=True)
+        shutil.rmtree(old, ignore_errors=True)
 
     # -- agreement + resume --------------------------------------------
     def newest_common_step(self) -> Optional[int]:

@@ -509,15 +509,19 @@ def flash_attention(q, k, v, causal=False, scale=None,
 
 def _resolve_bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k, d):
     """Backward block geometry: inherit the forward's unless
-    overridden.  EXPLICIT bwd overrides clamp with the warning here
+    overridden.  EXPLICIT bwd overrides get the clamp WARNING here
     (inside ``_flash_backward`` the clamp is warn=False, tuned for the
-    shared case where the forward already warned).  Shared by both
+    shared case where the forward already warned) — but the returned
+    blocks stay UNCLAMPED: ``_flash_backward`` applies the one real
+    clamp, so the geometry that runs is exactly the geometry the
+    warning names (a clamp here too would shrink twice — the clamp is
+    not idempotent: 1024 -> 512 -> 256 at d=384).  Shared by both
     backward rules so the policy cannot diverge between entry points."""
     explicit_bwd = bwd_block_q is not None or bwd_block_k is not None
     bq = block_q if bwd_block_q is None else bwd_block_q
     bk = block_k if bwd_block_k is None else bwd_block_k
     if explicit_bwd:
-        bq, bk = _clamp_blocks_for_dim(bq, bk, d, warn=True)
+        _clamp_blocks_for_dim(bq, bk, d, warn=True)  # warning only
     return bq, bk
 
 

@@ -872,7 +872,12 @@ def config_seq2seq_mp():
 
         env = dict(os.environ)
         env.pop("JAX_PLATFORMS", None)
-        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=2"
+        # append, not clobber: the operator's XLA_FLAGS may be load-
+        # bearing for their XLA install
+        env["XLA_FLAGS"] = (
+            env.get("XLA_FLAGS", "")
+            + " --xla_force_host_platform_device_count=2"
+        ).strip()
         try:
             r = subprocess.run(
                 [sys.executable,

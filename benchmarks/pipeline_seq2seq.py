@@ -74,7 +74,13 @@ def build_pipeline_seq2seq(comm, *, vocab=8192, units=512, seqlen=40,
     enc = Encoder(vocab, units, n_layers)
     dec = Decoder(vocab, units, n_layers)
     S, half = seqlen, n_layers * units
-    D = 2 * half + S  # carry width (state dominates: 2*S <= D always)
+    D = 2 * half + S  # carry width
+    if 2 * S > D:  # the stage-0 injection packs [src | targets] in 2*S
+        raise ValueError(
+            f"carry too narrow: packing src+targets needs 2*seqlen "
+            f"({2 * S}) <= 2*n_layers*units + seqlen ({D}); raise "
+            "units/n_layers or shorten seqlen"
+        )
 
     def run_enc(sp, h):
         b = h.shape[0]

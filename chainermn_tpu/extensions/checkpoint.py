@@ -46,6 +46,12 @@ class _MultiNodeCheckpointer:
         agreement protocol is unaffected: an in-flight save is simply
         not visible yet.  Call :meth:`wait_until_finished` (or
         ``finalize``) before reading the snapshot back or exiting."""
+        if use_async and not use_orbax:
+            raise ValueError(
+                "use_async=True requires the orbax tier: the npz "
+                "backend writes synchronously, which would silently "
+                "break the non-stalling-save contract async promises"
+            )
         self._name = name
         self._comm = comm
         self._root = os.path.join(path, name)
@@ -187,8 +193,10 @@ class _MultiNodeCheckpointer:
         import glob as _glob
 
         tmp = f"{target}.tmp{os.getpid()}"
-        for stale in _glob.glob(f"{target}.tmp*"):  # crashed past saves
-            shutil.rmtree(stale, ignore_errors=True)
+        # glob.escape: a checkpoint path containing [ ? * is legal and
+        # must not silently skip the stale-dir sweep
+        for stale in _glob.glob(f"{_glob.escape(target)}.tmp*"):
+            shutil.rmtree(stale, ignore_errors=True)  # crashed saves
         os.makedirs(tmp)
         leaves, treedef = jax.tree_util.tree_flatten(state)
         np.savez(
@@ -209,7 +217,7 @@ class _MultiNodeCheckpointer:
         # save of the same step, so they cannot accumulate or make the
         # rename-aside fail with ENOTEMPTY.
         old = f"{target}.old{os.getpid()}"
-        for stale in _glob.glob(f"{target}.old*"):
+        for stale in _glob.glob(f"{_glob.escape(target)}.old*"):
             shutil.rmtree(stale, ignore_errors=True)
         if os.path.exists(target):
             os.rename(target, old)

@@ -69,7 +69,8 @@ _DEFAULT_BLOCK = 1024
 _warned_geometries: set = set()
 
 
-def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True):
+def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True,
+                          _context: str = "fwd"):
     """Head-dim-aware block clamp (``None`` block = the default).  The
     backward kernel holds three (bq, bk) fp32 score tiles plus
     d-proportional operand/accumulator tiles in scoped VMEM (16 MB hard
@@ -99,7 +100,10 @@ def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True):
 
         new_q, new_k = down(block_q), down(block_k)
         if warn and explicit and (new_q, new_k) != (block_q, block_k):
-            key = (block_q, block_k, d)
+            # key includes the caller context: a bwd-override warning
+            # must not suppress a later forward warning for the same
+            # geometry (each names a different knob to fix)
+            key = (_context, block_q, block_k, d)
             if key not in _warned_geometries:
                 _warned_geometries.add(key)
                 import warnings
@@ -521,7 +525,8 @@ def _resolve_bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k, d):
     bq = block_q if bwd_block_q is None else bwd_block_q
     bk = block_k if bwd_block_k is None else bwd_block_k
     if explicit_bwd:
-        _clamp_blocks_for_dim(bq, bk, d, warn=True)  # warning only
+        _clamp_blocks_for_dim(bq, bk, d, warn=True,
+                              _context="bwd")  # warning only
     return bq, bk
 
 

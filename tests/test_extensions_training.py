@@ -228,6 +228,15 @@ class TestCheckpointer:
         )
         assert int(restored["opt_state"][1]["count"]) == 5
 
+    def test_async_requires_orbax(self, comm, tmp_path):
+        """use_async with the synchronous npz backend would silently
+        break the non-stalling-save contract — rejected loudly."""
+        with pytest.raises(ValueError, match="use_async"):
+            cmn.create_multi_node_checkpointer(
+                "t_bad", comm, path=str(tmp_path),
+                use_orbax=False, use_async=True,
+            )
+
     def test_async_back_to_back_saves_serialize(self, comm, tmp_path):
         """Two async saves in a row: the second must wait for the
         first's commit (directory mutations would otherwise race), and

@@ -13,6 +13,7 @@ Facade parity: ``chainermn/__init__.py`` re-exports (component #1 in
 SURVEY.md section 2).
 """
 
+from chainermn_tpu import _compat  # noqa: F401  (jax API shims; must be first)
 from chainermn_tpu.communicators import (  # noqa: F401
     CommunicatorBase,
     create_communicator,
@@ -38,7 +39,7 @@ __version__ = "0.2.0"
 def __getattr__(name):
     # Heavier subsystems load lazily to keep import light.
     if name in ("functions", "links", "iterators", "training", "parallel",
-                "models", "ops", "utils"):
+                "models", "ops", "utils", "resilience"):
         import importlib
 
         return importlib.import_module(f"chainermn_tpu.{name}")

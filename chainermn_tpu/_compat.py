@@ -1,0 +1,63 @@
+"""JAX version compatibility shims.
+
+The framework targets the current jax API (``jax.shard_map`` with
+``check_vma``); older runtimes (<= 0.4.x) ship the same machinery as
+``jax.experimental.shard_map.shard_map`` with the ``check_rep`` spelling.
+Importing ``chainermn_tpu`` installs a forwarding shim onto the ``jax``
+module when (and only when) the attribute is missing, so every caller —
+package modules, tests, examples — works unchanged on both.  On a jax
+that already has ``jax.shard_map`` this module is a no-op.
+"""
+
+from __future__ import annotations
+
+import jax
+
+# True on the old-shard_map tier (<= 0.4.x).  Consumers: the hybrid
+# DP x TP step must manually psum replicated-param cotangents there,
+# because check_rep=False (the only mode whose out_specs validation
+# accepts psum-built optimizer states) also disables the replication
+# rewrite that inserts those psums in autodiff.
+OLD_SHARD_MAP = not hasattr(jax, "shard_map")
+
+
+def _install_axis_size_shim() -> None:
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+
+    def axis_size(axis_name):
+        # the classic pre-axis_size idiom: a psum of the literal 1 over
+        # a bound axis constant-folds to the static axis size
+        return lax.psum(1, axis_name)
+
+    lax.axis_size = axis_size
+
+
+def _install_shard_map_shim() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    def shard_map(f, mesh, in_specs, out_specs, check_vma=None, **kw):
+        # check_vma=False maps directly onto check_rep=False.  A caller
+        # that OMITS check_vma wants the current-jax vma machinery (the
+        # hybrid DP x TP step) — old shard_map's check_rep=True cannot
+        # statically infer replication for those out_specs (psum-built
+        # optimizer states), so the closest working translation is
+        # check_rep=False: gradients are still correct (the transpose
+        # psums come from the out_specs, not the rep checker), only the
+        # static replication VALIDATION is lost on this jax tier.
+        kw.setdefault(
+            "check_rep", bool(check_vma) if check_vma is not None else False
+        )
+        return _shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+        )
+
+    jax.shard_map = shard_map
+
+
+_install_shard_map_shim()
+_install_axis_size_shim()

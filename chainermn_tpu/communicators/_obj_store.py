@@ -24,6 +24,9 @@ import jax
 import numpy as np
 
 from .communicator_base import dumps, loads
+from ..resilience import fault_injection as _fi
+from ..resilience.errors import PayloadCorruptionError
+from ..resilience.retry import RetryPolicy, call_with_retry
 
 # Chunk cap mirroring the reference's max message length for pickled sends
 # (mpi_communicator_base.py, ~256 MB).  Applies to the KV-store path.
@@ -31,12 +34,52 @@ MAX_OBJ_CHUNK_BYTES = 256 * 1024 * 1024
 
 
 def _recv_timeout_ms() -> int:
-    """Blocking-recv timeout for the KV-store path.  A peer that died never
-    publishes its key; a bounded wait turns that into an error the global
-    except hook can contain instead of a 10-minute hang."""
+    """TOTAL blocking-recv budget for the KV-store path, split across the
+    retry policy's attempts.  A peer that died never publishes its key; a
+    bounded wait turns that into a ``TransientCommError`` (naming the
+    peer, attempts, and elapsed time) the global except hook can contain
+    instead of a 10-minute hang."""
     import os
 
     return int(os.environ.get("CHAINERMN_TPU_OBJ_TIMEOUT_MS", 600_000))
+
+
+def _obj_policy() -> RetryPolicy:
+    """Retry policy for host-side exchanges (bounded attempts, jitter-free
+    exponential backoff — deterministic for tests)."""
+    import os
+
+    return RetryPolicy(
+        max_attempts=int(
+            os.environ.get("CHAINERMN_TPU_OBJ_MAX_ATTEMPTS", 4)
+        )
+    )
+
+
+def _maybe_fault(site: str, peer=None, payload: Any = None) -> Any:
+    """Injection point with retry: with no injector active this is one
+    ``is None`` check; with one active, injected transient timeouts are
+    absorbed by the (deterministic) retry schedule and the possibly
+    mutated payload (truncation faults) is returned."""
+    if _fi.active() is None:
+        return payload
+    return call_with_retry(
+        lambda: _fi.fire(site, peer=peer, payload=payload),
+        site=site, peer=peer, policy=_obj_policy(),
+    )
+
+
+def _loads_checked(data: bytes, site: str, peer=None) -> Any:
+    """Unpickle with taxonomy: a truncated / torn payload surfaces as a
+    recoverable :class:`PayloadCorruptionError`, not a bare pickle error."""
+    try:
+        return loads(data)
+    except Exception as e:
+        raise PayloadCorruptionError(
+            f"{site}: payload failed to unpickle "
+            f"({type(e).__name__}: {e})",
+            site=site, peer=peer,
+        ) from e
 
 
 def _check_rank(value: int, size: int, name: str) -> None:
@@ -53,7 +96,9 @@ class LocalObjStore:
 
     def send(self, obj: Any, dest: int, tag: int = 0) -> None:
         _check_rank(dest, self._size, "dest")
-        self._mail[(dest, tag)].append(dumps(obj))
+        payload = _maybe_fault("obj_store.send", peer=dest,
+                               payload=dumps(obj))
+        self._mail[(dest, tag)].append(payload)
 
     def recv(self, source: int, tag: int = 0, dest: int = 0) -> Any:
         """Drain the mailbox of rank ``dest``.
@@ -66,13 +111,14 @@ class LocalObjStore:
         """
         del source
         _check_rank(dest, self._size, "dest")
+        _maybe_fault("obj_store.recv", peer=dest)
         box = self._mail[(dest, tag)]
         if not box:
             raise RuntimeError(
                 f"recv_obj: no message pending for rank {dest}/tag {tag} "
                 "(single-controller recv must follow the matching send)"
             )
-        return loads(box.popleft())
+        return _loads_checked(box.popleft(), "obj_store.recv", dest)
 
     def recv_for(self, dest: int, tag: int = 0) -> Any:
         return self.recv(source=-1, tag=tag, dest=dest)
@@ -81,14 +127,21 @@ class LocalObjStore:
         # single controller: every rank's payload is this caller's payload,
         # so any in-range root broadcasts the same object
         _check_rank(root, self._size, "root")
-        return loads(dumps(obj))
+        payload = _maybe_fault("obj_store.exchange", peer=root,
+                               payload=dumps(obj))
+        return _loads_checked(payload, "obj_store.exchange", root)
 
     def gather(self, obj: Any, root: int = 0) -> list:
         _check_rank(root, self._size, "root")
-        return [loads(dumps(obj)) for _ in range(self._size)]
+        payload = _maybe_fault("obj_store.exchange", peer=root,
+                               payload=dumps(obj))
+        return [_loads_checked(payload, "obj_store.exchange", root)
+                for _ in range(self._size)]
 
     def allgather(self, obj: Any) -> list:
-        return [loads(dumps(obj)) for _ in range(self._size)]
+        payload = _maybe_fault("obj_store.exchange", payload=dumps(obj))
+        return [_loads_checked(payload, "obj_store.exchange")
+                for _ in range(self._size)]
 
 
 class MultiprocessObjStore:
@@ -126,18 +179,34 @@ class MultiprocessObjStore:
 
     # -- collectives ---------------------------------------------------
     def _host_allgather_bytes(self, payload: bytes) -> list:
+        """Host-collective byte exchange.
+
+        The retryable part is the injection point, which fires BEFORE
+        the collective: a rank whose injected transient fault precedes
+        the exchange simply joins late on its retry — peers block in the
+        collective until it arrives (tail latency, not deadlock).  The
+        real ``process_allgather`` is deliberately NOT retried: a
+        one-sided transient failure (rank A's receive times out after
+        rank B's call already returned) would make A's retry pair with
+        B's *next* exchange, silently shifting the collective stream by
+        one message.  Addressed KV-store recv (idempotent reads) keeps
+        the full real-failure retry path; a genuinely failed collective
+        propagates as an error for auto-resume to handle.
+        """
         from jax.experimental import multihost_utils
 
+        p = _maybe_fault("obj_store.exchange", payload=payload)
         nproc = jax.process_count()
-        length = np.array([len(payload)], np.int64)
+        length = np.array([len(p)], np.int64)
         lengths = multihost_utils.process_allgather(length).reshape(-1)
         maxlen = int(lengths.max())
         buf = np.zeros((maxlen,), np.uint8)
-        arr = np.frombuffer(payload, np.uint8)
+        arr = np.frombuffer(p, np.uint8)
         buf[: arr.size] = arr
         gathered = multihost_utils.process_allgather(buf)
         return [
-            gathered[p, : int(lengths[p])].tobytes() for p in range(nproc)
+            gathered[q, : int(lengths[q])].tobytes()
+            for q in range(nproc)
         ]
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
@@ -147,10 +216,13 @@ class MultiprocessObjStore:
         payload costs nothing extra)."""
         src = self._root_process(root)
         payloads = self._host_allgather_bytes(dumps(obj))
-        return loads(payloads[src])
+        return _loads_checked(payloads[src], "obj_store.exchange", src)
 
     def allgather(self, obj: Any) -> list:
-        return [loads(p) for p in self._host_allgather_bytes(dumps(obj))]
+        return [
+            _loads_checked(p, "obj_store.exchange", i)
+            for i, p in enumerate(self._host_allgather_bytes(dumps(obj)))
+        ]
 
     def gather(self, obj: Any, root: int = 0) -> list:
         """Process-ordered list of every process's payload.
@@ -175,14 +247,31 @@ class MultiprocessObjStore:
         return client
 
     def send(self, obj: Any, dest: int, tag: int = 0) -> None:
-        payload = dumps(obj)
+        payload = _maybe_fault("obj_store.send", peer=dest,
+                               payload=dumps(obj))
         key = f"cmn_obj/{jax.process_index()}->{dest}/{tag}/{self._seq[(dest, tag)]}"
         self._seq[(dest, tag)] += 1
         client = self._kv()
-        for i in range(0, max(len(payload), 1), MAX_OBJ_CHUNK_BYTES):
-            chunk = payload[i : i + MAX_OBJ_CHUNK_BYTES]
-            client.key_value_set_bytes(f"{key}/{i}", chunk)
-        client.key_value_set_bytes(f"{key}/len", str(len(payload)).encode())
+
+        def kv_set(k, v):
+            # allow_overwrite: a retry after a PARTIALLY successful
+            # publish re-sets keys that already exist; without it the
+            # coordination service raises ALREADY_EXISTS and the retry
+            # layer would convert a recoverable transient failure into a
+            # hard crash.  The payload for a given (key, seq) is
+            # deterministic, so overwriting is value-identical.
+            try:
+                client.key_value_set_bytes(k, v, allow_overwrite=True)
+            except TypeError:  # jaxlib without the kwarg
+                client.key_value_set_bytes(k, v)
+
+        def publish():
+            for i in range(0, max(len(payload), 1), MAX_OBJ_CHUNK_BYTES):
+                kv_set(f"{key}/{i}", payload[i : i + MAX_OBJ_CHUNK_BYTES])
+            kv_set(f"{key}/len", str(len(payload)).encode())
+
+        call_with_retry(publish, site="obj_store.send", peer=dest,
+                        policy=_obj_policy())
 
     def recv(self, source: int, tag: int = 0, dest: int = None) -> Any:
         if dest is not None and dest != jax.process_index():
@@ -193,13 +282,35 @@ class MultiprocessObjStore:
         key = f"cmn_obj/{source}->{jax.process_index()}/{tag}/{self._seq[('r', source, tag)]}"
         self._seq[("r", source, tag)] += 1
         client = self._kv()
-        timeout = _recv_timeout_ms()
-        total = int(client.blocking_key_value_get_bytes(f"{key}/len", timeout))
-        payload = b"".join(
-            client.blocking_key_value_get_bytes(f"{key}/{i}", timeout)
-            for i in range(0, max(total, 1), MAX_OBJ_CHUNK_BYTES)
-        )
-        return loads(payload[:total])
+        policy = _obj_policy()
+        # the env timeout is the TOTAL wait budget across all attempts
+        # AND all chunk gets: every blocking get's timeout is capped by
+        # the remaining budget (a deadline, not a per-get slice), so a
+        # dead peer mid-multi-chunk-payload still errors near the
+        # configured bound instead of budget x chunks later
+        import time as _time
+
+        per_attempt = max(_recv_timeout_ms() // policy.max_attempts, 1)
+        deadline = _time.monotonic() + _recv_timeout_ms() / 1000.0
+
+        def bounded_get(k):
+            remaining = int((deadline - _time.monotonic()) * 1000)
+            return client.blocking_key_value_get_bytes(
+                k, max(min(per_attempt, remaining), 1)
+            )
+
+        def attempt():
+            _fi.fire("obj_store.recv", peer=source)
+            total = int(bounded_get(f"{key}/len"))
+            payload = b"".join(
+                bounded_get(f"{key}/{i}")
+                for i in range(0, max(total, 1), MAX_OBJ_CHUNK_BYTES)
+            )
+            return payload[:total]
+
+        data = call_with_retry(attempt, site="obj_store.recv",
+                               peer=source, policy=policy)
+        return _loads_checked(data, "obj_store.recv", source)
 
 
 def create_obj_store(size: int, process_count: int = 1,

@@ -170,11 +170,26 @@ class CommunicatorBase:
         raise NotImplementedError
 
     def barrier(self) -> None:
-        """Synchronize all processes (no-op within one controller)."""
+        """Synchronize all processes (no-op within one controller).
+
+        Resilience: an injected or transient pre-barrier fault is
+        absorbed by the bounded retry schedule (the late rank simply
+        joins the rendezvous on its retry); exhaustion raises a
+        recoverable ``TransientCommError`` instead of wedging forever.
+        """
+        from chainermn_tpu.resilience.retry import resilient_call
+
         if self.process_count > 1:
             from jax.experimental import multihost_utils
 
-            multihost_utils.sync_global_devices("chainermn_tpu_barrier")
+            resilient_call(
+                "barrier",
+                lambda: multihost_utils.sync_global_devices(
+                    "chainermn_tpu_barrier"
+                ),
+            )
+        else:
+            resilient_call("barrier", lambda: None)
 
     # ------------------------------------------------------------------
     # split (parity: CommunicatorBase.split via mpi_comm.Split)

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .communicator_base import CommunicatorBase
 from ._obj_store import create_obj_store
 from ._topology import Topology
+from ..resilience.retry import resilient_call
 
 _REDUCERS = {
     "sum": lax.psum,
@@ -134,12 +135,20 @@ class XlaCommunicatorBase(CommunicatorBase):
         return fns
 
     # -- collectives ---------------------------------------------------
+    # Every public eager collective is an instrumented resilience site
+    # ("collective.<name>"): with no injector active the wrapper is one
+    # ``is None`` check (the BENCH_* hot path is unchanged); with one
+    # active, injected pre-dispatch faults are deterministic,
+    # call-count-addressed, and absorbed by the retry schedule.
     def allreduce(self, x, op: str = "sum"):
         if op == "prod":
             # XLA has no pprod; exp/sum/log would lose sign — use allgather.
             g = self.allgather(x)
             return self._put(jnp.broadcast_to(jnp.prod(g, axis=0), jnp.shape(x)))
-        return self._allreduce_fns[op](self._put(x))
+        return resilient_call(
+            "collective.allreduce",
+            lambda: self._allreduce_fns[op](self._put(x)),
+        )
 
     @functools.cached_property
     def _bcast_fn(self):
@@ -159,7 +168,10 @@ class XlaCommunicatorBase(CommunicatorBase):
         )
 
     def bcast(self, x, root: int = 0):
-        return self._bcast_fn(self._put(x), jnp.int32(root))
+        return resilient_call(
+            "collective.bcast",
+            lambda: self._bcast_fn(self._put(x), jnp.int32(root)),
+        )
 
     @functools.cached_property
     def _allgather_fn(self):
@@ -174,7 +186,10 @@ class XlaCommunicatorBase(CommunicatorBase):
         return self._shard(f, out_replicated=True)
 
     def allgather(self, x):
-        return self._allgather_fn(self._put(x))
+        return resilient_call(
+            "collective.allgather",
+            lambda: self._allgather_fn(self._put(x)),
+        )
 
     def gather(self, x, root: int = 0):
         g = self.allgather(x)
@@ -182,7 +197,9 @@ class XlaCommunicatorBase(CommunicatorBase):
 
     def scatter(self, x, root: int = 0):
         del root  # stacked representation: scatter = reshard one-per-rank
-        return self._put(jnp.asarray(x))
+        return resilient_call(
+            "collective.scatter", lambda: self._put(jnp.asarray(x))
+        )
 
     @functools.cached_property
     def _alltoall_fn(self):
@@ -226,7 +243,12 @@ class XlaCommunicatorBase(CommunicatorBase):
             raise ValueError(
                 f"alltoall expects (size, size, ...); got {x.shape}"
             )
-        out = self._alltoall_fn(jax.device_put(x, self._stack_sharding))
+        out = resilient_call(
+            "collective.alltoall",
+            lambda: self._alltoall_fn(
+                jax.device_put(x, self._stack_sharding)
+            ),
+        )
         # out[j, i] currently equals in[i, j] with (recv_rank, sender) layout
         # transposed into (sender, recv_rank); swap back to stacked-by-rank.
         return jnp.swapaxes(out, 0, 1)
@@ -256,8 +278,12 @@ class XlaCommunicatorBase(CommunicatorBase):
 
     def send(self, x, dest: int, source: int):
         """out[dest] = x[source]; other slices zero."""
-        return self._ppermute_fn(
-            self._put(x), jnp.int32(source), jnp.int32(dest)
+        return resilient_call(
+            "collective.send",
+            lambda: self._ppermute_fn(
+                self._put(x), jnp.int32(source), jnp.int32(dest)
+            ),
+            peer=dest,
         )
 
     @functools.cached_property
@@ -282,7 +308,10 @@ class XlaCommunicatorBase(CommunicatorBase):
             raise ValueError(
                 f"reduce_scatter expects (size, k*size); got {x.shape}"
             )
-        return self._reduce_scatter_fns[op](self._put(x))
+        return resilient_call(
+            "collective.reduce_scatter",
+            lambda: self._reduce_scatter_fns[op](self._put(x)),
+        )
 
     # -- split ---------------------------------------------------------
     def split(self, colors, keys=None):
@@ -326,8 +355,12 @@ class XlaCommunicatorBase(CommunicatorBase):
     def allreduce_grad(self, grads, *, mean: bool = True):
         if self._allreduce_grad_dtype is None:
             return super().allreduce_grad(grads, mean=mean)
-        return jax.tree_util.tree_map(
-            lambda g: self._allreduce_grad_cast_fn(self._put(g)), grads
+        return resilient_call(
+            "collective.allreduce_grad",
+            lambda: jax.tree_util.tree_map(
+                lambda g: self._allreduce_grad_cast_fn(self._put(g)),
+                grads,
+            ),
         )
 
 

@@ -88,6 +88,13 @@ class Evaluator:
             else trainer.updater.params
         )
         result = self.evaluate(params)
+        # Surface the trainer's resilience counters next to the val
+        # metrics: a validation score is only interpretable alongside
+        # how many steps were skipped / restarted to reach it.
+        rlog = getattr(trainer, "resilience_log", None)
+        if rlog is not None:
+            for kind, n in rlog.counts.items():
+                result[f"resilience/{kind}"] = n
         trainer.observation.update(result)
         return result
 

@@ -37,6 +37,20 @@ def _global_except_hook(exctype, value, tb):
             f"\n*** chainermn_tpu: uncaught exception on process {pid} — "
             "aborting the distributed job ***\n"
         )
+        try:
+            # Resilience taxonomy: an uncaught ResilienceError means the
+            # retry/auto-resume layers gave up (or were not enabled);
+            # print the structured diagnostics (site, peer, attempts,
+            # elapsed) before the raw traceback so a wedged-job postmortem
+            # starts with WHERE and HOW MANY TIMES, not a jax stack.
+            from chainermn_tpu.resilience.errors import ResilienceError
+
+            if isinstance(value, ResilienceError):
+                sys.stderr.write(
+                    f"*** resilience: {value.describe()} ***\n"
+                )
+        except Exception:
+            pass
         traceback.print_exception(exctype, value, tb)
         sys.stderr.flush()
         if os.environ.get("CHAINERMN_TPU_FORCE_ABORT_ON_EXCEPTION"):

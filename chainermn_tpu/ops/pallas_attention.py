@@ -511,6 +511,119 @@ def flash_attention(q, k, v, causal=False, scale=None,
     return out
 
 
+def _is_vmem_oom(e: Exception) -> bool:
+    """Recognize a scoped-VMEM budget failure from the Mosaic compiler
+    (the backward holds three (bq, bk) fp32 score tiles; on hardware
+    generations beyond the measured v5e d<=256 boundary the default
+    geometry can exceed the 16 MB scoped limit at compile time)."""
+    s = str(e).lower()
+    return "vmem" in s and any(
+        m in s for m in ("scoped", "exceed", "limit", "budget")
+    )
+
+
+def _shrink_blocks(bq: int, bk: int):
+    """One retry notch: halve both blocks, floored at the 128 lane tile
+    (blocks already at or below the floor stay put — shrinking cannot
+    GROW a sub-tile block).  Returns None when the geometry cannot
+    shrink further."""
+    def down(b):
+        return min(max(b // 2 // 128 * 128, 128), b)
+
+    nq, nk = down(bq), down(bk)
+    return None if (nq, nk) == (bq, bk) else (nq, nk)
+
+
+_bwd_probe_cache: dict = {}
+
+
+def _bwd_compile_blocked(arrays, causal, scale, bq, bk) -> bool:
+    """AOT-compile probe: does the backward at this geometry compile on
+    the real backend?  Needed because the production path wraps the step
+    in an outer ``jax.jit`` — there the Mosaic compile error would
+    surface during the STEP's compilation, after the vjp rule returned,
+    where no try/except can reach it.  Probing via
+    ``_flash_backward.lower(...).compile()`` with abstract shapes raises
+    the scoped-VMEM failure at trace time instead, where the shrink loop
+    can act.  Cached per (shapes, geometry); any probe *infrastructure*
+    error counts as "not blocked" — the probe must never break a path
+    that would have run."""
+    key = (
+        tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
+        causal, scale, bq, bk,
+    )
+    if key in _bwd_probe_cache:
+        return _bwd_probe_cache[key]
+    blocked = False
+    try:
+        sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
+        _flash_backward.lower(
+            *sds, causal, scale, bq, bk, False
+        ).compile()
+    except Exception as e:
+        blocked = _is_vmem_oom(e)
+    _bwd_probe_cache[key] = blocked
+    return blocked
+
+
+def _backward_with_vmem_retry(q, k, v, out, lse, g, causal, scale,
+                              block_q, block_k, interp, g_lse=None):
+    """Run the backward kernels; on a scoped-VMEM compile failure retry
+    with progressively ceil-shrunk block geometry (ADVICE round-5: the
+    d<=256 clamp boundary was measured on v5e only — other generations
+    may reject the default 1024x1024 backward at compile time).  The
+    measured fast path is untouched: the first attempt is exactly the
+    requested/default geometry, and the shrink loop only runs after a
+    recognized VMEM failure — caught directly on the eager path, or via
+    the AOT compile probe (:func:`_bwd_compile_blocked`) on the
+    compiled-TPU path where the failure would otherwise surface outside
+    this frame.  Same retry-on-failure shape as the resilience layer's
+    transport retries, applied to kernel compilation.
+    """
+    d = q.shape[-1]
+    bq = _DEFAULT_BLOCK if block_q is None else block_q
+    bk = _DEFAULT_BLOCK if block_k is None else block_k
+    probe = not interp and jax.default_backend() == "tpu"
+    tried = set()
+    while True:
+        # the geometry that will actually run (post head-dim clamp) —
+        # dedupe on it so a shrink that clamps to the same program
+        # doesn't loop forever
+        eff = _clamp_blocks_for_dim(bq, bk, d, warn=False)
+        tried.add(eff)
+        try:
+            if probe and _bwd_compile_blocked(
+                (q, k, v, out, lse, g), causal, scale, bq, bk
+            ):
+                raise RuntimeError(
+                    f"scoped vmem limit exceeded at {eff[0]}x{eff[1]} "
+                    "(AOT compile probe)"
+                )
+            return _flash_backward(q, k, v, out, lse, g, causal, scale,
+                                   bq, bk, interp, g_lse=g_lse)
+        except Exception as e:
+            if not _is_vmem_oom(e):
+                raise
+            shrunk = _shrink_blocks(*eff)
+            if shrunk is None or shrunk in tried:
+                raise
+            import warnings
+
+            warnings.warn(
+                f"flash_attention backward: geometry {eff[0]}x{eff[1]} "
+                f"exceeded scoped VMEM on this device; retrying with "
+                f"{shrunk[0]}x{shrunk[1]}"
+            )
+            try:  # observable on any attached resilience log
+                from ..resilience.log import emit
+
+                emit("kernel_retry", "pallas.flash_backward",
+                     from_blocks=eff, to_blocks=shrunk)
+            except Exception:
+                pass
+            bq, bk = shrunk
+
+
 def _resolve_bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k, d):
     """Backward block geometry: inherit the forward's unless
     overridden.  EXPLICIT bwd overrides get the clamp WARNING here
@@ -561,8 +674,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
         return vjp(g)
     bq, bk = _resolve_bwd_blocks(block_q, block_k, bwd_block_q,
                                  bwd_block_k, q.shape[-1])
-    return _flash_backward(q, k, v, out, lse, g, causal, scale, bq,
-                           bk, interp)
+    return _backward_with_vmem_retry(q, k, v, out, lse, g, causal,
+                                     scale, bq, bk, interp)
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -645,7 +758,7 @@ def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
     g_lse_bh = jnp.moveaxis(g_lse, 1, 2).reshape(b * h, s_q)
     bq, bk = _resolve_bwd_blocks(block_q, block_k, bwd_block_q,
                                  bwd_block_k, q.shape[-1])
-    return _flash_backward(
+    return _backward_with_vmem_retry(
         q, k, v, out, lse_bh, g_out, causal, scale, bq, bk,
         _should_interpret(interpret), g_lse=g_lse_bh,
     )

@@ -91,6 +91,21 @@ def _sync_grads(grads, comm, comm_dtype=None, axes=None):
     return jax.tree_util.tree_map(one, grads)
 
 
+def _tree_all_finite(grads):
+    """Scalar bool: every inexact gradient leaf is fully finite."""
+    flags = [
+        jnp.all(jnp.isfinite(g))
+        for g in jax.tree_util.tree_leaves(grads)
+        if jnp.issubdtype(jnp.asarray(g).dtype, jnp.inexact)
+    ]
+    if not flags:
+        return jnp.ones((), jnp.bool_)
+    out = flags[0]
+    for f in flags[1:]:
+        out = jnp.logical_and(out, f)
+    return out
+
+
 class MultiNodeOptimizerState(NamedTuple):
     inner_state: Any
     step: jnp.ndarray
@@ -334,6 +349,7 @@ def build_train_step(
     use_shard_map: bool = True,
     has_aux: bool = False,
     merge_aux=None,
+    nonfinite: Optional[str] = None,
 ):
     """Build a jitted SPMD data-parallel training step.
 
@@ -397,6 +413,23 @@ def build_train_step(
     step; gradient sync still happens once per step.  The per-chip batch
     must divide by it.
 
+    ``nonfinite``: cross-rank non-finite-step guard (``None`` = off, no
+    change to the compiled program).  With a policy set (``"skip"``,
+    ``"abort"``, ``"warn"``), the step computes a single
+    all-gradients-finite flag and — under ``shard_map`` — ``pmin``-s it
+    over EVERY mesh axis, so all ranks agree bit-identically on whether
+    the step was finite.  That agreement is the point: the classic
+    divergence is one rank skipping a NaN step while the others apply
+    it, after which the next collective deadlocks or silently mixes
+    divergent parameter histories.  ``"skip"`` and ``"abort"`` select
+    the PREVIOUS params/opt_state when the flag is down (an agreed
+    no-op step, compiled as two ``where``-selects); ``"warn"`` applies
+    the update anyway.  The flag is returned in the metrics as
+    ``grads_finite`` (1.0/0.0); host-side policy (raising
+    ``StepDivergedError`` for ``"abort"``, warning/logging) lives in
+    ``training.trainer.Trainer``, which reads the step's
+    ``nonfinite_policy`` attribute.
+
     ``remat``: rematerialize the forward pass in the backward
     (``jax.checkpoint`` around ``loss_fn``) — trade FLOPs for HBM.
     ``True`` uses JAX's default policy; pass a
@@ -439,6 +472,11 @@ def build_train_step(
 
     if accum_steps < 1:
         raise ValueError(f"accum_steps must be >= 1, got {accum_steps}")
+    if nonfinite not in (None, "skip", "abort", "warn"):
+        raise ValueError(
+            f"nonfinite must be None, 'skip', 'abort' or 'warn'; "
+            f"got {nonfinite!r}"
+        )
     if remat:
         loss_fn = (
             jax.checkpoint(loss_fn)
@@ -509,6 +547,30 @@ def build_train_step(
     def _param_spec_tree(params):
         return param_specs(params) if callable(param_specs) else param_specs
 
+    all_axes = tuple(comm.axis_names)
+
+    def _guarded_apply(params, opt_state, grads, do_update, *, bound):
+        """Run ``do_update(grads) -> (params', opt_state')`` under the
+        cross-rank non-finite guard.  ``bound``: whether mesh axes are
+        bound (shard_map) — then the finite flag is ``pmin``-ed over
+        every axis so ALL ranks agree to skip or apply, preventing the
+        skip-on-one-rank / apply-on-the-rest deadlock.  Returns
+        ``(params', opt_state', metrics_extra)``."""
+        if nonfinite is None:
+            p, s = do_update(grads)
+            return p, s, {}
+        finite = _tree_all_finite(grads)
+        if bound:
+            finite = lax.pmin(finite.astype(jnp.int32), all_axes) > 0
+        new_p, new_s = do_update(grads)
+        if nonfinite != "warn":
+            def sel(n, o):
+                return jnp.where(finite, n, o)
+
+            new_p = jax.tree_util.tree_map(sel, new_p, params)
+            new_s = jax.tree_util.tree_map(sel, new_s, opt_state)
+        return new_p, new_s, {"grads_finite": finite.astype(jnp.float32)}
+
     # ZeRO-style optimizers declare per-leaf state sharding; the concrete
     # spec tree depends on the state's structure, so the program is built
     # lazily at first call and cached by state treedef.
@@ -545,6 +607,61 @@ def build_train_step(
             return rep
         return _spec_to_sharding(_state_specs(opt_state, params))
 
+    # Old-shard_map jax tier: autodiff under check_rep=False returns the
+    # UNSUMMED per-shard cotangent for every leaf, so each gradient must
+    # be psummed over exactly the mesh axes its parameter does NOT span
+    # (replicated leaves: all axes; TP-sharded kernels: the data axes).
+    # On current jax the vma machinery inserts these psums itself.
+    from . import _compat as _jax_compat
+
+    def _manual_rep_sum(grads, pspecs):
+        axis_order = tuple(mesh.axis_names)
+
+        def spec_axes(spec):
+            out = set()
+            for part in tuple(spec):
+                if part is None:
+                    continue
+                for a in (part if isinstance(part, tuple) else (part,)):
+                    out.add(a)
+            return out
+
+        def fix(g, spec):
+            missing = tuple(
+                a for a in axis_order if a not in spec_axes(spec)
+            )
+            return lax.psum(g, missing) if missing else g
+
+        # flatten_up_to: PartitionSpec may itself flatten as a pytree,
+        # so pair specs to gradient LEAVES by the gradients' structure
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        specs = treedef.flatten_up_to(pspecs)
+        return treedef.unflatten(
+            [fix(g, s) for g, s in zip(leaves, specs)]
+        )
+
+    def _make_do_update(params, opt_state, aux, *, hybrid_sync=False):
+        """The update/apply/merge_aux tail shared by all three step
+        bodies (one definition so the nonfinite where-select ordering
+        cannot diverge between lowering paths).  ``hybrid_sync``: the
+        hybrid path's autodiff already produced globally-synced grads,
+        so a multi-node optimizer must skip its own sync."""
+        def do_update(g):
+            if hybrid_sync and is_mn:
+                updates, new_state = optimizer.update(
+                    g, opt_state, params, sync_axes=()
+                )
+            else:
+                updates, new_state = optimizer.update(
+                    g, opt_state, params
+                )
+            p = optax.apply_updates(params, updates)
+            if aux is not None and merge_aux is not None:
+                p = merge_aux(p, aux)
+            return p, new_state
+
+        return do_update
+
     if use_shard_map and hybrid:
         def _step(params, opt_state, batch):
             # Differentiate the GLOBAL loss (pmean over the data axes is
@@ -559,6 +676,8 @@ def build_train_step(
                 return lax.pmean(out, axes)
 
             loss, grads = _value_and_grad(global_loss, params, batch)
+            if _jax_compat.OLD_SHARD_MAP:
+                grads = _manual_rep_sum(grads, _param_spec_tree(params))
             aux = None
             if has_aux:
                 loss, aux = loss
@@ -568,18 +687,12 @@ def build_train_step(
                     else a,
                     aux,
                 )
-            if is_mn:
-                updates, opt_state = optimizer.update(
-                    grads, opt_state, params, sync_axes=()
-                )
-            else:
-                updates, opt_state = optimizer.update(
-                    grads, opt_state, params
-                )
-            params = optax.apply_updates(params, updates)
-            if aux is not None and merge_aux is not None:
-                params = merge_aux(params, aux)
-            return params, opt_state, {"loss": loss}
+            params, opt_state, extra = _guarded_apply(
+                params, opt_state, grads,
+                _make_do_update(params, opt_state, aux, hybrid_sync=True),
+                bound=True,
+            )
+            return params, opt_state, {"loss": loss, **extra}
 
         def _build(state_specs, pspecs):
             sharded = jax.shard_map(
@@ -603,17 +716,15 @@ def build_train_step(
                     else a,
                     aux,
                 )
-            if is_mn:
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-            else:
-                if not _no_exchange(comm):
-                    grads = _sync_grads(grads, comm)
-                updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            if aux is not None and merge_aux is not None:
-                params = merge_aux(params, aux)
+            if not is_mn and not _no_exchange(comm):
+                grads = _sync_grads(grads, comm)
+            params, opt_state, extra = _guarded_apply(
+                params, opt_state, grads,
+                _make_do_update(params, opt_state, aux),
+                bound=True,
+            )
             loss = lax.pmean(loss, axes)
-            return params, opt_state, {"loss": loss}
+            return params, opt_state, {"loss": loss, **extra}
 
         def _build(state_specs, pspecs=None):
             del pspecs
@@ -631,11 +742,15 @@ def build_train_step(
             aux = None
             if has_aux:
                 loss, aux = loss
-            updates, opt_state = optimizer.update(grads, opt_state, params)
-            params = optax.apply_updates(params, updates)
-            if aux is not None and merge_aux is not None:
-                params = merge_aux(params, aux)
-            return params, opt_state, {"loss": loss}
+
+            # GSPMD path: grads are global arrays, so the finite flag is
+            # already globally agreed — no pmin needed (axes unbound).
+            params, opt_state, extra = _guarded_apply(
+                params, opt_state, grads,
+                _make_do_update(params, opt_state, aux),
+                bound=False,
+            )
+            return params, opt_state, {"loss": loss, **extra}
 
         def _build(state_shardings, pshardings=None):
             pshardings = rep if pshardings is None else pshardings
@@ -767,4 +882,7 @@ def build_train_step(
     # (k-steps-in-one-dispatch loops) can refuse a donated step, whose
     # warm call would consume params/opt_state and corrupt later calls.
     checked_step.donate = donate
+    # The trainer reads this to apply the host-side half of the policy
+    # (raise StepDivergedError on "abort", warn/log on the others).
+    checked_step.nonfinite_policy = nonfinite
     return checked_step

@@ -1,0 +1,34 @@
+"""Resilience layer: fault injection, retry/backoff, error taxonomy, log.
+
+The production-robustness counterpart of the reference's
+``global_except_hook`` + multi-node checkpointer pair: this package makes
+every recovery path *testable* (deterministic fault injection), *bounded*
+(retry/backoff on the host-side exchanges instead of wedging forever),
+and *observable* (a structured event log the trainer and tests assert
+against).  The cross-rank non-finite-step guard lives in
+``optimizers.build_train_step`` (it must compile into the step program);
+auto-resume lives in ``training.trainer.Trainer.run(max_restarts=N)``.
+"""
+
+from .errors import (  # noqa: F401
+    PayloadCorruptionError,
+    ResilienceError,
+    RestartBudgetExceededError,
+    StepDivergedError,
+    TransientCommError,
+)
+from .fault_injection import (  # noqa: F401
+    FaultInjector,
+    FaultSpec,
+    fire,
+    inject_faults,
+    install,
+)
+from .log import ResilienceEvent, ResilienceLog, attach, detach, emit  # noqa: F401
+from .retry import (  # noqa: F401
+    DEFAULT_POLICY,
+    RetryPolicy,
+    call_with_retry,
+    is_transient,
+    resilient_call,
+)

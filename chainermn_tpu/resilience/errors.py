@@ -1,0 +1,82 @@
+"""Resilience error taxonomy.
+
+Every failure the resilience layer can contain is a :class:`ResilienceError`
+carrying structured diagnostics (site, peer, attempt count, elapsed time)
+instead of a bare ``TimeoutError`` buried in a jax runtime stack.  The
+``recoverable`` flag is the contract with ``Trainer.run(max_restarts=N)``:
+recoverable errors are eligible for auto-resume from the newest common
+checkpoint; everything else propagates to the global except hook, which
+prints the taxonomy line before aborting the job.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+
+class ResilienceError(RuntimeError):
+    """Base class: a contained distributed failure with diagnostics.
+
+    ``site`` names the instrumented operation (e.g. ``obj_store.recv``),
+    ``peer`` the rank/process involved (when addressed), ``attempts`` how
+    many tries the retry layer spent, ``elapsed`` the wall-clock seconds
+    across those tries.
+    """
+
+    recoverable = False
+
+    def __init__(self, message: str, *, site: Optional[str] = None,
+                 peer=None, attempts: Optional[int] = None,
+                 elapsed: Optional[float] = None):
+        super().__init__(message)
+        self.site = site
+        self.peer = peer
+        self.attempts = attempts
+        self.elapsed = elapsed
+
+    def describe(self) -> str:
+        """One structured line for the global except hook / logs."""
+        parts = [f"kind={type(self).__name__}",
+                 f"recoverable={self.recoverable}"]
+        if self.site is not None:
+            parts.append(f"site={self.site}")
+        if self.peer is not None:
+            parts.append(f"peer={self.peer}")
+        if self.attempts is not None:
+            parts.append(f"attempts={self.attempts}")
+        if self.elapsed is not None:
+            parts.append(f"elapsed={self.elapsed:.2f}s")
+        return " ".join(parts)
+
+
+class TransientCommError(ResilienceError):
+    """A host-side exchange timed out or failed transiently.  Raised by
+    the retry layer once its attempt budget is exhausted (and directly by
+    the fault injector's ``timeout`` kind).  Recoverable: a restarted run
+    resumes from the newest common checkpoint."""
+
+    recoverable = True
+
+
+class PayloadCorruptionError(ResilienceError):
+    """A control-plane payload failed to unpickle (truncation / torn
+    write).  The message itself is lost, but the run is recoverable by
+    restart — re-exchange reproduces the payload."""
+
+    recoverable = True
+
+
+class StepDivergedError(ResilienceError):
+    """Non-finite gradients under the ``abort`` policy.  NOT recoverable:
+    restarting from the same state would diverge again — this is a
+    numerics problem, not a transport one."""
+
+    recoverable = False
+
+
+class RestartBudgetExceededError(ResilienceError):
+    """Auto-resume gave up: more recoverable failures than
+    ``max_restarts``.  Carries the last underlying error as
+    ``__cause__``."""
+
+    recoverable = False

@@ -1,0 +1,222 @@
+"""Deterministic fault injection for the host-side control plane.
+
+The reference's robustness machinery (``global_except_hook``, the
+multi-node checkpointer) exists because flaky workers are a production
+fact — but none of those recovery paths are testable without a way to
+*cause* the faults on demand.  This module is that way: a seedable,
+call-count-addressed injector that the instrumented sites
+(``communicators/_obj_store.py``, the eager collectives in
+``xla_communicator_base.py``, ``Updater.update``) consult via
+:func:`fire`.
+
+Determinism contract: a fault is addressed by ``(site, call_count)`` —
+the Nth ``fire()`` at a site either fires a spec or doesn't, identically
+on every run.  Probabilistic specs draw from a ``numpy`` RandomState
+seeded at injector construction, so they too replay exactly.
+
+Off by default, zero-overhead when off: the module-level ``_ACTIVE`` is
+``None`` unless a context manager / ``install()`` / the
+``CHAINERMN_TPU_FAULTS`` env var activated an injector, and ``fire()``'s
+un-instrumented fast path is a single ``is None`` check.  The env-var
+activation exists so the multi-process test harness can inject faults
+into spawned ``jax.distributed`` workers it cannot reach by object
+reference.
+
+Fault kinds
+-----------
+* ``delay``     — sleep ``delay`` seconds, then proceed (tail-latency
+  variance, the dominant real-world failure mode).
+* ``timeout``   — raise :class:`TransientCommError` (a transient
+  exchange failure the retry layer should absorb).
+* ``truncate``  — cut a bytes payload to ``truncate_to`` bytes (torn
+  write / short read; surfaces as :class:`PayloadCorruptionError` at the
+  unpickling site).
+* ``die``       — ``os._exit(exit_code)`` (simulated process death /
+  preemption; only meaningful in the multi-process harness).
+* ``error``     — raise a plain ``RuntimeError`` (an *unclassified*
+  failure, for testing that only recognized faults are retried).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from collections import Counter
+from typing import Any, Optional, Sequence
+
+import numpy as np
+
+from .errors import TransientCommError
+from .log import ResilienceLog, emit
+
+_KINDS = ("delay", "timeout", "truncate", "die", "error")
+
+ENV_SPEC = "CHAINERMN_TPU_FAULTS"
+ENV_SEED = "CHAINERMN_TPU_FAULT_SEED"
+
+
+class FaultSpec:
+    """One fault rule: where, what, and at which call counts.
+
+    ``at`` is a collection of 1-based call counts at ``site``;
+    ``probability`` additionally fires on a seeded coin flip per call
+    (both may be combined; either alone is fine).  ``max_fires`` bounds
+    the total fires of this spec (default unbounded).
+    """
+
+    def __init__(self, site: str, kind: str, *, at: Sequence[int] = (),
+                 probability: float = 0.0, delay: float = 0.05,
+                 truncate_to: int = 8, exit_code: int = 43,
+                 max_fires: Optional[int] = None):
+        if kind not in _KINDS:
+            raise ValueError(f"unknown fault kind {kind!r}; one of {_KINDS}")
+        if not 0.0 <= probability <= 1.0:
+            raise ValueError(f"probability must be in [0, 1], got {probability}")
+        self.site = site
+        self.kind = kind
+        self.at = frozenset(int(c) for c in at)
+        self.probability = float(probability)
+        self.delay = float(delay)
+        self.truncate_to = int(truncate_to)
+        self.exit_code = int(exit_code)
+        self.max_fires = max_fires
+        self.fires = 0
+
+    def should_fire(self, count: int, rng: np.random.RandomState) -> bool:
+        if self.max_fires is not None and self.fires >= self.max_fires:
+            return False
+        if count in self.at:
+            return True
+        # the draw happens on every call so the stream position — and
+        # therefore the fire pattern — depends only on (seed, call count)
+        if self.probability > 0.0:
+            return bool(rng.random_sample() < self.probability)
+        return False
+
+    def __repr__(self):
+        return (f"<FaultSpec {self.kind}@{self.site} at={sorted(self.at)} "
+                f"p={self.probability}>")
+
+
+class FaultInjector:
+    """Holds the specs, per-site call counters, and the seeded RNG."""
+
+    def __init__(self, specs: Sequence[FaultSpec], seed: int = 0,
+                 log: Optional[ResilienceLog] = None):
+        self.specs = list(specs)
+        self.seed = int(seed)
+        self._rng = np.random.RandomState(self.seed)
+        self._counts: Counter = Counter()
+        self.log = log if log is not None else ResilienceLog()
+
+    def call_count(self, site: str) -> int:
+        return self._counts[site]
+
+    def fire(self, site: str, *, peer=None, payload: Any = None) -> Any:
+        """Count a call at ``site`` and apply any matching fault.
+
+        Returns the (possibly mutated) payload; raises for ``timeout`` /
+        ``error``; never returns for ``die``.
+        """
+        self._counts[site] += 1
+        count = self._counts[site]
+        for spec in self.specs:
+            if spec.site != site or not spec.should_fire(count, self._rng):
+                continue
+            spec.fires += 1
+            self.log.record("fault_injected", site, fault=spec.kind,
+                            call=count, peer=peer)
+            emit("fault_injected", site, fault=spec.kind, call=count,
+                 peer=peer)
+            if spec.kind == "delay":
+                time.sleep(spec.delay)
+            elif spec.kind == "timeout":
+                raise TransientCommError(
+                    f"injected timeout at {site} (call {count})",
+                    site=site, peer=peer,
+                )
+            elif spec.kind == "truncate":
+                if isinstance(payload, (bytes, bytearray)):
+                    payload = bytes(payload[: spec.truncate_to])
+            elif spec.kind == "die":
+                # flush so the harness sees output written before death
+                import sys
+
+                sys.stdout.flush()
+                sys.stderr.flush()
+                os._exit(spec.exit_code)
+            elif spec.kind == "error":
+                raise RuntimeError(
+                    f"injected error at {site} (call {count})"
+                )
+        return payload
+
+
+# -- activation ---------------------------------------------------------
+_ACTIVE: Optional[FaultInjector] = None
+
+
+def active() -> Optional[FaultInjector]:
+    return _ACTIVE
+
+
+def install(injector: Optional[FaultInjector]) -> None:
+    """Set (or clear, with ``None``) the process-global injector."""
+    global _ACTIVE
+    _ACTIVE = injector
+
+
+def fire(site: str, *, peer=None, payload: Any = None) -> Any:
+    """Hot-path hook at every instrumented site.
+
+    The un-instrumented fast path is this one ``is None`` check — no
+    counter, no dict lookup, no allocation.
+    """
+    inj = _ACTIVE
+    if inj is None:
+        return payload
+    return inj.fire(site, peer=peer, payload=payload)
+
+
+class inject_faults:
+    """Context manager: activate an injector for a ``with`` block.
+
+    ``specs`` is a sequence of :class:`FaultSpec` (or dicts forwarded to
+    its constructor).  Nesting restores the previous injector on exit.
+
+        with inject_faults([FaultSpec("obj_store.recv", "timeout",
+                                      at=[1])]) as inj:
+            ...
+        inj.log.events("fault_injected")
+    """
+
+    def __init__(self, specs, seed: int = 0,
+                 log: Optional[ResilienceLog] = None):
+        specs = [s if isinstance(s, FaultSpec) else FaultSpec(**s)
+                 for s in specs]
+        self.injector = FaultInjector(specs, seed=seed, log=log)
+        self._prev: Optional[FaultInjector] = None
+
+    def __enter__(self) -> FaultInjector:
+        self._prev = _ACTIVE
+        install(self.injector)
+        return self.injector
+
+    def __exit__(self, *exc):
+        install(self._prev)
+        return False
+
+
+def _from_env() -> None:
+    """Activate from ``CHAINERMN_TPU_FAULTS`` (a JSON list of FaultSpec
+    kwargs) — the only way to reach spawned multi-process workers."""
+    raw = os.environ.get(ENV_SPEC)
+    if not raw:
+        return
+    specs = [FaultSpec(**d) for d in json.loads(raw)]
+    seed = int(os.environ.get(ENV_SEED, "0"))
+    install(FaultInjector(specs, seed=seed))
+
+
+_from_env()

@@ -1,0 +1,93 @@
+"""Resilience event log.
+
+Every injected fault, retry, skipped step, and restart is recorded as a
+:class:`ResilienceEvent` so tests (and extensions like the evaluator) can
+assert against exactly what happened instead of inferring it from timing.
+
+The injector and retry layer are process-global, but the natural assertion
+surface is per-trainer (``trainer.resilience_log``).  The bridge is a sink
+registry: ``emit()`` fans an event out to every attached log, and
+``Trainer.run`` attaches its log for the duration of the run.  Logs also
+work standalone (``ResilienceLog.record``) for unit tests that have no
+trainer.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Dict, List, Optional
+
+
+class ResilienceEvent:
+    """One observed/injected fault or recovery action."""
+
+    __slots__ = ("kind", "site", "time", "info")
+
+    def __init__(self, kind: str, site: Optional[str] = None, **info):
+        self.kind = kind
+        self.site = site
+        self.time = time.time()
+        self.info = info
+
+    def __repr__(self):
+        extra = "".join(f" {k}={v!r}" for k, v in self.info.items())
+        return f"<ResilienceEvent {self.kind} site={self.site}{extra}>"
+
+
+class ResilienceLog:
+    """Append-only event list with query helpers."""
+
+    def __init__(self):
+        self._events: List[ResilienceEvent] = []
+
+    def record(self, kind: str, site: Optional[str] = None,
+               **info) -> ResilienceEvent:
+        ev = ResilienceEvent(kind, site, **info)
+        self._events.append(ev)
+        return ev
+
+    def events(self, kind: Optional[str] = None,
+               site: Optional[str] = None) -> List[ResilienceEvent]:
+        return [
+            e for e in self._events
+            if (kind is None or e.kind == kind)
+            and (site is None or e.site == site)
+        ]
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self._events:
+            out[e.kind] = out.get(e.kind, 0) + 1
+        return out
+
+    def clear(self) -> None:
+        self._events.clear()
+
+    def __len__(self):
+        return len(self._events)
+
+    def __iter__(self):
+        return iter(self._events)
+
+
+# -- sink registry ------------------------------------------------------
+_sinks: List[ResilienceLog] = []
+
+
+def attach(log: ResilienceLog) -> None:
+    """Route subsequent :func:`emit` events into ``log`` (idempotent)."""
+    if log not in _sinks:
+        _sinks.append(log)
+
+
+def detach(log: ResilienceLog) -> None:
+    if log in _sinks:
+        _sinks.remove(log)
+
+
+def emit(kind: str, site: Optional[str] = None, **info) -> None:
+    """Record an event on every attached sink (no-op with none attached —
+    the hot-path cost of an un-observed event is one empty-list check)."""
+    for sink in _sinks:
+        sink.record(kind, site, **info)

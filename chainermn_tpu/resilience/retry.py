@@ -1,0 +1,128 @@
+"""Bounded retry with deterministic exponential backoff.
+
+Replaces the control plane's wedge-forever failure mode: an obj-store
+exchange that times out is retried on a bounded, *deterministic* schedule
+(no jitter by default, so tests replay exactly), and exhaustion raises a
+:class:`TransientCommError` naming the site, peer, attempt count, and
+elapsed time — the diagnostics the reference's ``MPI_Abort`` path never
+had.
+
+What counts as retryable: ``TimeoutError``, anything already classified
+:class:`TransientCommError`, and jax runtime errors whose text marks a
+coordination-service deadline (``DEADLINE_EXCEEDED``).  An *unclassified*
+exception propagates unchanged on the first attempt — retrying an unknown
+failure can double-apply a side effect.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Optional, Sequence
+
+from .errors import ResilienceError, TransientCommError
+from .log import emit
+
+# Substrings of exception text that mark a transient coordination-service
+# failure (jax's KV store surfaces timeouts as XlaRuntimeError strings).
+_TRANSIENT_MARKERS = ("DEADLINE_EXCEEDED", "deadline exceeded", "timed out")
+
+
+def is_transient(exc: BaseException) -> bool:
+    if isinstance(exc, (TransientCommError, TimeoutError)):
+        return True
+    if isinstance(exc, ResilienceError):
+        return False  # already classified as something else
+    text = str(exc)
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+class RetryPolicy:
+    """Bounded attempts with exponential backoff.
+
+    ``delay(i)`` for attempt ``i`` (1-based) is
+    ``min(base_delay * multiplier**(i-1), max_delay)`` — jitter-free, so
+    the schedule is a pure function of the policy (deterministic tests).
+    """
+
+    def __init__(self, max_attempts: int = 4, base_delay: float = 0.05,
+                 multiplier: float = 2.0, max_delay: float = 2.0):
+        if max_attempts < 1:
+            raise ValueError(f"max_attempts must be >= 1, got {max_attempts}")
+        self.max_attempts = int(max_attempts)
+        self.base_delay = float(base_delay)
+        self.multiplier = float(multiplier)
+        self.max_delay = float(max_delay)
+
+    def delay(self, attempt: int) -> float:
+        return min(
+            self.base_delay * self.multiplier ** (attempt - 1),
+            self.max_delay,
+        )
+
+    def schedule(self) -> Sequence[float]:
+        """The full backoff schedule (between attempts 1..max_attempts)."""
+        return [self.delay(i) for i in range(1, self.max_attempts)]
+
+    def __repr__(self):
+        return (f"RetryPolicy(max_attempts={self.max_attempts}, "
+                f"base_delay={self.base_delay}, "
+                f"multiplier={self.multiplier}, max_delay={self.max_delay})")
+
+
+DEFAULT_POLICY = RetryPolicy()
+
+
+def call_with_retry(fn: Callable, *, site: str, peer=None,
+                    policy: Optional[RetryPolicy] = None,
+                    retryable: Callable[[BaseException], bool] = is_transient,
+                    sleep: Callable[[float], None] = time.sleep):
+    """Run ``fn()`` under ``policy``; absorb transient failures.
+
+    On exhaustion raises :class:`TransientCommError` (recoverable) with
+    the last failure chained, naming the peer, attempt count, and total
+    elapsed time.  Non-retryable exceptions propagate immediately.
+    """
+    policy = policy or DEFAULT_POLICY
+    t0 = time.monotonic()
+    last: Optional[BaseException] = None
+    for attempt in range(1, policy.max_attempts + 1):
+        try:
+            return fn()
+        except BaseException as e:  # noqa: BLE001 — classified below
+            if not retryable(e):
+                raise
+            last = e
+            emit("retry", site, attempt=attempt, peer=peer,
+                 error=f"{type(e).__name__}: {e}")
+            if attempt < policy.max_attempts:
+                sleep(policy.delay(attempt))
+    elapsed = time.monotonic() - t0
+    raise TransientCommError(
+        f"{site}: {policy.max_attempts} attempts failed over "
+        f"{elapsed:.2f}s"
+        + (f" (peer={peer})" if peer is not None else "")
+        + f"; last: {type(last).__name__}: {last}",
+        site=site, peer=peer, attempts=policy.max_attempts,
+        elapsed=elapsed,
+    ) from last
+
+
+def resilient_call(site: str, fn: Callable, *, peer=None,
+                   policy: Optional[RetryPolicy] = None):
+    """Injection-aware wrapper for operations that cannot fail
+    transiently on their own (in-memory mailboxes, compiled XLA
+    collectives): with no injector active it is a direct call — the
+    un-instrumented hot path pays ONE ``is None`` check, no retry
+    machinery.  With an injector active, each attempt fires the site
+    (so call-count-addressed faults hit deterministically per attempt)
+    and injected transient faults are absorbed by the retry policy."""
+    from . import fault_injection as _fi
+
+    if _fi.active() is None:
+        return fn()
+
+    def attempt():
+        _fi.fire(site, peer=peer)
+        return fn()
+
+    return call_with_retry(attempt, site=site, peer=peer, policy=policy)

@@ -16,11 +16,19 @@ the host prepares the next batch).
 from __future__ import annotations
 
 import time
+import warnings
 from typing import Any, Callable, Dict, Optional
 
 import jax
 
 from .triggers import get_trigger
+from ..resilience import fault_injection as _fi
+from ..resilience import log as _rlog
+from ..resilience.errors import (
+    ResilienceError,
+    RestartBudgetExceededError,
+    StepDivergedError,
+)
 
 
 class Updater:
@@ -47,6 +55,10 @@ class Updater:
         return getattr(self.iterator, "epoch_detail", 0.0)
 
     def update(self) -> None:
+        # resilience site: a deterministic mid-run failure point for
+        # exercising auto-resume (no-op — one None check — when no
+        # injector is active)
+        _fi.fire("trainer.update")
         batch = next(self.iterator)
         place_batch = getattr(self.step_fn, "place_batch", None)
         # build_train_step exposes its own placement predicate; a batch
@@ -92,6 +104,14 @@ class Trainer:
         self.observation: Dict[str, Any] = {}
         self._extensions: list[_ExtensionEntry] = []
         self._start_time: Optional[float] = None
+        # Structured record of every injected/observed fault, retry,
+        # skipped step, and restart during run() — the assertion surface
+        # for tests and reporting extensions.
+        from ..resilience.log import ResilienceLog
+
+        self.resilience_log = ResilienceLog()
+        self.restarts = 0
+        self._pending_guard = None  # deferred grads_finite read
 
     # -- extension management -----------------------------------------
     def extend(self, ext, trigger=None, priority: Optional[int] = None,
@@ -126,26 +146,143 @@ class Trainer:
             return self.iteration >= self.stop_n
         return self.updater.epoch >= self.stop_n
 
-    def run(self) -> None:
+    def _check_step_guard(self) -> None:
+        """Host side of the non-finite-step guard: the compiled step
+        already skipped (or applied, under ``warn``) the update in
+        cross-rank agreement; here the policy's host effect happens —
+        record the event, warn, or abort.
+
+        The flag is read one iteration LATE: materializing iteration
+        i's ``grads_finite`` would otherwise block the host on step i
+        every time, serializing the async-dispatch pipeline.  Deferring
+        the read until after step i+1 is dispatched keeps the overlap;
+        by then step i has (almost always) completed, so ``float()``
+        returns without waiting.  The pending flag is flushed at loop
+        end (``_flush_step_guard``), so no event is ever lost."""
+        policy = getattr(self.updater.step_fn, "nonfinite_policy", None)
+        if policy is None:
+            return
+        flag = (self.updater.last_metrics or {}).get("grads_finite")
+        prev, self._pending_guard = (
+            self._pending_guard,
+            None if flag is None else (self.iteration, flag, policy),
+        )
+        if prev is not None:
+            self._consume_guard(prev)
+
+    def _flush_step_guard(self) -> None:
+        prev, self._pending_guard = self._pending_guard, None
+        if prev is not None:
+            self._consume_guard(prev)
+
+    def _consume_guard(self, pending) -> None:
+        iteration, flag, policy = pending
+        if float(flag) > 0.0:
+            return
+        self.resilience_log.record(
+            "nonfinite_step", "trainer.update",
+            iteration=iteration, policy=policy,
+        )
+        if policy == "abort":
+            raise StepDivergedError(
+                f"non-finite gradients at iteration {iteration} "
+                "(policy 'abort'); all ranks agreed via the compiled "
+                "pmin flag, so the abort is collective-safe",
+                site="trainer.update",
+            )
+        if policy == "warn":
+            warnings.warn(
+                f"non-finite gradients at iteration {iteration} "
+                "applied under policy 'warn'"
+            )
+
+    def _find_checkpointer(self):
+        for e in self._extensions:
+            if hasattr(e.ext, "restore_trainer"):
+                return e.ext
+        return None
+
+    def _auto_resume(self, error: ResilienceError) -> None:
+        """Roll back to the newest common checkpoint (params, opt_state,
+        iteration, iterator position).  Without a checkpointer extension
+        the in-flight state is still consistent (the step is functional:
+        an aborted update left params untouched), so training simply
+        continues from the current iteration."""
+        ckpt = self._find_checkpointer()
+        step = ckpt.restore_trainer(self) if ckpt is not None else None
+        self.resilience_log.record(
+            "restart", error.site,
+            restored_step=step, restarts=self.restarts,
+            error=f"{type(error).__name__}: {error}",
+        )
+
+    def run(self, max_restarts: int = 0) -> None:
+        """Run to the stop trigger.
+
+        ``max_restarts``: auto-resume budget.  A *recoverable*
+        :class:`ResilienceError` escaping an update (exhausted obj-store
+        retries, an injected transient fault, a corrupted control-plane
+        payload) rolls the trainer back to the newest common checkpoint
+        (see :meth:`_auto_resume`) and continues, up to this many times;
+        the budget and every restart are recorded on
+        ``self.resilience_log``.  Exhaustion raises
+        :class:`RestartBudgetExceededError` with the last failure
+        chained; non-recoverable errors propagate immediately.
+        """
         self._start_time = time.time()
-        for e in self._extensions:
-            init = getattr(e.ext, "initialize", None)
-            if init:
-                init(self)
-        exts = sorted(self._extensions, key=lambda e: -e.priority)
-        while not self._stop():
-            self.updater.update()
-            self.iteration += 1
-            self.observation = {
-                k: v for k, v in (self.updater.last_metrics or {}).items()
-            }
-            for e in exts:
-                if e.trigger(self):
-                    e.ext(self)
-        for e in self._extensions:
-            fin = getattr(e.ext, "finalize", None)
-            if fin:
-                fin(self)
+        _rlog.attach(self.resilience_log)
+        try:
+            for e in self._extensions:
+                init = getattr(e.ext, "initialize", None)
+                if init:
+                    init(self)
+            exts = sorted(self._extensions, key=lambda e: -e.priority)
+            self.restarts = 0
+            while not self._stop():
+                try:
+                    self.updater.update()
+                    self.iteration += 1
+                    self.observation = {
+                        k: v
+                        for k, v in (self.updater.last_metrics or {}).items()
+                    }
+                    self._check_step_guard()
+                    # extensions run INSIDE the recovery scope: a
+                    # transient failure during e.g. the checkpointer's
+                    # collective save is as recoverable as one during
+                    # the update itself
+                    for e in exts:
+                        if e.trigger(self):
+                            e.ext(self)
+                except ResilienceError as err:
+                    if not err.recoverable:
+                        raise
+                    if self.restarts >= max_restarts:
+                        if self.restarts == 0:
+                            # auto-resume never engaged (max_restarts=0):
+                            # propagate the original, still-recoverable
+                            # error unchanged so outer layers can apply
+                            # their own policy to the true taxonomy
+                            raise
+                        raise RestartBudgetExceededError(
+                            f"giving up after {self.restarts} restart(s) "
+                            f"(max_restarts={max_restarts}); last failure: "
+                            f"{type(err).__name__}: {err}",
+                            site=err.site,
+                            attempts=err.attempts,
+                        ) from err
+                    self.restarts += 1
+                    # the restored state invalidates any deferred
+                    # grads_finite read from the rolled-back step
+                    self._pending_guard = None
+                    self._auto_resume(err)
+            self._flush_step_guard()
+            for e in self._extensions:
+                fin = getattr(e.ext, "finalize", None)
+                if fin:
+                    fin(self)
+        finally:
+            _rlog.detach(self.resilience_log)
 
     # -- state (for checkpointing) -------------------------------------
     def state_dict(self) -> Dict[str, Any]:

@@ -154,17 +154,20 @@ def device_normalize(x, mean, std, dtype=None):
     """``(x - mean) / std`` for a uint8 wire batch, ON DEVICE.
 
     Call inside the jitted train step with a ``wire="uint8"`` loader's
-    ``mean`` / ``std``: the subtraction/scale runs in fp32 (matching the
-    float32 wire path's host-side numerics) and fuses into the first
-    conv's input, so it is free next to the transfer bytes it saves.
-    ``dtype`` casts the result (``jnp.bfloat16`` for the standard
-    TPU input design).
+    ``mean`` / ``std``: subtract-then-DIVIDE in fp32 — the exact
+    operation sequence of the C++ float32 wire path
+    (``loader.cpp``: ``(float(px) - mean[k]) / stddev[k]``), so the two
+    wire modes agree bit-for-bit (IEEE fp32 subtraction and division
+    are exactly rounded; a multiply by a precomputed reciprocal would
+    differ by 1-2 ulp).  It fuses into the first conv's input, so it is
+    free next to the transfer bytes it saves.  ``dtype`` casts the
+    result (``jnp.bfloat16`` for the standard TPU input design).
     """
     import jax.numpy as jnp
 
     mean = jnp.asarray(np.asarray(mean), jnp.float32)
-    inv_std = 1.0 / jnp.asarray(np.asarray(std), jnp.float32)
-    out = (x.astype(jnp.float32) - mean) * inv_std
+    std = jnp.asarray(np.asarray(std), jnp.float32)
+    out = (x.astype(jnp.float32) - mean) / std
     return out.astype(dtype) if dtype is not None else out
 
 

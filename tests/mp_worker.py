@@ -36,6 +36,16 @@ def main():
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    try:
+        # Older jax (<= 0.4.x) does not enable cross-process CPU
+        # collectives unless the gloo implementation is selected; newer
+        # releases default to it (and may drop the option — hence the
+        # guard).  Without this, every multihost_utils collective dies
+        # with "Multiprocess computations aren't implemented on the CPU
+        # backend".
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
     jax.distributed.initialize(
         f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
     )
@@ -481,6 +491,149 @@ def scenario_kill_mid_checkpoint_phase2(pid, nproc, scratch):
         assert np.isfinite(float(m["loss"]))
     return {"resumed_step": got_step,
             "w4": float(np.asarray(params["w"])[0])}
+
+
+def scenario_async_checkpoint(pid, nproc, scratch):
+    """``use_async=True`` across a real 2-process world: ``save`` returns
+    while the write continues on a background thread; a second save
+    serializes behind the in-flight one; ``wait_until_finished`` +
+    ``newest_common_step`` + ``resume`` observe the committed snapshots
+    (previously async was only exercised single-process)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import chainermn_tpu as cmn
+
+    comm = _comm()
+    ckpt = cmn.create_multi_node_checkpointer(
+        "amp", comm, path=os.path.join(scratch, "ckpt"), use_async=True
+    )
+    state2 = {
+        "params": comm.bcast_data({"w": jnp.arange(8.0)}),
+        "meta": {"it": 2},
+    }
+    ckpt.save(2, state2)
+    state5 = {
+        "params": comm.bcast_data({"w": jnp.arange(8.0) + 5}),
+        "meta": {"it": 5},
+    }
+    ckpt.save(5, state5)  # must serialize behind the in-flight step-2 save
+    ckpt.wait_until_finished()
+    comm.barrier()  # every process committed before the agreement scan
+    assert ckpt.newest_common_step() == 5
+    step, restored = ckpt.resume(like=state5)
+    assert step == 5, step
+    np.testing.assert_allclose(
+        np.asarray(restored["params"]["w"]), np.arange(8.0) + 5
+    )
+    assert int(np.asarray(restored["meta"]["it"])) == 5
+    ckpt.finalize()
+    return {"resumed_step": step}
+
+
+def scenario_resilience(pid, nproc, scratch):
+    """The resilience tentpole in a REAL 2-process world (faults injected
+    via the CHAINERMN_TPU_FAULTS env var set by the spawning test):
+
+    (a) an injected transient obj-store timeout (first exchange, both
+        processes) is absorbed by the retry schedule — the allgather
+        completes;
+    (b) a NaN gradient on ONE process's rows is skipped in cross-rank
+        agreement (the compiled pmin flag) — no deadlock, bit-identical
+        params everywhere;
+    (c) an injected mid-run failure at update call 4 (both processes)
+        triggers auto-resume from ``newest_common_step()`` and training
+        reaches the stop trigger with ``max_restarts`` respected.
+    """
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.training.trainer import Trainer, Updater
+    from chainermn_tpu.iterators import SerialIterator
+
+    comm = _comm()
+
+    # (a) retried obj-store exchange: the env spec fires a timeout on the
+    # FIRST obj_store.exchange call of every process; the retry joins the
+    # collective late (tail latency, not deadlock) and it completes.
+    got = comm.allgather_obj(pid * 7)
+    assert got == [i * 7 for i in range(nproc)], got
+
+    # (b) cross-rank NaN skip agreement.
+    lr, c = 0.1, float(np.mean(np.arange(comm.size)))
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(lr), comm)
+    step = build_train_step(comm, loss_fn, opt, donate=False,
+                            nonfinite="skip")
+    params, opt_state = step.place(
+        {"w": jnp.zeros((4,))}, opt.init({"w": jnp.zeros((4,))})
+    )
+    n_local = comm.size // comm.process_count
+    rows = np.stack([
+        np.full((4,), float(pid * n_local + i), np.float32)
+        for i in range(n_local)
+    ])
+    bad = rows.copy()
+    if pid == 0:  # non-finite data on ONE process only
+        bad[0, 0] = np.nan
+
+    def w_at(k):
+        return c * (1.0 - (1.0 - lr) ** k)
+
+    params, opt_state, m1 = step(params, opt_state, rows)
+    assert float(m1["grads_finite"]) == 1.0
+    params, opt_state, m2 = step(params, opt_state, bad)
+    assert float(m2["grads_finite"]) == 0.0, (
+        "every rank must agree the NaN step is skipped"
+    )
+    np.testing.assert_allclose(  # skipped: params still at step 1
+        np.asarray(params["w"]), np.full((4,), w_at(1)), rtol=1e-6
+    )
+    params, opt_state, m3 = step(params, opt_state, rows)
+    assert float(m3["grads_finite"]) == 1.0
+    flags = comm.allgather_obj(
+        [float(m1["grads_finite"]), float(m2["grads_finite"]),
+         float(m3["grads_finite"])]
+    )
+    assert all(f == flags[0] for f in flags), flags
+
+    # (c) auto-resume across processes: train 6 iterations with a
+    # per-iteration collective checkpoint; the env spec kills update
+    # call 4 with a transient fault on BOTH processes (same
+    # deterministic call count), so both roll back to step 3 together.
+    opt2 = cmn.create_multi_node_optimizer(optax.sgd(lr), comm)
+    step2 = build_train_step(comm, loss_fn, opt2, donate=False)
+    p2, s2 = step2.place(
+        {"w": jnp.zeros((4,))}, opt2.init({"w": jnp.zeros((4,))})
+    )
+    it = SerialIterator([rows[i] for i in range(n_local)], n_local,
+                        shuffle=False)
+    trainer = Trainer(Updater(it, step2, p2, s2),
+                      stop_trigger=(6, "iteration"))
+    ckpt = cmn.create_multi_node_checkpointer(
+        "resume", comm, path=os.path.join(scratch, "resume_ckpt")
+    )
+    trainer.extend(ckpt, trigger=(1, "iteration"))
+    trainer.run(max_restarts=2)
+    assert trainer.iteration == 6, trainer.iteration
+    assert trainer.restarts == 1, trainer.restarts
+    counts = trainer.resilience_log.counts
+    assert counts.get("restart") == 1, counts
+    assert counts.get("fault_injected", 0) >= 1, counts
+    np.testing.assert_allclose(
+        np.asarray(trainer.updater.params["w"]), np.full((4,), w_at(6)),
+        rtol=1e-6,
+    )
+    finals = comm.allgather_obj(
+        float(np.asarray(trainer.updater.params["w"])[0])
+    )
+    assert all(abs(f - finals[0]) < 1e-6 for f in finals), finals
+    return {"final_w": finals[0], "restarts": trainer.restarts}
 
 
 def scenario_except_hook(pid, nproc, scratch):

@@ -188,6 +188,44 @@ class TestKillMidCheckpoint:
         assert payloads[0]["w4"] == pytest.approx(payloads[1]["w4"])
 
 
+class TestAsyncCheckpoint:
+    def test_async_save_agree_resume_two_processes(self, tmp_path):
+        # use_async=True was previously only exercised single-process;
+        # here the AsyncCheckpointer's background commit, the
+        # save-after-save serialization, wait_until_finished, and the
+        # agreement protocol all run across a real 2-process world.
+        res = run_world("async_checkpoint", n_procs=2, local_devices=2,
+                        tmpdir=tmp_path)
+        payloads = _assert_ok(res, "async_checkpoint")
+        assert all(p["resumed_step"] == 5 for p in payloads)
+
+
+class TestResilience:
+    def test_retry_skip_and_auto_resume_two_processes(self, tmp_path):
+        """Tentpole acceptance in a real 2-process world: an injected
+        transient obj-store timeout is retried and the run completes; a
+        NaN gradient on one process is skipped in agreement on all
+        ranks with no deadlock; an injected mid-run failure triggers
+        auto-resume from newest_common_step() with max_restarts
+        respected (faults reach the workers via CHAINERMN_TPU_FAULTS)."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "timeout", "at": [1]},
+            {"site": "trainer.update", "kind": "timeout", "at": [4]},
+        ])
+        res = run_world(
+            "resilience", n_procs=2, local_devices=2, tmpdir=tmp_path,
+            timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "resilience")
+        assert all(p["restarts"] == 1 for p in payloads)
+        assert payloads[0]["final_w"] == pytest.approx(
+            payloads[1]["final_w"]
+        )
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

@@ -254,10 +254,19 @@ class TestUint8Wire:
         )
         slot, x, y = loader.acquire()
         assert x.dtype == np.uint8 and x.shape == (BATCH, 8, 8, C)
-        assert x.nbytes * 4 == BATCH * 8 * 8 * C * 4  # 1/4 of float32
+        assert x.nbytes == BATCH * 8 * 8 * C  # one byte per pixel-channel
         assert loader.wire == "uint8"
         loader.release(slot)
         loader.close()
+        # the 1/4-of-float32 wire claim, against a real float32 batch
+        f = NativeImageLoader(
+            images, labels, BATCH, crop=(8, 8), n_threads=2, seed=3,
+        )
+        slot_f, x_f, _y_f = f.acquire()
+        assert x_f.dtype == np.float32
+        assert x_f.nbytes == 4 * x.nbytes
+        f.release(slot_f)
+        f.close()
 
     @pytest.mark.parametrize("train", [False, True])
     def test_matches_float_wire_after_device_normalize(self, train):
@@ -277,7 +286,9 @@ class TestUint8Wire:
                 got = np.asarray(
                     device_normalize(jnp_asarray(xu), u.mean, u.std)
                 )
-                np.testing.assert_allclose(got, xf, rtol=1e-6, atol=1e-6)
+                # bit-for-bit: device_normalize subtracts then DIVIDES
+                # in fp32, the exact op sequence of the C++ float32 wire
+                np.testing.assert_array_equal(got, xf)
         finally:
             f.close()
             u.close()

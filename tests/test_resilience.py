@@ -1,0 +1,565 @@
+"""Resilience layer tests: fault injection, retry/backoff, the cross-rank
+non-finite-step guard, and trainer auto-resume.
+
+Strategy mirrors the suite's "real small world, no mocks" rule: every
+recovery path runs against the real 8-device virtual CPU mesh (the
+2-process ``jax.distributed`` variants live in ``test_multiprocess.py``,
+scenario ``resilience``).  Injection is deterministic — (site, call
+count) addressed, seeded — so each test asserts the exact sequence of
+faults, retries, and recoveries.
+"""
+
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.optimizers import build_train_step
+from chainermn_tpu.training.trainer import Trainer, Updater
+from chainermn_tpu.iterators import SerialIterator
+from chainermn_tpu.resilience import (
+    FaultInjector,
+    FaultSpec,
+    PayloadCorruptionError,
+    ResilienceLog,
+    RestartBudgetExceededError,
+    RetryPolicy,
+    StepDivergedError,
+    TransientCommError,
+    call_with_retry,
+    inject_faults,
+)
+from chainermn_tpu.resilience import fault_injection as fi
+
+from conftest import cpu_devices
+
+
+@pytest.fixture(scope="module")
+def comm():
+    return cmn.create_communicator("flat", devices=cpu_devices(8))
+
+
+# ----------------------------------------------------------------------
+# Fault injector
+# ----------------------------------------------------------------------
+class TestFaultInjector:
+    def test_off_by_default_noop_fast_path(self):
+        assert fi.active() is None
+        payload = b"untouched"
+        assert fi.fire("anything", payload=payload) is payload
+
+    def test_call_count_addressing(self):
+        inj = FaultInjector([FaultSpec("s", "timeout", at=[2, 4])])
+        inj.fire("s")  # call 1: clean
+        with pytest.raises(TransientCommError):
+            inj.fire("s")  # call 2: fires
+        inj.fire("s")  # call 3: clean
+        with pytest.raises(TransientCommError):
+            inj.fire("s")  # call 4: fires
+        assert inj.call_count("s") == 4
+        assert len(inj.log.events("fault_injected")) == 2
+
+    def test_sites_are_independent(self):
+        inj = FaultInjector([FaultSpec("a", "timeout", at=[1])])
+        inj.fire("b")  # other sites never trip the spec
+        with pytest.raises(TransientCommError):
+            inj.fire("a")
+
+    def test_seeded_probability_is_deterministic(self):
+        def pattern(seed):
+            inj = FaultInjector(
+                [FaultSpec("s", "timeout", probability=0.5)], seed=seed
+            )
+            out = []
+            for _ in range(32):
+                try:
+                    inj.fire("s")
+                    out.append(0)
+                except TransientCommError:
+                    out.append(1)
+            return out
+
+        assert pattern(7) == pattern(7)
+        assert pattern(7) != pattern(8)  # seed actually matters
+        assert sum(pattern(7)) > 0
+
+    def test_max_fires_bounds_a_spec(self):
+        inj = FaultInjector(
+            [FaultSpec("s", "timeout", at=[1, 2, 3], max_fires=1)]
+        )
+        with pytest.raises(TransientCommError):
+            inj.fire("s")
+        inj.fire("s")  # budget spent: calls 2 and 3 pass
+        inj.fire("s")
+
+    def test_truncate_mutates_payload(self):
+        inj = FaultInjector(
+            [FaultSpec("s", "truncate", at=[1], truncate_to=3)]
+        )
+        assert inj.fire("s", payload=b"0123456789") == b"012"
+        assert inj.fire("s", payload=b"0123456789") == b"0123456789"
+
+    def test_delay_sleeps(self):
+        import time
+
+        inj = FaultInjector([FaultSpec("s", "delay", at=[1], delay=0.2)])
+        t0 = time.monotonic()
+        inj.fire("s")
+        assert time.monotonic() - t0 >= 0.15
+
+    def test_context_manager_restores_previous(self):
+        assert fi.active() is None
+        with inject_faults([FaultSpec("x", "timeout", at=[1])]) as outer:
+            assert fi.active() is outer
+            with inject_faults([]) as inner:
+                assert fi.active() is inner
+            assert fi.active() is outer
+        assert fi.active() is None
+
+    def test_env_activation_and_die(self, tmp_path):
+        """The env-var path (how spawned mp workers are injected) and the
+        simulated-process-death kind, in a throwaway subprocess."""
+        import json
+
+        code = (
+            "from chainermn_tpu.resilience import fault_injection as fi\n"
+            "assert fi.active() is not None\n"
+            "fi.fire('warm')\n"          # other sites unaffected
+            "fi.fire('doom')\n"          # call 1: clean
+            "fi.fire('doom')\n"          # call 2: dies with code 43
+            "print('UNREACHABLE')\n"
+        )
+        from conftest import subprocess_env
+
+        env = subprocess_env(1)
+        env[fi.ENV_SPEC] = json.dumps(
+            [{"site": "doom", "kind": "die", "at": [2], "exit_code": 43}]
+        )
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           capture_output=True, text=True, timeout=120)
+        assert r.returncode == 43, r.stderr
+        assert "UNREACHABLE" not in r.stdout
+
+    def test_unknown_kind_rejected(self):
+        with pytest.raises(ValueError):
+            FaultSpec("s", "explode")
+
+
+# ----------------------------------------------------------------------
+# Retry / backoff
+# ----------------------------------------------------------------------
+class TestRetry:
+    def test_backoff_schedule_is_deterministic(self):
+        p = RetryPolicy(max_attempts=5, base_delay=0.1, multiplier=2.0,
+                        max_delay=0.5)
+        assert p.schedule() == [0.1, 0.2, 0.4, 0.5]
+
+    def test_absorbs_transient_then_succeeds(self):
+        calls = []
+
+        def flaky():
+            calls.append(1)
+            if len(calls) < 3:
+                raise TimeoutError("slow peer")
+            return "ok"
+
+        log = ResilienceLog()
+        from chainermn_tpu.resilience import log as rlog
+
+        rlog.attach(log)
+        try:
+            out = call_with_retry(
+                flaky, site="t", policy=RetryPolicy(4, base_delay=0.0)
+            )
+        finally:
+            rlog.detach(log)
+        assert out == "ok" and len(calls) == 3
+        assert len(log.events("retry")) == 2
+
+    def test_exhaustion_raises_with_diagnostics(self):
+        def always():
+            raise TimeoutError("never")
+
+        with pytest.raises(TransientCommError) as ei:
+            call_with_retry(always, site="s", peer=3,
+                            policy=RetryPolicy(3, base_delay=0.0))
+        e = ei.value
+        assert e.recoverable
+        assert e.site == "s" and e.peer == 3 and e.attempts == 3
+        assert e.elapsed is not None
+        assert "3 attempts" in str(e) and "peer=3" in str(e)
+
+    def test_unclassified_error_propagates_immediately(self):
+        calls = []
+
+        def broken():
+            calls.append(1)
+            raise ValueError("not transient")
+
+        with pytest.raises(ValueError):
+            call_with_retry(broken, site="s",
+                            policy=RetryPolicy(4, base_delay=0.0))
+        assert len(calls) == 1  # no blind retries of unknown failures
+
+    def test_jax_deadline_text_is_transient(self):
+        from chainermn_tpu.resilience.retry import is_transient
+
+        assert is_transient(RuntimeError("DEADLINE_EXCEEDED: kv get"))
+        assert not is_transient(RuntimeError("INVALID_ARGUMENT"))
+
+
+# ----------------------------------------------------------------------
+# Obj store + collectives under injection (8-rank single controller)
+# ----------------------------------------------------------------------
+class TestObjStoreResilience:
+    def test_transient_recv_timeout_is_retried(self, comm):
+        with inject_faults(
+            [FaultSpec("obj_store.recv", "timeout", at=[1])]
+        ) as inj:
+            comm.send_obj({"x": 1}, dest=2, tag=9)
+            assert comm.recv_obj(source=-1, tag=9, dest=2) == {"x": 1}
+        assert len(inj.log.events("fault_injected")) == 1
+
+    def test_retry_exhaustion_names_site_and_attempts(self, comm):
+        with inject_faults(
+            [FaultSpec("obj_store.recv", "timeout", at=[1, 2, 3, 4])]
+        ):
+            comm.send_obj("y", dest=0, tag=3)
+            with pytest.raises(TransientCommError) as ei:
+                comm.recv_obj(source=-1, tag=3, dest=0)
+        assert ei.value.site == "obj_store.recv"
+        assert ei.value.attempts == 4
+
+    def test_truncated_payload_is_classified(self, comm):
+        with inject_faults(
+            [FaultSpec("obj_store.send", "truncate", at=[1])]
+        ):
+            comm.send_obj({"big": list(range(1000))}, dest=1, tag=4)
+            with pytest.raises(PayloadCorruptionError) as ei:
+                comm.recv_obj(source=-1, tag=4, dest=1)
+        assert ei.value.recoverable
+
+    def test_bcast_obj_timeout_retried(self, comm):
+        with inject_faults(
+            [FaultSpec("obj_store.exchange", "timeout", at=[1])]
+        ):
+            assert comm.bcast_obj("payload") == "payload"
+
+    def test_barrier_timeout_retried(self, comm):
+        with inject_faults([FaultSpec("barrier", "timeout", at=[1])]) as inj:
+            comm.barrier()
+        assert inj.call_count("barrier") == 2  # fault + clean retry
+
+
+class TestCollectiveInjection:
+    def test_allreduce_timeout_retried_result_correct(self, comm):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        with inject_faults(
+            [FaultSpec("collective.allreduce", "timeout", at=[1])]
+        ) as inj:
+            out = np.asarray(comm.allreduce(x, op="sum"))
+        np.testing.assert_allclose(out, np.full((8, 1), 28.0))
+        assert len(inj.log.events("fault_injected")) == 1
+
+    def test_unclassified_collective_error_propagates(self, comm):
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        with inject_faults(
+            [FaultSpec("collective.allgather", "error", at=[1])]
+        ):
+            with pytest.raises(RuntimeError, match="injected error"):
+                comm.allgather(x)
+
+    def test_no_injector_no_interference(self, comm):
+        # the same calls with the injector inactive (the hot path)
+        x = np.arange(8, dtype=np.float32).reshape(8, 1)
+        np.testing.assert_allclose(
+            np.asarray(comm.allreduce(x, op="sum")),
+            np.full((8, 1), 28.0),
+        )
+
+
+# ----------------------------------------------------------------------
+# Cross-rank non-finite step guard (8-device virtual mesh)
+# ----------------------------------------------------------------------
+def _guard_pieces(comm, nonfinite):
+    lr = 0.1
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(lr), comm)
+    step = build_train_step(comm, loss_fn, opt, donate=False,
+                            nonfinite=nonfinite)
+    params, opt_state = step.place(
+        {"w": jnp.zeros((4,))}, opt.init({"w": jnp.zeros((4,))})
+    )
+    rows = np.stack(
+        [np.full((4,), float(i), np.float32) for i in range(comm.size)]
+    )
+    bad = rows.copy()
+    bad[3, 2] = np.nan  # non-finite on ONE shard of the mesh
+
+    def w_at(k):  # closed form from w0 = 0
+        c = float(np.mean(np.arange(comm.size)))
+        return c * (1.0 - (1.0 - lr) ** k)
+
+    return step, params, opt_state, rows, bad, w_at
+
+
+class TestNonfiniteStepGuard:
+    def test_skip_is_agreed_and_params_roll_forward(self, comm):
+        step, params, opt_state, rows, bad, w_at = _guard_pieces(
+            comm, "skip"
+        )
+        params, opt_state, m1 = step(params, opt_state, rows)
+        assert float(m1["grads_finite"]) == 1.0
+        params, opt_state, m2 = step(params, opt_state, bad)
+        assert float(m2["grads_finite"]) == 0.0
+        np.testing.assert_allclose(  # NaN step skipped on EVERY rank
+            np.asarray(params["w"]), np.full((4,), w_at(1)), rtol=1e-6
+        )
+        params, opt_state, m3 = step(params, opt_state, rows)
+        assert float(m3["grads_finite"]) == 1.0
+        np.testing.assert_allclose(  # training continued cleanly
+            np.asarray(params["w"]), np.full((4,), w_at(2)), rtol=1e-6
+        )
+        assert not np.isnan(np.asarray(params["w"])).any()
+
+    def test_warn_policy_applies_the_step(self, comm):
+        step, params, opt_state, rows, bad, _ = _guard_pieces(
+            comm, "warn"
+        )
+        params, opt_state, m = step(params, opt_state, bad)
+        assert float(m["grads_finite"]) == 0.0
+        assert np.isnan(np.asarray(params["w"])).any()
+
+    def test_guard_off_means_no_metric(self, comm):
+        step, params, opt_state, rows, _, _ = _guard_pieces(comm, None)
+        _, _, m = step(params, opt_state, rows)
+        assert "grads_finite" not in m
+        assert step.nonfinite_policy is None
+
+    def test_invalid_policy_rejected(self, comm):
+        def loss_fn(params, batch):
+            return jnp.sum(params["w"] * batch.mean())
+
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+        with pytest.raises(ValueError, match="nonfinite"):
+            build_train_step(comm, loss_fn, opt, nonfinite="explode")
+
+
+# ----------------------------------------------------------------------
+# Trainer: policy host side + auto-resume
+# ----------------------------------------------------------------------
+def _make_trainer(comm, tmp, *, nonfinite=None, batches=None,
+                  stop=(6, "iteration"), ckpt_name="rckpt"):
+    lr = 0.1
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(lr), comm)
+    step = build_train_step(comm, loss_fn, opt, donate=False,
+                            nonfinite=nonfinite)
+    params, opt_state = step.place(
+        {"w": jnp.zeros((4,))}, opt.init({"w": jnp.zeros((4,))})
+    )
+    if batches is None:
+        batches = [np.full((4,), float(i), np.float32)
+                   for i in range(comm.size)]
+    it = SerialIterator(batches, comm.size, shuffle=False)
+    trainer = Trainer(Updater(it, step, params, opt_state),
+                      stop_trigger=stop)
+    if tmp is not None:
+        ckpt = cmn.create_multi_node_checkpointer(
+            ckpt_name, comm, path=str(tmp)
+        )
+        trainer.extend(ckpt, trigger=(1, "iteration"))
+    return trainer
+
+
+class TestTrainerGuardPolicies:
+    def test_skip_records_event(self, comm):
+        tr = _make_trainer(comm, None, nonfinite="skip",
+                           stop=(2, "iteration"))
+        # iteration 2's batch carries a NaN
+        bad = [np.full((4,), 1.0, np.float32) for _ in range(comm.size)]
+        bad[0] = np.full((4,), np.nan, np.float32)
+        tr.updater.iterator = SerialIterator(
+            [np.full((4,), 1.0, np.float32)] * comm.size + bad,
+            comm.size, shuffle=False,
+        )
+        tr.run()
+        evs = tr.resilience_log.events("nonfinite_step")
+        assert len(evs) == 1 and evs[0].info["iteration"] == 2
+
+    def test_abort_raises_step_diverged(self, comm):
+        tr = _make_trainer(comm, None, nonfinite="abort",
+                           stop=(2, "iteration"))
+        bad = [np.full((4,), np.nan, np.float32)] * comm.size
+        tr.updater.iterator = SerialIterator(bad, comm.size, shuffle=False)
+        with pytest.raises(StepDivergedError):
+            tr.run()
+        assert not tr.resilience_log.events("restart")
+
+    def test_abort_is_not_auto_resumed(self, comm, tmp_path):
+        # StepDivergedError is non-recoverable: max_restarts must NOT
+        # absorb it (restarting would diverge identically)
+        tr = _make_trainer(comm, tmp_path, nonfinite="abort",
+                           stop=(2, "iteration"))
+        bad = [np.full((4,), np.nan, np.float32)] * comm.size
+        tr.updater.iterator = SerialIterator(bad, comm.size, shuffle=False)
+        with pytest.raises(StepDivergedError):
+            tr.run(max_restarts=5)
+
+    def test_warn_policy_warns(self, comm):
+        tr = _make_trainer(comm, None, nonfinite="warn",
+                           stop=(1, "iteration"))
+        bad = [np.full((4,), np.nan, np.float32)] * comm.size
+        tr.updater.iterator = SerialIterator(bad, comm.size, shuffle=False)
+        with pytest.warns(UserWarning, match="non-finite"):
+            tr.run()
+
+
+class TestAutoResume:
+    def test_transient_fault_resumes_and_matches_oracle(self, comm,
+                                                        tmp_path):
+        oracle = _make_trainer(comm, tmp_path / "a", ckpt_name="o")
+        oracle.run()
+        w_oracle = np.asarray(oracle.updater.params["w"]).copy()
+
+        tr = _make_trainer(comm, tmp_path / "b")
+        with inject_faults(
+            [FaultSpec("trainer.update", "timeout", at=[4])]
+        ):
+            tr.run(max_restarts=2)
+        assert tr.iteration == 6
+        assert tr.restarts == 1
+        np.testing.assert_allclose(
+            np.asarray(tr.updater.params["w"]), w_oracle, rtol=1e-6
+        )
+        counts = tr.resilience_log.counts
+        assert counts["restart"] == 1
+        assert counts["fault_injected"] >= 1
+        (restart,) = tr.resilience_log.events("restart")
+        assert restart.info["restored_step"] == 3
+
+    def test_budget_exhaustion_raises(self, comm, tmp_path):
+        tr = _make_trainer(comm, tmp_path)
+        with inject_faults(
+            [FaultSpec("trainer.update", "timeout", at=[2, 3, 4, 5, 6])]
+        ):
+            with pytest.raises(RestartBudgetExceededError) as ei:
+                tr.run(max_restarts=1)
+        assert not ei.value.recoverable
+        assert tr.restarts == 1  # budget spent before giving up
+        assert isinstance(ei.value.__cause__, TransientCommError)
+
+    def test_default_budget_is_zero(self, comm, tmp_path):
+        # max_restarts=0 (default): auto-resume never engages, and the
+        # ORIGINAL recoverable error propagates unchanged (pre-resilience
+        # behavior) so outer layers can apply their own policy
+        tr = _make_trainer(comm, tmp_path)
+        with inject_faults(
+            [FaultSpec("trainer.update", "timeout", at=[2])]
+        ):
+            with pytest.raises(TransientCommError):
+                tr.run()
+        assert tr.restarts == 0
+
+    def test_resume_without_checkpointer_continues(self, comm):
+        # no checkpointer extension: state is still consistent (the
+        # faulted update never mutated params), so training continues
+        # from the in-flight state rather than failing
+        tr = _make_trainer(comm, None)
+        with inject_faults(
+            [FaultSpec("trainer.update", "timeout", at=[3])]
+        ):
+            tr.run(max_restarts=1)
+        assert tr.iteration == 6
+        (restart,) = tr.resilience_log.events("restart")
+        assert restart.info["restored_step"] is None
+
+    def test_corruption_is_recoverable_end_to_end(self, comm, tmp_path):
+        # a truncated control-plane payload inside an update surfaces as
+        # PayloadCorruptionError (recoverable) and auto-resume absorbs it
+        tr = _make_trainer(comm, tmp_path)
+        orig_update = tr.updater.update.__func__
+
+        def update_with_exchange(self_):
+            # an obj exchange rides along with the update; call 4's send
+            # is truncated by the spec below
+            tr2 = getattr(self_, "_exchange_count", 0) + 1
+            self_._exchange_count = tr2
+            comm.send_obj({"hb": tr2}, dest=0, tag=77)
+            comm.recv_obj(source=-1, tag=77, dest=0)
+            orig_update(self_)
+
+        tr.updater.update = update_with_exchange.__get__(tr.updater)
+        with inject_faults(
+            [FaultSpec("obj_store.send", "truncate", at=[4])]
+        ):
+            tr.run(max_restarts=1)
+        assert tr.iteration == 6
+        assert tr.restarts == 1
+
+
+class TestEvaluatorReporting:
+    def test_resilience_counts_surface_in_observation(self, comm,
+                                                      tmp_path):
+        from chainermn_tpu.extensions.evaluator import Evaluator
+
+        # NaN batch at iteration 2; the guard's deferred host read
+        # consumes its flag during iteration 3, so the evaluator firing
+        # at iteration 4 sees the counter
+        tr = _make_trainer(comm, tmp_path, nonfinite="skip",
+                           stop=(4, "iteration"))
+        bad = [np.full((4,), 1.0, np.float32) for _ in range(comm.size)]
+        bad[0] = np.full((4,), np.nan, np.float32)
+        tr.updater.iterator = SerialIterator(
+            [np.full((4,), 1.0, np.float32)] * comm.size + bad
+            + [np.full((4,), 1.0, np.float32)] * (2 * comm.size),
+            comm.size, shuffle=False,
+        )
+
+        def metric_fn(params, batch):
+            return {"zero": jnp.mean(batch) * 0.0}
+
+        ev = Evaluator(
+            lambda: iter(
+                [np.ones((comm.size, 4), np.float32)]
+            ),
+            metric_fn, comm,
+        )
+        tr.extend(ev, trigger=(4, "iteration"))
+        tr.run()
+        assert tr.observation["resilience/nonfinite_step"] == 1
+
+
+class TestExceptHookTaxonomy:
+    def test_hook_prints_structured_diagnostics(self):
+        from conftest import subprocess_env
+
+        code = (
+            "import jax\n"
+            "jax.config.update('jax_platforms', 'cpu')\n"
+            "import chainermn_tpu as cmn\n"
+            "cmn.global_except_hook.add_hook()\n"
+            "from chainermn_tpu.resilience import TransientCommError\n"
+            "raise TransientCommError('boom', site='obj_store.recv',\n"
+            "                         peer=1, attempts=4, elapsed=2.5)\n"
+        )
+        r = subprocess.run(
+            [sys.executable, "-c", code], env=subprocess_env(1),
+            capture_output=True, text=True, timeout=240,
+        )
+        assert r.returncode != 0
+        assert "resilience: kind=TransientCommError" in r.stderr
+        assert "site=obj_store.recv" in r.stderr
+        assert "attempts=4" in r.stderr

@@ -123,9 +123,13 @@ def _time_steps(run_fn, steps, warmup=1):
 
 def _burned_kloop(run_k, k, repeats=2):
     """Burn-in + paired-k/2k timing of a k-steps-in-one-dispatch
-    callable; seconds per step.  The burn loop's first call absorbs
-    compilation, then ``_BURN_S`` of device activity stabilizes the
-    tunneled backend's decaying per-dispatch cost before timing."""
+    callable; returns ``(seconds_per_step, samples)`` — the per-repeat
+    samples feed every row's min-of-N spread record (round 6: the
+    native-input row's ``n_measurements``/``spread_max_over_min``
+    protocol extended to ALL rows, VERDICT r5 #1).  The burn loop's
+    first call absorbs compilation, then ``_BURN_S`` of device activity
+    stabilizes the tunneled backend's decaying per-dispatch cost before
+    timing."""
     if _BURN_S > 0:
         import time as _t
 
@@ -133,12 +137,34 @@ def _burned_kloop(run_k, k, repeats=2):
         t_end = _t.perf_counter() + _BURN_S
         while _t.perf_counter() < t_end:
             _force(run_k(max(k // 2, 1)))
-    dt, _samples = _time_kloop(run_k, k, repeats)
-    return dt
+    return _time_kloop(run_k, k, repeats)
+
+
+def _spread_fields(samples):
+    """min-of-N disclosure for one timed row: how many paired
+    measurements were taken and how far apart they landed (transport
+    noise only ADDS time, so the min is the number and the spread is
+    the honesty bar next to it)."""
+    pos = [s for s in samples if s > 0]
+    out = {"n_measurements": len(samples)}
+    if len(pos) >= 2:
+        out["spread_max_over_min"] = round(max(pos) / min(pos), 3)
+    return out
+
+
+def _copy_spread(dst, src, suffix=""):
+    """Propagate one sub-record's spread disclosure into a config row
+    (one implementation so no row can silently drop a field; ``suffix``
+    distinguishes multi-leg rows like the vgg on/off A/B)."""
+    if "n_measurements" in src and "n_measurements" not in dst:
+        dst["n_measurements"] = src["n_measurements"]
+    if "spread_max_over_min" in src:
+        dst["spread_max_over_min" + suffix] = src["spread_max_over_min"]
 
 
 def _kloop_step_time(step, params, opt_state, batch, k, repeats=2):
-    """Seconds per train step with k steps inside ONE jitted fori_loop.
+    """``(seconds_per_step, samples)`` with k steps inside ONE jitted
+    fori_loop.
 
     Round 3/4 found per-dispatch python-loop timing carries +-5-30 %
     tunnel noise even with paired k/2k readbacks (the vgg16_db ratio
@@ -231,13 +257,16 @@ def bench_image_model(comm, model, *, image, batch, n_classes=1000,
         double_buffering=double_buffering,
     )
     params, opt_state, batch_dev = args
-    step_time = _kloop_step_time(step, params, opt_state, batch_dev, steps)
+    step_time, samples = _kloop_step_time(
+        step, params, opt_state, batch_dev, steps
+    )
     flops = _flops_of(jitted, *args)
     peak = _peak_flops(comm.devices[0])
     out = {
         "images_per_sec": batch / step_time,
         "images_per_sec_per_chip": batch / step_time / comm.size,
         "step_time_ms": step_time * 1e3,
+        **_spread_fields(samples),
     }
     if flops:
         out["model_tflops_per_step"] = flops / 1e12
@@ -287,7 +316,9 @@ def config_mnist_flat():
     # (driver captures ranged 1M-7M samples/s under per-dispatch noise;
     # the k-loop measures 14.9M +-0.2%).
     k = steps * (10 if SMOKE else 100)
-    step_time = _kloop_step_time(step, params, opt_state, (bx, by), k)
+    step_time, samples = _kloop_step_time(
+        step, params, opt_state, (bx, by), k
+    )
     return {
         "metric": "mnist_mlp_flat_samples_per_sec_per_chip",
         "value": round(batch / step_time / comm.size, 2),
@@ -295,6 +326,7 @@ def config_mnist_flat():
         "step_time_ms": round(step_time * 1e3, 3),
         "communicator": "flat",
         "k_loop": k,
+        **_spread_fields(samples),
         "config_fingerprint": _fingerprint(
             arch="mlp1000", b=batch, dtype="bf16"
         ),
@@ -326,11 +358,45 @@ def config_resnet50_hierarchical():
             arch=model_cls.__name__, b=batch, img=image, bn="bf16"
         ),
     }
+    _copy_spread(out, r)
     if "model_tflops_per_step" in r:
         out["model_tflops_per_step"] = round(r["model_tflops_per_step"], 2)
     if "mfu" in r:
         out["mfu"] = round(r["mfu"], 4)
     return out
+
+
+def _uint8_link_ceiling(dev, batch, image, k=8):
+    """SAME-RUN uint8 link-ceiling probe (VERDICT r5 #7): measure the
+    H2D bandwidth of exactly the wire payload the native-input config
+    ships (a batch of image-size uint8 crops) at the same transport
+    instant as the end-to-end number.  The r5 record compared its
+    end-to-end draw against a ceiling measured hours earlier on a link
+    that drifts 2-6x across a day; recording
+    ``fraction_of_link_ceiling`` from a same-run probe removes that
+    confound from the committed capture."""
+    sys.path.insert(0, os.path.join(
+        os.path.dirname(os.path.abspath(__file__)), "benchmarks"))
+    try:
+        import h2d_bench
+    finally:
+        sys.path.pop(0)
+    import numpy as np
+
+    rng = np.random.RandomState(0)
+    arrs = [
+        rng.randint(0, 256, size=(batch, image, image, 3)).astype(np.uint8)
+        for _ in range(k)
+    ]
+    probe = h2d_bench._scalar_probe()
+    rtt = h2d_bench.measure_rtt(dev)
+    bw = h2d_bench.measure_h2d(dev, probe, arrs, depth=2)
+    t_batch = arrs[0].nbytes / bw + rtt
+    return {
+        "link_uint8_MBps": round(bw / 1e6, 1),
+        "link_rtt_ms": round(rtt * 1e3, 2),
+        "link_ceiling_img_per_sec_uint8": round(batch / t_batch, 1),
+    }
 
 
 def config_resnet50_native_input():
@@ -439,9 +505,24 @@ def config_resnet50_native_input():
         it.close()  # retire the generator's held slot before the loader
         loader.close()
     dt = min(dts)
+    # same-run link-ceiling probe; its failure must not kill the row.
+    # GLOBAL batch rate vs GLOBAL-batch ceiling (the probe ships the
+    # whole batch over the one host link, so the per-chip rate would
+    # understate the fraction by comm.size on multi-chip hosts)
+    link = {}
+    try:
+        link = _uint8_link_ceiling(comm.devices[0], batch, image)
+        ceiling = link["link_ceiling_img_per_sec_uint8"]
+        if ceiling > 0:
+            link["fraction_of_link_ceiling"] = round(
+                (batch / dt) / ceiling, 3
+            )
+    except Exception as e:
+        link = {"link_ceiling_error": f"{type(e).__name__}: {e}"}
     return {
         "metric": "resnet50_native_input_images_per_sec_per_chip",
         "value": round(batch / dt / comm.size, 2),
+        **link,
         "unit": "images/sec/chip (incl. C++ input pipeline, uint8 wire, "
                 "double-buffered H2D; min of N)",
         "step_time_ms": round(dt * 1e3, 2),
@@ -482,7 +563,7 @@ def config_vgg16_double_buffering():
         )
         out["on" if db else "off"] = r
     on, off = out["on"], out["off"]
-    return {
+    rec = {
         "metric": "vgg16_double_buffering_speedup",
         "value": round(
             on["images_per_sec_per_chip"] / off["images_per_sec_per_chip"],
@@ -502,6 +583,19 @@ def config_vgg16_double_buffering():
             arch="VGG16", b_per_chip=batch, img=image
         ),
     }
+    # row-level disclosure first (the bench-wide protocol fields every
+    # row must carry): total samples across both legs, and the spread
+    # is the WORSE leg's — the on/off ratio is only as trustworthy as
+    # its noisier side.  Per-leg fields follow, suffixed.
+    rec["n_measurements"] = (off.get("n_measurements", 0)
+                             + on.get("n_measurements", 0))
+    spreads = [r["spread_max_over_min"] for r in (off, on)
+               if "spread_max_over_min" in r]
+    if spreads:
+        rec["spread_max_over_min"] = max(spreads)
+    _copy_spread(rec, off, "_off")
+    _copy_spread(rec, on, "_on")
+    return rec
 
 
 def config_resnet50_mnbn():
@@ -532,6 +626,7 @@ def config_resnet50_mnbn():
             arch=model_cls.__name__, b=batch, img=image, bn="mnbn_bf16"
         ),
     }
+    _copy_spread(out, r)
     if "mfu" in r:
         out["mfu"] = round(r["mfu"], 4)
     return out
@@ -566,8 +661,9 @@ def _bench_lm(model, loss_fn, comm, *, batch, seq, vocab,
         np.random.RandomState(0).randint(0, vocab, (batch, seq)), jnp.int32
     )
     bt = jax.device_put(toks, step.batch_sharding)
-    step_time = _kloop_step_time(step, params, opt_state, bt, steps)
-    extra = {}
+    step_time, samples = _kloop_step_time(step, params, opt_state, bt,
+                                          steps)
+    extra = _spread_fields(samples)
     if with_flops:
         flops = _flops_of(
             step.get_jitted(params, opt_state), params, opt_state, bt
@@ -654,8 +750,54 @@ def config_transformer_lm():
             h=heads, v=vocab,
             # derived from the SAME variables passed to the kernel so a
             # retune cannot silently desynchronize the recorded geometry
-            attn=(f"flash_f{fbq}x{fbk}_b{bbq}x{bbk}"
+            # ("split" = the round-6 diagonal-split taxonomy)
+            attn=(f"flash_split_f{fbq}x{fbk}_b{bbq}x{bbk}"
                   if not SMOKE else "xla"),
+        ),
+        **extra,
+    }
+
+
+def _long_seq_lm_config(*, seq, smoke_seq, batch_env, batch_default):
+    """Shared body of the long-sequence LM tiers (seq 8192 / 16384):
+    identical model, 1024x1024 flash blocks (the r4/r5 sweeps' choice
+    at both lengths), analytic attention FLOPs and fingerprint — only
+    the length, batch knob and metric strings differ, so a fix to one
+    tier cannot miss the other."""
+    import chainermn_tpu as cmn
+    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+
+    comm = cmn.create_communicator("tpu")
+    vocab, d_model, n_layers = _lm_dims()
+    s = smoke_seq if SMOKE else seq
+    batch = _env(batch_env, batch_default) * comm.size
+    heads = _lm_heads(d_model)
+    model = TransformerLM(
+        vocab_size=vocab, d_model=d_model, n_heads=heads,
+        n_layers=n_layers, max_len=s,
+        attention_fn=None if SMOKE else flash_attention_fn(
+            block_q=1024, block_k=1024
+        ),
+    )
+    attn = None if SMOKE else _flash_attn_tflops(
+        batch, heads, s, d_model // heads, n_layers
+    )
+    tps, step_time, extra = _bench_lm(
+        model, lambda p, b: lm_loss(model.apply(p, b), b), comm,
+        batch=batch, seq=s, vocab=vocab, with_flops=True,
+        attn_tflops=attn,
+    )
+    return {
+        "metric": f"transformer_lm_seq{seq}_tokens_per_sec_per_chip",
+        "value": round(tps, 1),
+        "unit": f"tokens/sec/chip (flash attention, bf16, seq {seq})",
+        "step_time_ms": round(step_time * 1e3, 2),
+        "seq_len": s,
+        "config_fingerprint": _fingerprint(
+            arch="dense_lm", b=batch, s=s, d=d_model, L=n_layers,
+            h=heads, v=vocab,
+            attn="flash_split_1024x1024" if not SMOKE else "xla",
         ),
         **extra,
     }
@@ -669,43 +811,23 @@ def config_transformer_lm_long():
     (MFU 0.61) there vs 67.8k at the round-3 defaults (b1, 256x512
     blocks, which were tuned at seq 2048); 1024x2048 blocks exceed the
     16 MB scoped-vmem limit and b4 OOMs HBM."""
-    import chainermn_tpu as cmn
-    from chainermn_tpu.models.transformer import TransformerLM, lm_loss
-    from chainermn_tpu.ops.pallas_attention import flash_attention_fn
+    return _long_seq_lm_config(seq=8192, smoke_seq=256,
+                               batch_env="BENCH_LM_LONG_BATCH",
+                               batch_default=2)
 
-    comm = cmn.create_communicator("tpu")
-    vocab, d_model, n_layers = _lm_dims()
-    seq = 256 if SMOKE else 8192
-    batch = _env("BENCH_LM_LONG_BATCH", 2) * comm.size
-    heads = _lm_heads(d_model)
-    model = TransformerLM(
-        vocab_size=vocab, d_model=d_model, n_heads=heads,
-        n_layers=n_layers, max_len=seq,
-        attention_fn=None if SMOKE else flash_attention_fn(
-            block_q=1024, block_k=1024
-        ),
-    )
-    attn = None if SMOKE else _flash_attn_tflops(
-        batch, heads, seq, d_model // heads, n_layers
-    )
-    tps, step_time, extra = _bench_lm(
-        model, lambda p, b: lm_loss(model.apply(p, b), b), comm,
-        batch=batch, seq=seq, vocab=vocab, with_flops=True,
-        attn_tflops=attn,
-    )
-    return {
-        "metric": "transformer_lm_seq8192_tokens_per_sec_per_chip",
-        "value": round(tps, 1),
-        "unit": "tokens/sec/chip (flash attention, bf16, seq 8192)",
-        "step_time_ms": round(step_time * 1e3, 2),
-        "seq_len": seq,
-        "config_fingerprint": _fingerprint(
-            arch="dense_lm", b=batch, s=seq, d=d_model, L=n_layers,
-            h=heads, v=vocab,
-            attn="flash" if not SMOKE else "xla",
-        ),
-        **extra,
-    }
+
+def config_transformer_lm_xl():
+    """seq-16384 tier, promoted to a first-class fingerprinted config
+    (VERDICT r5 #4: the 61.3k tok/s round-5 result lived only in the
+    perf doc's prose — a regression there was invisible to the bench).
+    Batch 1, 1024x1024 flash blocks (the r5 sweep's choice at this
+    length); attention is ~72% of the analytic FLOPs here, and under
+    the diagonal-split kernel 120 of 136 live blocks per program run
+    the unmasked fast branch (block_census) — the config where the
+    split's win is largest."""
+    return _long_seq_lm_config(seq=16384, smoke_seq=512,
+                               batch_env="BENCH_LM_XL_BATCH",
+                               batch_default=1)
 
 
 def config_moe_lm():
@@ -755,7 +877,7 @@ def config_moe_lm():
         "config_fingerprint": _fingerprint(
             arch="moe_lm", b=batch, s=seq, d=d_model, L=n_layers,
             h=heads, v=vocab, E=n_experts, k=2, every=2,
-            attn="flash" if not SMOKE else "xla",
+            attn="flash_split" if not SMOKE else "xla",
         ),
         **extra,
     }
@@ -846,7 +968,9 @@ def config_seq2seq_mp():
         )
 
     k = steps * (2 if SMOKE else 10)
-    step_time = _burned_kloop(lambda n: ksteps(params, state, n)[2], k)
+    step_time, kloop_samples = _burned_kloop(
+        lambda n: ksteps(params, state, n)[2], k
+    )
     tokens = batch * seqlen * 2  # enc + dec
 
     # 2. eager per-stage dispatch (the chain's ergonomic tier): each
@@ -906,6 +1030,7 @@ def config_seq2seq_mp():
                 "both stages on the ONE chip - a dispatch-cost "
                 "measurement, not a pipeline)",
         "step_time_ms": round(step_time * 1e3, 2),
+        **_spread_fields(kloop_samples),
         "eager_per_stage_step_ms": round(eager_dt * 1e3, 1),
         "eager_vs_jit_dispatch_cost_x": round(eager_dt / step_time, 1),
         "pipeline_2stage_virtual_mesh": pipeline_rec,
@@ -975,6 +1100,7 @@ def main():
         ("resnet50_mnbn", config_resnet50_mnbn),
         ("transformer_lm", config_transformer_lm),
         ("transformer_lm_long", config_transformer_lm_long),
+        ("transformer_lm_xl", config_transformer_lm_xl),
         ("moe_lm", config_moe_lm),
         ("seq2seq_mp", config_seq2seq_mp),
         ("resnet50_native_input", config_resnet50_native_input),

@@ -61,7 +61,7 @@ def burn_in(seconds=10.0):
         force_completion(f(x))
 
 
-def bench_seq(seq, batch, heads, dim, causal, steps):
+def bench_seq(seq, batch, heads, dim, causal, steps, taxonomy_ab=False):
     rng = np.random.RandomState(0)
     shape = (batch, seq, heads, dim)
     q = jnp.asarray(rng.randn(*shape), jnp.bfloat16) * 0.3
@@ -123,6 +123,29 @@ def bench_seq(seq, batch, heads, dim, causal, steps):
         "bwd_flash_ms": (flash_g, (q, k, v)),
         "bwd_xla_ms": (xla_g, (q, k, v)),
     }
+    if taxonomy_ab:
+        # kernel-level diagonal-split A/B (round 6): the same op timed
+        # under taxonomy="legacy" (pre-split) — the purest per-block-
+        # type measurement, with no model around the kernel.  The split
+        # row is the default flash rows above.
+        def with_tax(tax):
+            fwd = jax.jit(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal, None, None, None, None, None, None,
+                    tax
+                ).sum()
+            )
+            bwd = full_grad(
+                lambda q, k, v: flash_attention(
+                    q, k, v, causal, None, None, None, None, None, None,
+                    tax
+                )
+            )
+            return fwd, bwd
+
+        leg_f, leg_g = with_tax("legacy")
+        variants["fwd_flash_legacy_ms"] = (leg_f, (q, k, v))
+        variants["bwd_flash_legacy_ms"] = (leg_g, (q, k, v))
     for name, (fn, fargs) in variants.items():
         try:
             res[name] = _time(fn, *fargs, steps=steps) * 1e3
@@ -144,6 +167,9 @@ def main():
     p.add_argument("--steps", type=int, default=10)
     p.add_argument("--causal", action=argparse.BooleanOptionalAction,
                    default=True)
+    p.add_argument("--taxonomy-ab", action="store_true",
+                   help="also time the pre-split (taxonomy=legacy) "
+                        "kernels — the kernel-level diagonal-split A/B")
     args = p.parse_args()
 
     dev = jax.devices()[0]
@@ -160,8 +186,9 @@ def main():
     for seq in args.seqs:
         for dim in args.dims:
             r = bench_seq(seq, args.batch, args.heads, dim,
-                          args.causal, args.steps)
-            print(json.dumps({
+                          args.causal, args.steps,
+                          taxonomy_ab=args.taxonomy_ab)
+            rec = {
                 "metric": "flash_attention_vs_xla",
                 "device": dev.device_kind,
                 "seq": seq,
@@ -178,7 +205,19 @@ def main():
                 "bwd_flash_ms": fmt(r["bwd_flash_ms"]),
                 "bwd_xla_ms": fmt(r["bwd_xla_ms"]),
                 "bwd_speedup": ratio(r["bwd_xla_ms"], r["bwd_flash_ms"]),
-            }), flush=True)
+            }
+            if args.taxonomy_ab:
+                rec.update({
+                    "fwd_flash_legacy_ms": fmt(r["fwd_flash_legacy_ms"]),
+                    "bwd_flash_legacy_ms": fmt(r["bwd_flash_legacy_ms"]),
+                    "fwd_split_speedup": ratio(
+                        r["fwd_flash_legacy_ms"], r["fwd_flash_ms"]
+                    ),
+                    "bwd_split_speedup": ratio(
+                        r["bwd_flash_legacy_ms"], r["bwd_flash_ms"]
+                    ),
+                })
+            print(json.dumps(rec), flush=True)
 
 
 if __name__ == "__main__":

@@ -15,6 +15,9 @@ one lever with the k-in-one-fori_loop harness:
                 buffer is 1 GB at b1 s8192 — exactly its claimed regime)
   no_attn       attention removed: how much of the step is attention?
   no_head       vocab-8 twin: how much is the LM head?
+  anatomy_*     per-block-type timing (round 6): the taxonomy triplet
+                legacy/split/interior at the b2 1024^2 default — the
+                diagonal-split kernel's segment-anatomy mode
 
 Usage: python benchmarks/longseq_tune.py [variants...]
 """
@@ -49,13 +52,14 @@ def _attn_tflops(batch):
 
 def time_variant(name, *, batch=None, loss="lm", attention="flash",
                  block_q=256, block_k=512, remat=False,
-                 bwd_block_q=None, bwd_block_k=None):
+                 bwd_block_q=None, bwd_block_k=None, taxonomy=None):
     if batch is None:
         batch = int(os.environ.get("TUNE_BATCH", "1"))
     attn = {
         "flash": flash_attention_fn(block_q=block_q, block_k=block_k,
                                     bwd_block_q=bwd_block_q,
-                                    bwd_block_k=bwd_block_k),
+                                    bwd_block_k=bwd_block_k,
+                                    taxonomy=taxonomy),
         "none": lambda q, k, v, causal, scale: q,
     }[attention]
     model = TransformerLM(
@@ -128,6 +132,17 @@ def time_variant(name, *, batch=None, loss="lm", attention="flash",
         "tokens_per_sec": round(batch * SEQ / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
     }
+    if attention == "flash":
+        # census of the geometry that ran (clamps applied) — see the
+        # caveat in transformer_mfu.py: a scoped-VMEM retry warning
+        # during the run invalidates this census for cost division.
+        from chainermn_tpu.ops.pallas_attention import launch_census
+
+        census = launch_census(SEQ, SEQ, D // HEADS, block_q, block_k,
+                               bwd_block_q, bwd_block_k)
+        out["taxonomy"] = taxonomy or "split"
+        out["block_census_fwd"] = census["fwd"]
+        out["block_census_bwd"] = census["bwd"]
     peak = _peak_flops(jax.devices()[0])
     if flops and peak:
         attn_tf = _attn_tflops(batch) if attention == "flash" else 0.0
@@ -181,6 +196,21 @@ VARIANTS.update({
     "b2_fwd1024x1024_bwd512x1024": lambda: time_variant(
         "b2_fwd1024x1024_bwd512x1024", batch=2, block_q=1024,
         block_k=1024, bwd_block_q=512, bwd_block_k=1024),
+    # round 6: SEGMENT ANATOMY at the seq-8192 default (b2, 1024^2 —
+    # census: 28 of 36 live blocks interior).  Same taxonomy triplet
+    # as benchmarks/transformer_mfu.py's anatomy_* rungs; at this
+    # length the interior fraction is 78%, so legacy-vs-split is the
+    # headline win and split-vs-interior bounds the leftover diagonal
+    # cost (8 blocks).  anatomy_interior is TIMING ONLY.
+    "anatomy_legacy": lambda: time_variant(
+        "anatomy_legacy", batch=2, block_q=1024, block_k=1024,
+        taxonomy="legacy"),
+    "anatomy_split": lambda: time_variant(
+        "anatomy_split", batch=2, block_q=1024, block_k=1024,
+        taxonomy="split"),
+    "anatomy_interior": lambda: time_variant(
+        "anatomy_interior", batch=2, block_q=1024, block_k=1024,
+        taxonomy="interior"),
 })
 
 

@@ -22,6 +22,11 @@ flash 1024x1024, adamw, fused lm_loss):
   blocks256x512  the r03 flash block geometry — the tuning delta
   xla_attn    XLA's fused attention instead of the Pallas kernel
   legacy_heads16 the r03 16-head/dh64 config — cross-round anchor
+  anatomy_*   SEGMENT-ANATOMY mode (round 6): the same step timed
+              under taxonomy=legacy/split/interior at fixed geometry —
+              the A/B deltas divide by the printed block census into
+              per-block-type costs (see the VARIANTS comment and
+              docs/performance.md "Diagonal-split kernel")
 
 Usage: python benchmarks/transformer_mfu.py [rung ...]   (TPU)
 """
@@ -65,12 +70,14 @@ def _readback(x):
 def time_variant(name, *, batch=8, loss="lm", attention="flash",
                  opt="adamw", n_heads=None, remat=False,
                  block_q=None, block_k=None, bwd_block_q=None,
-                 bwd_block_k=None, ln_dtype=jnp.float32):
+                 bwd_block_k=None, ln_dtype=jnp.float32,
+                 taxonomy=None):
     heads = n_heads or D // 128  # dh=128: the shipping config
     attn = {
         "flash": flash_attention_fn(block_q=block_q, block_k=block_k,
                                     bwd_block_q=bwd_block_q,
-                                    bwd_block_k=bwd_block_k),
+                                    bwd_block_k=bwd_block_k,
+                                    taxonomy=taxonomy),
         "none": lambda q, k, v, causal, scale: q,
         "xla": None,
     }[attention]
@@ -163,6 +170,24 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         "tokens_per_sec": round(batch * SEQ / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
     }
+    if attention == "flash":
+        # segment anatomy: the static block census this launch executes
+        # per (batch*head) program — what turns the taxonomy-rung A/B
+        # times into per-block-type costs (docs/performance.md
+        # "Diagonal-split kernel").  launch_census applies the same
+        # clamps the kernel does, so the printed census is the geometry
+        # that RAN, not the one requested — UNLESS the backward's
+        # scoped-VMEM retry warned and shrank mid-run (it prints a
+        # UserWarning naming both geometries); a capture that saw that
+        # warning must rerun with the shrunk blocks requested
+        # explicitly before dividing times by this census.
+        from chainermn_tpu.ops.pallas_attention import launch_census
+
+        census = launch_census(SEQ, SEQ, D // heads, block_q, block_k,
+                               bwd_block_q, bwd_block_k)
+        out["taxonomy"] = taxonomy or "split"
+        out["block_census_fwd"] = census["fwd"]
+        out["block_census_bwd"] = census["bwd"]
     if flops:
         total = flops + attn_tf * 1e12
         out["tflops_per_step"] = round(total / 1e12, 3)
@@ -203,6 +228,37 @@ VARIANTS = {
         bwd_block_q=1024, bwd_block_k=1024),
     "xla_attn": lambda: time_variant("xla_attn", attention="xla"),
     "legacy_heads16": lambda: time_variant("legacy_heads16", n_heads=16),
+    # ---- segment anatomy (round 6): per-block-type timing ----
+    # Three rungs at the SAME 1024^2 geometry (census fwd: 1 interior /
+    # 2 masked / 1 dead; bwd identical), differing only in taxonomy:
+    #   anatomy_legacy    every live block pays the masked path (the
+    #                     pre-split kernel — the r5 shipping cost)
+    #   anatomy_split     interior blocks take the fast branch (the
+    #                     shipping r6 kernel; == `full` but explicit)
+    #   anatomy_interior  ALL live blocks take the fast branch — a
+    #                     TIMING-ONLY floor (numerics wrong under the
+    #                     causal mask; never a training path)
+    # Per-block-type costs: with n_live live blocks and n_int interior,
+    #   masked-block overhead = (legacy - interior) / n_live
+    #   split win             =  legacy - split  (= overhead * n_int)
+    #   irreducible diagonal  =  split - interior (= overhead * n_diag)
+    # If split ~= interior, the remaining attention-segment gap to the
+    # dense program's MFU is the unmasked online-softmax VPU work
+    # itself — the measured kernel floor, not the diagonal handling.
+    "anatomy_legacy": lambda: time_variant(
+        "anatomy_legacy", block_q=1024, block_k=1024, taxonomy="legacy"),
+    "anatomy_split": lambda: time_variant(
+        "anatomy_split", block_q=1024, block_k=1024, taxonomy="split"),
+    "anatomy_interior": lambda: time_variant(
+        "anatomy_interior", block_q=1024, block_k=1024,
+        taxonomy="interior"),
+    # the shipping fwd geometry under the split kernel: at seq 2048,
+    # fwd 1024x2048 has ZERO interior blocks (both live blocks straddle
+    # the diagonal) while 1024^2 has 1 of 3 — whether the wider K
+    # stream still beats the fast branch is this A/B vs anatomy_split
+    "anatomy_ship_geometry": lambda: time_variant(
+        "anatomy_ship_geometry", block_q=1024, block_k=2048,
+        bwd_block_q=1024, bwd_block_k=1024, taxonomy="split"),
 }
 
 

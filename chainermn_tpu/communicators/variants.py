@@ -86,15 +86,34 @@ class HierarchicalCommunicator(XlaCommunicatorBase):
 
     def _build_mesh(self) -> Mesh:
         if not self.topology.is_uniform():
-            # Fall back to flat when nodes are ragged (reference would
-            # assert; we degrade gracefully and note it in repr).
-            return Mesh(np.array(self.devices, dtype=object), ("mn_intra",))
-        grid = self.topology.device_grid()
-        if grid.shape[0] == 1 and grid.shape[1] >= 2:
-            # Single node: emulate a 2-level layout so the hierarchical code
-            # path is still exercised (reference on one host: intra==size).
-            inter = 1
-            grid = grid.reshape(inter, -1)
+            # Ragged nodes (unequal chips per slice): the reference
+            # would assert; we degrade to a one-level mesh — but LOUDLY
+            # (a silent fallback turns every collective into a flat
+            # all-ring program while the operator believes the heavy
+            # phases ride intra-slice ICI), and the documented
+            # ('mn_inter', 'mn_intra') axis pair survives as a width-1
+            # inter axis, so param specs / shard_map code / tests
+            # written against the hierarchical axis names keep working
+            # through the degradation (a width-1 axis is a no-op in
+            # every collective).
+            import warnings
+
+            sizes = sorted(set(self.topology.intra_sizes))
+            warnings.warn(
+                "HierarchicalCommunicator: ragged topology (chips per "
+                f"slice/node: {sizes}) — the two-level ICI/DCN "
+                "factorization degrades to a flat mesh (width-1 "
+                "'mn_inter' axis kept for axis-name compatibility); "
+                "collectives will NOT be slice-staged.  Use uniform "
+                "slices, or an explicit device subset, to restore the "
+                "hierarchical schedule."
+            )
+            grid = np.array(self.devices, dtype=object).reshape(1, -1)
+        else:
+            # device_grid() is already (inter_size, intra_size); one
+            # node arrives as (1, n) — the degenerate two-level layout,
+            # so the hierarchical code path is exercised either way.
+            grid = self.topology.device_grid()
         return Mesh(grid, ("mn_inter", "mn_intra"))
 
 

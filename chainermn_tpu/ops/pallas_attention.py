@@ -19,6 +19,13 @@ dk/dv kernel sweeps query blocks, each recomputing its score tile in
 VMEM — training memory stays O(s), never O(s^2), and causally-dead
 blocks are skipped entirely.  Tiny compiled shapes (< one 128 lane tile)
 take a dense-recompute fallback instead.
+
+All three kernels are DIAGONAL-SPLIT (round 6): each (q block, k block)
+grid point is classified dead / interior / masked, and interior blocks
+(the fully-unmasked majority at long sequence) run a fast branch with
+no iota/mask/select work — see the "Block taxonomy" section below and
+docs/performance.md "Diagonal-split kernel".  The pre-split kernels
+are kept under ``taxonomy="legacy"`` as the bit-exact reference.
 """
 
 from __future__ import annotations
@@ -120,12 +127,174 @@ def _clamp_blocks_for_dim(block_q, block_k, d: int, warn: bool = True,
 
 
 # ----------------------------------------------------------------------
+# Block taxonomy (the diagonal split)
+# ----------------------------------------------------------------------
+# Every (q block, k block) grid point falls into exactly one class:
+#
+#   dead      strictly above the causal diagonal — contributes nothing;
+#             skipped entirely (no matmul, no softmax) since round 1.
+#   interior  fully unmasked: every (q, k) pair in the block is causally
+#             live and unpadded.  The fast branch — no iota, no mask
+#             compare, no select; and at the FIRST k step (where the
+#             running max is provably monotone because the running
+#             state is empty) no rescale of the accumulator either.
+#   masked    the diagonal-straddling blocks plus the ragged-tail
+#             blocks (k or q padding) — the only blocks that pay the
+#             masked online-softmax path.  Per q row this is ~1/q_blocks
+#             of the live work at square geometry.
+#
+# ``taxonomy`` selects the kernel family:
+#   "split"    (default) classify at run time, route interior blocks
+#              down the fast branch — numerically EXACT vs "legacy"
+#              (the mask it skips is provably all-true there).
+#   "legacy"   the pre-split kernels, kept verbatim as the in-tree
+#              reference: every live block runs the masked path.
+#   "interior" TIMING ONLY: force every live block down the unmasked
+#              fast branch.  Numerics are intentionally wrong for
+#              causal/ragged inputs — this is the segment-anatomy
+#              bench's per-block-type floor, never a training path.
+_TAXONOMIES = ("split", "legacy", "interior")
+
+
+def _resolve_taxonomy(taxonomy):
+    t = "split" if taxonomy is None else taxonomy
+    if t not in _TAXONOMIES:
+        raise ValueError(
+            f"taxonomy must be one of {_TAXONOMIES} (or None), got "
+            f"{taxonomy!r}"
+        )
+    return t
+
+
+def _when(pred):
+    """``pl.when`` that folds statically-known predicates: a Python
+    ``True`` emits the body unconditionally, ``False`` emits nothing
+    (e.g. the masked branch of a non-causal, non-ragged launch)."""
+    if isinstance(pred, bool):
+        if pred:
+            return lambda f: f()
+        return lambda f: None
+    return pl.when(pred)
+
+
+def _and(a, b):
+    if isinstance(a, bool):
+        return b if a else False
+    if isinstance(b, bool):
+        return a if b else False
+    return jnp.logical_and(a, b)
+
+
+def _not(a):
+    return (not a) if isinstance(a, bool) else jnp.logical_not(a)
+
+
+def _block_class(first_q, first_k, *, s_k, s_kp, causal, block_q, block_k,
+                 force_interior=False, s_q=None, s_qp=None):
+    """THE taxonomy predicate: (interior, masked) for one block.
+
+    The single source of truth for block classification — the split
+    kernels evaluate it on traced program ids, :func:`block_census`
+    on Python ints (``_and``/``_not`` fold either way), so the census
+    cannot drift from what the kernels execute.
+
+    The forward leaves ``s_q``/``s_qp`` unset: it never masks q
+    (padded q rows are garbage the caller slices off — same contract
+    as legacy).  The backward kernels pass them, so a ragged q tail
+    reclassifies its whole block row as masked (its recomputed p would
+    otherwise contribute to dk/dv and its garbage lse to dq).  Each
+    tail predicate is emitted only when the corresponding padding
+    exists (static), so an aligned launch never compares indices."""
+    live = (first_k <= first_q + block_q - 1) if causal else True
+    needs_mask = (first_k + block_k - 1 > first_q) if causal else False
+    if s_k < s_kp:
+        needs_mask = needs_mask | (first_k + block_k > s_k)
+    if s_q is not None and s_q < s_qp:
+        needs_mask = needs_mask | (first_q + block_q > s_q)
+    if force_interior:
+        return live, False
+    return _and(live, _not(needs_mask)), _and(live, needs_mask)
+
+
+def block_census(s_q: int, s_k: int, block_q: int, block_k: int,
+                 causal: bool, kind: str = "fwd") -> dict:
+    """Static census of the block taxonomy for one (batch*head) program
+    — the analytic side of the segment-anatomy bench (how many blocks
+    of each class a launch executes, so A/B step times divide into
+    per-block-type costs).
+
+    ``kind``: the forward kernel masks only the k axis (padded q rows
+    are garbage that gets sliced off), the backward kernels mask q too
+    (padded q rows would otherwise contribute to dk/dv) — so a ragged
+    q tail reclassifies its row of blocks only for ``kind="bwd"``.
+    Mirrors the kernels' run-time predicates exactly
+    (``test_block_census_matches_brute_force``)."""
+    if kind not in ("fwd", "bwd"):
+        raise ValueError(f"kind must be fwd/bwd, got {kind!r}")
+    s_qp, s_kp = _round_up(s_q, block_q), _round_up(s_k, block_k)
+    n_q, n_k = s_qp // block_q, s_kp // block_k
+    census = {"dead": 0, "interior": 0, "masked": 0,
+              "n_q_blocks": n_q, "n_k_blocks": n_k}
+    for j in range(n_q):
+        for kb in range(n_k):
+            interior, masked = _block_class(
+                j * block_q, kb * block_k, s_k=s_k, s_kp=s_kp,
+                causal=causal, block_q=block_q, block_k=block_k,
+                s_q=s_q if kind == "bwd" else None, s_qp=s_qp,
+            )
+            key = "masked" if masked else (
+                "interior" if interior else "dead")
+            census[key] += 1
+    return census
+
+
+def launch_census(s_q: int, s_k: int, d: int, block_q=None, block_k=None,
+                  bwd_block_q=None, bwd_block_k=None,
+                  causal: bool = True, interpret: bool = False) -> dict:
+    """Census of the geometry a launch will ACTUALLY run: resolves
+    ``None`` blocks to the defaults, then applies every clamp the entry
+    points apply — the head-dim clamp (:func:`_clamp_blocks_for_dim`),
+    the q-block lane-tile floor (:func:`_effective_q_block`; compiled
+    TPU floors bq at 128), and the k sequence clamp — and returns
+    ``{"fwd": census, "bwd": census}``.  The bench anatomy rungs use
+    this instead of calling :func:`block_census` on the *requested*
+    blocks, so a clamped launch cannot print a census for a geometry
+    it never ran.
+
+    Two run-time escapes are NOT reflected (they depend on the backend,
+    not the geometry): the backward's scoped-VMEM retry can ceil-shrink
+    its blocks further on generations where the d-clamp is too loose
+    (``_backward_with_vmem_retry`` warns when it does — a capture that
+    saw that warning must not divide by this census), and sequences
+    below one lane tile take the dense-recompute fallback with no
+    blocks at all."""
+    fbq, fbk = _clamp_blocks_for_dim(block_q, block_k, d, warn=False)
+    bq = block_q if bwd_block_q is None else bwd_block_q
+    bk = block_k if bwd_block_k is None else bwd_block_k
+    bbq, bbk = _clamp_blocks_for_dim(bq, bk, d, warn=False)
+
+    def eff(b_q, b_k):
+        # exactly _flash_forward/_flash_backward's block resolution
+        return (_effective_q_block(b_q, s_q, interpret),
+                min(b_k, _round_up(s_k, 8)))
+
+    return {
+        "fwd": block_census(s_q, s_k, *eff(fbq, fbk), causal, "fwd"),
+        "bwd": block_census(s_q, s_k, *eff(bbq, bbk), causal, "bwd"),
+    }
+
+
+# ----------------------------------------------------------------------
 # Flash attention — forward kernel
 # ----------------------------------------------------------------------
-def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
-                      l_ref, *, s_k: int, causal: bool, scale: float,
-                      block_q: int, block_k: int):
-    """Grid (batch*head, q_blocks, k_blocks); the k dimension is innermost
+def _flash_fwd_kernel_legacy(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref,
+                             m_ref, l_ref, *, s_k: int, causal: bool,
+                             scale: float, block_q: int, block_k: int):
+    """The PRE-SPLIT forward kernel, kept verbatim (``taxonomy="legacy"``)
+    as the numerics/timing reference for the diagonal split: every live
+    block pays the iota/mask/select online-softmax path.
+
+    Grid (batch*head, q_blocks, k_blocks); the k dimension is innermost
     and sequential on TPU, so the fp32 accumulator / running max /
     denominator live in VMEM scratch across k steps.  K/V residency is one
     (block_k, d) tile per step."""
@@ -195,11 +364,112 @@ def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
         )
 
 
+def _flash_fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref,
+                      l_ref, *, s_k: int, s_kp: int, causal: bool,
+                      scale: float, block_q: int, block_k: int,
+                      force_interior: bool = False):
+    """Diagonal-split forward kernel (``taxonomy="split"``).
+
+    Same grid/scratch contract as the legacy kernel; each (j, kb) grid
+    point routes to one of the taxonomy branches (see module section
+    "Block taxonomy").  The interior branch carries no iota/mask/select,
+    and the first k step (kb == 0, always live) writes the running
+    state directly instead of rescaling an empty accumulator — with
+    m_old = -inf the rescale factor exp(m_old - m_new) is exactly 0 in
+    fp32, so skipping it is bit-identical, and it removes the separate
+    init pass plus one (bq, d) multiply-add per q row.
+
+    Exactness vs legacy: on an interior block the legacy mask is
+    provably all-true, so ``where(mask, s, -inf)`` is the identity and
+    both branches compute the same fp32 expression tree
+    (``test_split_matches_legacy_exactly``)."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    first_q = j * block_q
+    first_k = kb * block_k
+    interior, masked = _block_class(
+        first_q, first_k, s_k=s_k, s_kp=s_kp, causal=causal,
+        block_q=block_q, block_k=block_k,
+        force_interior=force_interior,
+    )
+
+    def _attend(with_mask):
+        q = q_ref[0].astype(jnp.float32) * scale  # (bq, d)
+        k_blk = k_ref[0].astype(jnp.float32)      # (bk, d)
+        v_blk = v_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (bq, bk)
+        if with_mask:
+            mask = _tail_mask(
+                first_q, first_k, s_k=s_k, s_kp=s_kp, causal=causal,
+                block_q=block_q, block_k=block_k,
+            )
+            s = jnp.where(mask, s, _NEG_INF)
+        m_blk = jnp.max(s, axis=-1, keepdims=True)
+
+        @pl.when(kb == 0)
+        def _first():
+            # First k block (always live): the running state is empty,
+            # so the online-softmax rescale is provably a no-op — write
+            # the block statistics directly.
+            p = jnp.exp(s - m_blk)
+            m_ref[:] = jnp.broadcast_to(m_blk, m_ref.shape)
+            l_ref[:] = jnp.broadcast_to(
+                jnp.sum(p, axis=-1, keepdims=True), l_ref.shape
+            )
+            acc_ref[:] = lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+        @pl.when(kb != 0)
+        def _rest():
+            m_old = m_ref[:, 0:1]
+            m_new = jnp.maximum(m_old, m_blk)
+            p = jnp.exp(s - m_new)
+            alpha = jnp.exp(m_old - m_new)
+            l_new = alpha * l_ref[:, 0:1] + jnp.sum(
+                p, axis=-1, keepdims=True
+            )
+            l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+            m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+            acc_ref[:] = alpha * acc_ref[:] + lax.dot_general(
+                p, v_blk, (((1,), (0,)), ((), ())),
+                preferred_element_type=jnp.float32,
+            )
+
+    @_when(interior)
+    def _fast():
+        _attend(with_mask=False)
+
+    @_when(masked)
+    def _slow():
+        _attend(with_mask=True)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, 0:1], 1e-30)
+        ).astype(o_ref.dtype)
+        lse_ref[0] = jnp.broadcast_to(
+            (m_ref[:, 0] + jnp.log(jnp.maximum(l_ref[:, 0], 1e-30)))[
+                None, :
+            ],
+            lse_ref.shape[1:],
+        )
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret",
+                     "taxonomy"),
 )
-def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
+def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret,
+                   taxonomy="split"):
     b, s_q, h, d = q.shape
     s_k = k.shape[1]
     block_q, block_k = _clamp_blocks_for_dim(block_q, block_k, d)
@@ -218,12 +488,20 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
     kb_, vb = to_bh(k, s_k, bk), to_bh(v, s_k, bk)
     s_qp, s_kp = qb.shape[1], kb_.shape[1]
 
+    if taxonomy == "legacy":
+        kernel = functools.partial(
+            _flash_fwd_kernel_legacy, s_k=s_k, causal=causal,
+            scale=scale, block_q=bq, block_k=bk,
+        )
+    else:
+        kernel = functools.partial(
+            _flash_fwd_kernel, s_k=s_k, s_kp=s_kp, causal=causal,
+            scale=scale, block_q=bq, block_k=bk,
+            force_interior=(taxonomy == "interior"),
+        )
     grid = (b * h, s_qp // bq, s_kp // bk)
     out, lse = pl.pallas_call(
-        functools.partial(
-            _flash_fwd_kernel, s_k=s_k, causal=causal, scale=scale,
-            block_q=bq, block_k=bk,
-        ),
+        kernel,
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
             jax.ShapeDtypeStruct((b * h, 8, s_qp), jnp.float32),
@@ -252,11 +530,13 @@ def _flash_forward(q, k, v, causal, scale, block_q, block_k, interpret):
 # ----------------------------------------------------------------------
 # Flash attention — backward kernels (FlashAttention-2 shape)
 # ----------------------------------------------------------------------
-def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                         dq_ref, dq_acc, *, s_q: int, s_k: int,
-                         causal: bool, scale: float, block_q: int,
-                         block_k: int):
-    """Grid (batch*head, q_blocks, k_blocks); k innermost/sequential.
+def _flash_bwd_dq_kernel_legacy(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                delta_ref, dq_ref, dq_acc, *, s_q: int,
+                                s_k: int, causal: bool, scale: float,
+                                block_q: int, block_k: int):
+    """Pre-split dq kernel (``taxonomy="legacy"`` reference).
+
+    Grid (batch*head, q_blocks, k_blocks); k innermost/sequential.
     Recomputes the (bq, bk) probability tile from q, k and the saved
     row log-sum-exp, accumulates dq in VMEM."""
     j = pl.program_id(1)
@@ -308,11 +588,108 @@ def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
 
 
-def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
-                          dk_ref, dv_ref, dk_acc, dv_acc, *, s_q: int,
-                          s_k: int, causal: bool, scale: float,
-                          block_q: int, block_k: int):
-    """Grid (batch*head, k_blocks, q_blocks); q innermost/sequential.
+def _tail_mask(first_q, first_k, *, s_k, s_kp, causal, block_q, block_k,
+               s_q=None, s_qp=None):
+    """THE masked-branch mask, statically thinned: each padding compare
+    exists only when that padding exists (s < s_padded, static), so an
+    aligned causal launch's diagonal blocks pay only the causal
+    compare.  Dropped compares are provably all-true there, so the
+    thinning is bit-identical to the legacy full mask.  Same
+    ``s_q``/``s_qp`` convention as :func:`_block_class`: the forward
+    leaves them unset (it never masks q), the backward passes them."""
+    mask_q = s_q is not None and s_q < s_qp
+    need_q = causal or mask_q
+    need_k = causal or s_k < s_kp
+    q_idx = first_q + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    ) if need_q else None
+    k_idx = first_k + lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    ) if need_k else None
+    mask = True
+    if s_k < s_kp:
+        mask = _and(mask, k_idx < s_k)
+    if mask_q:
+        mask = _and(mask, q_idx < s_q)
+    if causal:
+        mask = _and(mask, k_idx <= q_idx)
+    return mask
+
+
+def _flash_bwd_dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                         dq_ref, dq_acc, *, s_q: int, s_qp: int,
+                         s_k: int, s_kp: int, causal: bool, scale: float,
+                         block_q: int, block_k: int,
+                         force_interior: bool = False):
+    """Diagonal-split dq kernel: interior blocks recompute p straight
+    from the saved log-sum-exp with no iota/mask/select work; only the
+    diagonal/tail blocks pay the masked path.  Same grid and numerics
+    as the legacy kernel (on interior blocks the legacy mask is all-
+    true, so ``where(mask, p, 0)`` is the identity)."""
+    j = pl.program_id(1)
+    kb = pl.program_id(2)
+    n_kb = pl.num_programs(2)
+
+    @pl.when(kb == 0)
+    def _init():
+        dq_acc[:] = jnp.zeros_like(dq_acc)
+
+    first_q = j * block_q
+    first_k = kb * block_k
+    interior, masked = _block_class(
+        first_q, first_k, s_q=s_q, s_qp=s_qp, s_k=s_k, s_kp=s_kp,
+        causal=causal, block_q=block_q, block_k=block_k,
+        force_interior=force_interior,
+    )
+
+    def _accum(with_mask):
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        if with_mask:
+            mask = _tail_mask(
+                first_q, first_k, s_q=s_q, s_qp=s_qp, s_k=s_k,
+                s_kp=s_kp, causal=causal, block_q=block_q,
+                block_k=block_k,
+            )
+            p = jnp.where(mask, p, 0.0)
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dq_acc[:] += lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @_when(interior)
+    def _fast():
+        _accum(with_mask=False)
+
+    @_when(masked)
+    def _slow():
+        _accum(with_mask=True)
+
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        dq_ref[0] = dq_acc[:].astype(dq_ref.dtype)
+
+
+def _flash_bwd_dkv_kernel_legacy(q_ref, k_ref, v_ref, do_ref, lse_ref,
+                                 delta_ref, dk_ref, dv_ref, dk_acc,
+                                 dv_acc, *, s_q: int, s_k: int,
+                                 causal: bool, scale: float,
+                                 block_q: int, block_k: int):
+    """Pre-split dk/dv kernel (``taxonomy="legacy"`` reference).
+
+    Grid (batch*head, k_blocks, q_blocks); q innermost/sequential.
     Accumulates dk and dv for one key block across all query blocks."""
     kb = pl.program_id(1)
     j = pl.program_id(2)
@@ -367,12 +744,83 @@ def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
         dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
 
 
+def _flash_bwd_dkv_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+                          dk_ref, dv_ref, dk_acc, dv_acc, *, s_q: int,
+                          s_qp: int, s_k: int, s_kp: int, causal: bool,
+                          scale: float, block_q: int, block_k: int,
+                          force_interior: bool = False):
+    """Diagonal-split dk/dv kernel (grid (batch*head, k_blocks,
+    q_blocks); q innermost/sequential) — same taxonomy routing as the
+    split dq kernel."""
+    kb = pl.program_id(1)
+    j = pl.program_id(2)
+    n_j = pl.num_programs(2)
+
+    @pl.when(j == 0)
+    def _init():
+        dk_acc[:] = jnp.zeros_like(dk_acc)
+        dv_acc[:] = jnp.zeros_like(dv_acc)
+
+    first_q = j * block_q
+    first_k = kb * block_k
+    interior, masked = _block_class(
+        first_q, first_k, s_q=s_q, s_qp=s_qp, s_k=s_k, s_kp=s_kp,
+        causal=causal, block_q=block_q, block_k=block_k,
+        force_interior=force_interior,
+    )
+
+    def _accum(with_mask):
+        q = q_ref[0].astype(jnp.float32)
+        k_blk = k_ref[0].astype(jnp.float32)
+        v_blk = v_ref[0].astype(jnp.float32)
+        do = do_ref[0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        p = jnp.exp(s - lse_ref[0, 0][:, None])
+        if with_mask:
+            mask = _tail_mask(
+                first_q, first_k, s_q=s_q, s_qp=s_qp, s_k=s_k,
+                s_kp=s_kp, causal=causal, block_q=block_q,
+                block_k=block_k,
+            )
+            p = jnp.where(mask, p, 0.0)
+        dv_acc[:] += lax.dot_general(
+            p, do, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_ref[0, 0][:, None])
+        dk_acc[:] += lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+
+    @_when(interior)
+    def _fast():
+        _accum(with_mask=False)
+
+    @_when(masked)
+    def _slow():
+        _accum(with_mask=True)
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+        dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+
 @functools.partial(
     jax.jit,
-    static_argnames=("causal", "scale", "block_q", "block_k", "interpret"),
+    static_argnames=("causal", "scale", "block_q", "block_k", "interpret",
+                     "taxonomy"),
 )
 def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
-                    interpret, g_lse=None):
+                    interpret, taxonomy="split", g_lse=None):
     """(b, s, h, d)-layout backward via the two kernels above.
 
     ``g_lse``: optional (b*h, s_q) cotangent of the log-sum-exp output
@@ -416,11 +864,19 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     lse_p = jnp.broadcast_to(lse_p[:, None], (bh, 8, s_qp))
 
     n_q, n_k = s_qp // bq, s_kp // bk
-    kwargs = dict(s_q=s_q, s_k=s_k, causal=causal, scale=scale,
-                  block_q=bq, block_k=bk)
+    if taxonomy == "legacy":
+        dq_kernel, dkv_kernel = (_flash_bwd_dq_kernel_legacy,
+                                 _flash_bwd_dkv_kernel_legacy)
+        kwargs = dict(s_q=s_q, s_k=s_k, causal=causal, scale=scale,
+                      block_q=bq, block_k=bk)
+    else:
+        dq_kernel, dkv_kernel = _flash_bwd_dq_kernel, _flash_bwd_dkv_kernel
+        kwargs = dict(s_q=s_q, s_qp=s_qp, s_k=s_k, s_kp=s_kp,
+                      causal=causal, scale=scale, block_q=bq, block_k=bk,
+                      force_interior=(taxonomy == "interior"))
 
     dq = pl.pallas_call(
-        functools.partial(_flash_bwd_dq_kernel, **kwargs),
+        functools.partial(dq_kernel, **kwargs),
         out_shape=jax.ShapeDtypeStruct((b * h, s_qp, d), q.dtype),
         grid=(b * h, n_q, n_k),
         in_specs=[
@@ -437,7 +893,7 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
     )(qb, kb_, vb, dob, lse_p, delta)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_flash_bwd_dkv_kernel, **kwargs),
+        functools.partial(dkv_kernel, **kwargs),
         out_shape=[
             jax.ShapeDtypeStruct((b * h, s_kp, d), k.dtype),
             jax.ShapeDtypeStruct((b * h, s_kp, d), v.dtype),
@@ -471,10 +927,11 @@ def _flash_backward(q, k, v, out, lse, g, causal, scale, block_q, block_k,
 # ----------------------------------------------------------------------
 # Public API
 # ----------------------------------------------------------------------
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention(q, k, v, causal=False, scale=None,
                     block_q=None, block_k=None, interpret=None,
-                    bwd_block_q=None, bwd_block_k=None):
+                    bwd_block_q=None, bwd_block_k=None, taxonomy=None):
     """Blocked flash attention: (b, s, h, d) x 3 -> (b, s, h, d).
 
     Numerics match :func:`chainermn_tpu.ops.multi_head_attention` (fp32
@@ -498,6 +955,14 @@ def flash_attention(q, k, v, causal=False, scale=None,
     tiles; the forward holds one), so the forward can stream wider K/V
     blocks than the backward survives — e.g. fwd 1024x2048 with bwd
     1024x1024 (measured: benchmarks/longseq_tune.py round-5 rows).
+
+    ``taxonomy``: block-classification mode (``None`` = ``"split"``,
+    the diagonal-split kernels).  ``"legacy"`` runs the pre-split
+    kernels (every live block masked — the in-tree A/B reference);
+    ``"interior"`` is TIMING ONLY for the segment-anatomy bench (forces
+    every live block down the unmasked fast branch; numerically wrong
+    for causal/ragged inputs).  Split and legacy are bit-identical
+    (``test_split_matches_legacy_exactly``).
     """
     if not PALLAS_AVAILABLE:
         raise ImportError(
@@ -507,7 +972,8 @@ def flash_attention(q, k, v, causal=False, scale=None,
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, _ = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                            _should_interpret(interpret))
+                            _should_interpret(interpret),
+                            _resolve_taxonomy(taxonomy))
     return out
 
 
@@ -537,7 +1003,8 @@ def _shrink_blocks(bq: int, bk: int):
 _bwd_probe_cache: dict = {}
 
 
-def _bwd_compile_blocked(arrays, causal, scale, bq, bk) -> bool:
+def _bwd_compile_blocked(arrays, causal, scale, bq, bk,
+                         taxonomy="split") -> bool:
     """AOT-compile probe: does the backward at this geometry compile on
     the real backend?  Needed because the production path wraps the step
     in an outer ``jax.jit`` — there the Mosaic compile error would
@@ -550,7 +1017,7 @@ def _bwd_compile_blocked(arrays, causal, scale, bq, bk) -> bool:
     that would have run."""
     key = (
         tuple((tuple(a.shape), str(a.dtype)) for a in arrays),
-        causal, scale, bq, bk,
+        causal, scale, bq, bk, taxonomy,
     )
     if key in _bwd_probe_cache:
         return _bwd_probe_cache[key]
@@ -558,7 +1025,7 @@ def _bwd_compile_blocked(arrays, causal, scale, bq, bk) -> bool:
     try:
         sds = [jax.ShapeDtypeStruct(a.shape, a.dtype) for a in arrays]
         _flash_backward.lower(
-            *sds, causal, scale, bq, bk, False
+            *sds, causal, scale, bq, bk, False, taxonomy
         ).compile()
     except Exception as e:
         blocked = _is_vmem_oom(e)
@@ -567,7 +1034,8 @@ def _bwd_compile_blocked(arrays, causal, scale, bq, bk) -> bool:
 
 
 def _backward_with_vmem_retry(q, k, v, out, lse, g, causal, scale,
-                              block_q, block_k, interp, g_lse=None):
+                              block_q, block_k, interp, g_lse=None,
+                              taxonomy="split"):
     """Run the backward kernels; on a scoped-VMEM compile failure retry
     with progressively ceil-shrunk block geometry (ADVICE round-5: the
     d<=256 clamp boundary was measured on v5e only — other generations
@@ -593,14 +1061,15 @@ def _backward_with_vmem_retry(q, k, v, out, lse, g, causal, scale,
         tried.add(eff)
         try:
             if probe and _bwd_compile_blocked(
-                (q, k, v, out, lse, g), causal, scale, bq, bk
+                (q, k, v, out, lse, g), causal, scale, bq, bk, taxonomy
             ):
                 raise RuntimeError(
                     f"scoped vmem limit exceeded at {eff[0]}x{eff[1]} "
                     "(AOT compile probe)"
                 )
             return _flash_backward(q, k, v, out, lse, g, causal, scale,
-                                   bq, bk, interp, g_lse=g_lse)
+                                   bq, bk, interp, taxonomy=taxonomy,
+                                   g_lse=g_lse)
         except Exception as e:
             if not _is_vmem_oom(e):
                 raise
@@ -644,16 +1113,17 @@ def _resolve_bwd_blocks(block_q, block_k, bwd_block_q, bwd_block_k, d):
 
 
 def _flash_fwd_rule(q, k, v, causal, scale, block_q, block_k, interpret,
-                    bwd_block_q=None, bwd_block_k=None):
+                    bwd_block_q=None, bwd_block_k=None, taxonomy=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     out, lse = _flash_forward(q, k, v, causal, scale, block_q, block_k,
-                              _should_interpret(interpret))
+                              _should_interpret(interpret),
+                              _resolve_taxonomy(taxonomy))
     return out, (q, k, v, out, lse)
 
 
 def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
-                    bwd_block_q, bwd_block_k, residuals, g):
+                    bwd_block_q, bwd_block_k, taxonomy, residuals, g):
     q, k, v, out, lse = residuals
     if scale is None:
         scale = q.shape[-1] ** -0.5
@@ -675,7 +1145,8 @@ def _flash_bwd_rule(causal, scale, block_q, block_k, interpret,
     bq, bk = _resolve_bwd_blocks(block_q, block_k, bwd_block_q,
                                  bwd_block_k, q.shape[-1])
     return _backward_with_vmem_retry(q, k, v, out, lse, g, causal,
-                                     scale, bq, bk, interp)
+                                     scale, bq, bk, interp,
+                                     taxonomy=_resolve_taxonomy(taxonomy))
 
 
 flash_attention.defvjp(_flash_fwd_rule, _flash_bwd_rule)
@@ -701,10 +1172,12 @@ def _dense_attention_with_lse(q, k, v, causal, scale):
     return out.astype(q.dtype), jnp.moveaxis(lse, 1, 2)  # lse (b, s_q, h)
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8, 9))
+@functools.partial(jax.custom_vjp,
+                   nondiff_argnums=(3, 4, 5, 6, 7, 8, 9, 10))
 def flash_attention_with_lse(q, k, v, causal=False, scale=None,
                              block_q=None, block_k=None, interpret=None,
-                             bwd_block_q=None, bwd_block_k=None):
+                             bwd_block_q=None, bwd_block_k=None,
+                             taxonomy=None):
     """Flash attention returning ``(out, lse)`` with BOTH outputs
     differentiable — ``lse`` is the per-row log-sum-exp of the scaled
     scores, shaped (b, s_q, h).
@@ -716,14 +1189,15 @@ def flash_attention_with_lse(q, k, v, causal=False, scale=None,
     the lse VJP is folded into the same backward kernels (see
     ``_flash_backward``'s ``g_lse``)."""
     out, lse = _flash_with_lse_fwd_rule(
-        q, k, v, causal, scale, block_q, block_k, interpret
+        q, k, v, causal, scale, block_q, block_k, interpret,
+        taxonomy=taxonomy,
     )[0]
     return out, lse
 
 
 def _flash_with_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k,
                              interpret, bwd_block_q=None,
-                             bwd_block_k=None):
+                             bwd_block_k=None, taxonomy=None):
     if scale is None:
         scale = q.shape[-1] ** -0.5
     interp = _should_interpret(interpret)
@@ -734,14 +1208,16 @@ def _flash_with_lse_fwd_rule(q, k, v, causal, scale, block_q, block_k,
         out, lse = _dense_attention_with_lse(q, k, v, causal, scale)
         return (out, lse), (q, k, v, None, None)
     out, lse_bh = _flash_forward(q, k, v, causal, scale, block_q,
-                                 block_k, interp)
+                                 block_k, interp,
+                                 _resolve_taxonomy(taxonomy))
     b, s_q, h, _ = q.shape
     lse = jnp.moveaxis(lse_bh.reshape(b, h, s_q), 1, 2)  # (b, s_q, h)
     return (out, lse), (q, k, v, out, lse_bh)
 
 
 def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
-                             bwd_block_q, bwd_block_k, residuals, g):
+                             bwd_block_q, bwd_block_k, taxonomy,
+                             residuals, g):
     q, k, v, out, lse_bh = residuals
     g_out, g_lse = g
     if scale is None:
@@ -761,6 +1237,7 @@ def _flash_with_lse_bwd_rule(causal, scale, block_q, block_k, interpret,
     return _backward_with_vmem_retry(
         q, k, v, out, lse_bh, g_out, causal, scale, bq, bk,
         _should_interpret(interpret), g_lse=g_lse_bh,
+        taxonomy=_resolve_taxonomy(taxonomy),
     )
 
 
@@ -773,13 +1250,17 @@ def flash_attention_fn(block_q: Optional[int] = None,
                        block_k: Optional[int] = None,
                        interpret: Optional[bool] = None,
                        bwd_block_q: Optional[int] = None,
-                       bwd_block_k: Optional[int] = None):
+                       bwd_block_k: Optional[int] = None,
+                       taxonomy: Optional[str] = None):
     """Adapter producing the ``attention_fn`` signature used by
-    ``ulysses_attention``: ``(q, k, v, causal, scale)``."""
+    ``ulysses_attention``: ``(q, k, v, causal, scale)``.  ``taxonomy``
+    passes through to :func:`flash_attention` (the segment-anatomy
+    bench's knob)."""
 
     def fn(q, k, v, causal, scale):
         return flash_attention(q, k, v, causal, scale, block_q, block_k,
-                               interpret, bwd_block_q, bwd_block_k)
+                               interpret, bwd_block_q, bwd_block_k,
+                               taxonomy)
 
     return fn
 

@@ -202,6 +202,244 @@ class TestFlashAttention:
         )
 
 
+def _brute_census(s_q, s_k, bq, bk, causal, kind):
+    """Oracle block classification from the literal padded mask matrix
+    (the kernels classify from corner predicates; this classifies every
+    element and must agree)."""
+    def up(x, m):
+        return (x + m - 1) // m * m
+
+    s_qp, s_kp = up(s_q, bq), up(s_k, bk)
+    qi = np.arange(s_qp)[:, None]
+    kj = np.arange(s_kp)[None, :]
+    valid = np.broadcast_to(kj < s_k, (s_qp, s_kp))  # fwd masks only k
+    if kind == "bwd":
+        valid = valid & (qi < s_q)
+    census = {"dead": 0, "interior": 0, "masked": 0,
+              "n_q_blocks": s_qp // bq, "n_k_blocks": s_kp // bk}
+    for j in range(s_qp // bq):
+        for kb in range(s_kp // bk):
+            sl = (slice(j * bq, (j + 1) * bq),
+                  slice(kb * bk, (kb + 1) * bk))
+            c_ok = (kj <= qi)[sl] if causal else np.ones(
+                (bq, bk), dtype=bool
+            )
+            if causal and not c_ok.any():
+                census["dead"] += 1
+            elif c_ok.all() and valid[sl].all():
+                census["interior"] += 1
+            else:
+                census["masked"] += 1
+    return census
+
+
+class TestDiagonalSplit:
+    """The diagonal-split kernel taxonomy: classification correctness,
+    bit-exactness vs the pre-split (legacy) kernels, and oracle checks
+    at the geometries where the classes meet."""
+
+    @pytest.mark.parametrize("kind", ["fwd", "bwd"])
+    @pytest.mark.parametrize("s_q,s_k,bq,bk,causal", [
+        (32, 32, 16, 16, True),    # aligned square: all classes present
+        (32, 32, 16, 16, False),
+        (23, 23, 16, 16, True),    # ragged q AND k tails
+        (23, 23, 16, 16, False),
+        (48, 48, 8, 16, True),     # bk > bq: coarse diagonal band
+        (48, 48, 16, 8, True),     # bq > bk: fully-masked rows exist
+        (24, 17, 24, 16, False),   # cross-attention, ragged k
+        (40, 40, 8, 32, True),
+        (2048, 2048, 1024, 2048, True),   # the shipping fwd geometry
+        (8192, 8192, 1024, 1024, True),   # the seq-8192 tier
+    ])
+    def test_block_census_matches_brute_force(self, kind, s_q, s_k, bq,
+                                              bk, causal):
+        from chainermn_tpu.ops.pallas_attention import block_census
+
+        assert block_census(s_q, s_k, bq, bk, causal, kind=kind) == \
+            _brute_census(s_q, s_k, bq, bk, causal, kind)
+
+    def test_census_shipping_geometries(self):
+        """The numbers the perf doc's anatomy section quotes: block
+        counts per (batch*head) program at the shipped configs."""
+        from chainermn_tpu.ops.pallas_attention import block_census
+
+        # seq 2048, bwd 1024x1024: 1 of 3 live blocks interior
+        c = block_census(2048, 2048, 1024, 1024, True, kind="bwd")
+        assert c == {"dead": 1, "interior": 1, "masked": 2,
+                     "n_q_blocks": 2, "n_k_blocks": 2}
+        # seq 2048, fwd 1024x2048 (the r5 split geometry): every live
+        # block straddles the diagonal — the split buys the forward
+        # nothing at this geometry (the anatomy rungs A/B it against
+        # 1024x1024, where 1 of 3 live blocks goes fast-path)
+        c = block_census(2048, 2048, 1024, 2048, True)
+        assert c["interior"] == 0 and c["masked"] == 2
+        # seq 8192, 1024^2: 28 of 36 live blocks interior (78%)
+        c = block_census(8192, 8192, 1024, 1024, True)
+        assert (c["dead"], c["interior"], c["masked"]) == (28, 28, 8)
+        # seq 16384: 120 of 136 live blocks interior (88%)
+        c = block_census(16384, 16384, 1024, 1024, True)
+        assert (c["dead"], c["interior"], c["masked"]) == (120, 120, 16)
+        # non-causal aligned: no mask work anywhere
+        c = block_census(64, 64, 16, 16, False)
+        assert c["masked"] == 0 and c["interior"] == 16
+
+    def test_census_conservation_and_kind(self):
+        from chainermn_tpu.ops.pallas_attention import block_census
+
+        c = block_census(40, 40, 16, 16, True)
+        assert c["dead"] + c["interior"] + c["masked"] == \
+            c["n_q_blocks"] * c["n_k_blocks"]
+        # a ragged q tail reclassifies blocks only for the backward
+        fwd = block_census(40, 48, 16, 16, False, kind="fwd")
+        bwd = block_census(40, 48, 16, 16, False, kind="bwd")
+        assert fwd["masked"] == 0 and bwd["masked"] == 3
+        with pytest.raises(ValueError, match="fwd/bwd"):
+            block_census(8, 8, 8, 8, False, kind="nope")
+
+    @pytest.mark.parametrize("causal", [False, True])
+    @pytest.mark.parametrize("s,bq,bk", [
+        (32, 16, 16),   # block-boundary aligned
+        (23, 16, 16),   # ragged tails
+        (48, 16, 8),    # fully-masked rows inside live blocks
+        (40, 8, 32),    # wide k blocks
+    ])
+    def test_split_matches_legacy_exactly(self, causal, s, bq, bk):
+        """The split kernels must be BIT-IDENTICAL to the pre-split
+        kernels in interpret mode, values and all three gradients: the
+        interior fast branch skips a mask that is provably all-true,
+        and the first-k-block direct write skips a rescale whose factor
+        is provably exp(-inf) = 0 — neither may change a single bit."""
+        q, k, v = _qkv(s=s, seed=7)
+
+        def run(tax):
+            def f(q, k, v):
+                return jnp.sum(
+                    flash_attention(q, k, v, causal, None, bq, bk, True,
+                                    None, None, tax) ** 2
+                )
+
+            out = flash_attention(q, k, v, causal, None, bq, bk, True,
+                                  None, None, tax)
+            grads = jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+            return out, grads
+
+        out_s, g_s = run("split")
+        out_l, g_l = run("legacy")
+        np.testing.assert_array_equal(np.asarray(out_s), np.asarray(out_l))
+        for a, b in zip(g_s, g_l):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    def test_split_matches_legacy_with_lse(self):
+        """Same exactness through the (out, lse)-differentiable entry
+        point (the ring-attention building block): both outputs and the
+        folded g_lse backward."""
+        from chainermn_tpu.ops.pallas_attention import (
+            flash_attention_with_lse,
+        )
+
+        q, k, v = _qkv(s=32, seed=11)
+
+        def run(tax):
+            def f(q, k, v):
+                out, lse = flash_attention_with_lse(
+                    q, k, v, True, None, 16, 16, True, None, None, tax
+                )
+                return jnp.sum(out ** 2) + jnp.sum(lse * 0.3)
+
+            out, lse = flash_attention_with_lse(
+                q, k, v, True, None, 16, 16, True, None, None, tax
+            )
+            return out, lse, jax.grad(f, argnums=(0, 1, 2))(q, k, v)
+
+        out_s, lse_s, g_s = run("split")
+        out_l, lse_l, g_l = run("legacy")
+        np.testing.assert_array_equal(np.asarray(out_s), np.asarray(out_l))
+        np.testing.assert_array_equal(np.asarray(lse_s), np.asarray(lse_l))
+        for a, b in zip(g_s, g_l):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+    @pytest.mark.parametrize("s", [32, 23])
+    def test_split_gradients_match_dense_oracle(self, s):
+        """Gradients of the split path vs the dense oracle exactly at
+        the geometries where the taxonomy matters: block boundaries
+        (s = 2 blocks: the diagonal class) and ragged tails (the tail
+        class), with the census proving BOTH live branches executed."""
+        from chainermn_tpu.ops.pallas_attention import block_census
+
+        c = block_census(s, s, 16, 16, True, kind="bwd")
+        if s == 32:
+            assert c["interior"] >= 1 and c["masked"] >= 1
+        q, k, v = _qkv(s=s, seed=3)
+
+        def f_ref(q, k, v):
+            return jnp.sum(multi_head_attention(q, k, v, causal=True) ** 2)
+
+        def f_split(q, k, v):
+            return jnp.sum(
+                flash_attention(q, k, v, True, None, 16, 16, True, None,
+                                None, "split") ** 2
+            )
+
+        g_ref = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+        g_split = jax.grad(f_split, argnums=(0, 1, 2))(q, k, v)
+        for a, b in zip(g_ref, g_split):
+            assert np.isfinite(np.asarray(b)).all()
+            np.testing.assert_allclose(
+                np.asarray(b), np.asarray(a), rtol=2e-3, atol=2e-4
+            )
+
+    def test_launch_census_applies_clamps(self):
+        """launch_census (the bench anatomy rungs' census source) must
+        describe the geometry that RUNS: None blocks resolve to the
+        defaults, the head-dim clamp and the sequence clamp both
+        apply — a clamped launch cannot print the requested census."""
+        from chainermn_tpu.ops.pallas_attention import (
+            block_census,
+            launch_census,
+        )
+
+        c = launch_census(2048, 2048, 128)  # defaults at dh=128
+        assert c["fwd"] == block_census(2048, 2048, 1024, 1024, True)
+        assert c["bwd"] == block_census(2048, 2048, 1024, 1024, True,
+                                        kind="bwd")
+        # head dim past the measured d<=256 boundary: blocks halve and
+        # the census follows the clamp
+        c = launch_census(2048, 2048, 512)
+        assert c["fwd"] == block_census(2048, 2048, 512, 512, True)
+        # split fwd/bwd geometry resolves independently
+        c = launch_census(2048, 2048, 128, 1024, 2048, 1024, 1024)
+        assert c["fwd"]["n_k_blocks"] == 1 and c["bwd"]["n_k_blocks"] == 2
+        # sequence clamp: blocks never exceed the (rounded) sequence
+        c = launch_census(64, 64, 128)
+        assert c["fwd"]["n_q_blocks"] == 1 and c["fwd"]["n_k_blocks"] == 1
+        # compiled TPU floors the q block at the 128 lane tile
+        # (_effective_q_block): a sub-128 request must census at 128
+        c = launch_census(8192, 8192, 128, 64, 1024)
+        assert c["fwd"]["n_q_blocks"] == 8192 // 128
+        c = launch_census(8192, 8192, 128, 64, 1024, interpret=True)
+        assert c["fwd"]["n_q_blocks"] == 8192 // 64
+
+    def test_interior_taxonomy_timing_only(self):
+        """``taxonomy="interior"`` (the anatomy bench's floor) must
+        equal split exactly when no mask exists (non-causal aligned),
+        and must DIFFER under causal masking — pinning that it is a
+        timing knob, not a numerics mode."""
+        q, k, v = _qkv(s=32, seed=5)
+        args = (None, 16, 16, True, None, None)
+        same = flash_attention(q, k, v, False, *args, "interior")
+        want = flash_attention(q, k, v, False, *args, "split")
+        np.testing.assert_array_equal(np.asarray(same), np.asarray(want))
+        wrong = flash_attention(q, k, v, True, *args, "interior")
+        right = flash_attention(q, k, v, True, *args, "split")
+        assert not np.allclose(np.asarray(wrong), np.asarray(right))
+
+    def test_invalid_taxonomy_raises(self):
+        q, k, v = _qkv(s=16)
+        with pytest.raises(ValueError, match="taxonomy"):
+            flash_attention(q, k, v, True, None, 8, 8, True, None, None,
+                            "diagonalize")
+
+
 class TestFlashWithSequenceParallel:
     def test_ulysses_with_flash_core(self, mesh8):
         from chainermn_tpu.parallel import ulysses_attention
@@ -339,7 +577,7 @@ class TestVmemRetry:
         calls = []
 
         def fake_backward(q, k, v, out, lse, g, causal, scale, bq, bk,
-                          interp, g_lse=None):
+                          interp, taxonomy="split", g_lse=None):
             eff = pa._clamp_blocks_for_dim(bq, bk, q.shape[-1],
                                            warn=False)
             calls.append(eff)
@@ -377,7 +615,7 @@ class TestVmemRetry:
         from chainermn_tpu.ops import pallas_attention as pa
 
         def fake_backward(q, k, v, out, lse, g, causal, scale, bq, bk,
-                          interp, g_lse=None):
+                          interp, taxonomy="split", g_lse=None):
             raise RuntimeError("scoped vmem limit exceeded")
 
         monkeypatch.setattr(pa, "_flash_backward", fake_backward)
@@ -421,12 +659,12 @@ class TestVmemRetry:
         real = pa._flash_backward
 
         def spying(q, k, v, out, lse, g, causal, scale, bq, bk, interp,
-                   g_lse=None):
+                   taxonomy="split", g_lse=None):
             seen.append((bq, bk))
             if len(seen) == 1:
                 raise RuntimeError("scoped vmem limit exceeded")
             return real(q, k, v, out, lse, g, causal, scale, bq, bk,
-                        interp, g_lse=g_lse)
+                        interp, taxonomy=taxonomy, g_lse=g_lse)
 
         monkeypatch.setattr(pa, "_flash_backward", spying)
         q, k, v = _qkv(s=32)

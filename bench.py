@@ -162,6 +162,20 @@ def _copy_spread(dst, src, suffix=""):
         dst["spread_max_over_min" + suffix] = src["spread_max_over_min"]
 
 
+def _ab_disclosure(rec, leg_a, leg_b, suffix_a, suffix_b):
+    """Two-leg A/B row disclosure: total samples across both legs, the
+    row spread is the WORSE leg's (the ratio is only as trustworthy as
+    its noisier side), then the per-leg fields, suffixed."""
+    rec["n_measurements"] = (leg_a.get("n_measurements", 0)
+                             + leg_b.get("n_measurements", 0))
+    spreads = [r["spread_max_over_min"] for r in (leg_a, leg_b)
+               if "spread_max_over_min" in r]
+    if spreads:
+        rec["spread_max_over_min"] = max(spreads)
+    _copy_spread(rec, leg_a, suffix_a)
+    _copy_spread(rec, leg_b, suffix_b)
+
+
 def _kloop_step_time(step, params, opt_state, batch, k, repeats=2):
     """``(seconds_per_step, samples)`` with k steps inside ONE jitted
     fori_loop.
@@ -199,7 +213,7 @@ def _kloop_step_time(step, params, opt_state, batch, k, repeats=2):
 
 
 def _train_setup(comm, model, image, batch, n_classes, mutable_bn,
-                 double_buffering=False):
+                 double_buffering=False, wire="auto"):
     """Shared scaffolding: params, step fn, a resident synthetic batch."""
     import jax
     import jax.numpy as jnp
@@ -217,7 +231,7 @@ def _train_setup(comm, model, image, batch, n_classes, mutable_bn,
     params = comm.bcast_data(params)
     opt = cmn.create_multi_node_optimizer(
         optax.sgd(0.1, momentum=0.9), comm,
-        double_buffering=double_buffering,
+        double_buffering=double_buffering, wire=wire,
     )
 
     def loss_fn(p, b):
@@ -250,11 +264,11 @@ def _train_setup(comm, model, image, batch, n_classes, mutable_bn,
 
 def bench_image_model(comm, model, *, image, batch, n_classes=1000,
                       mutable_bn=True, steps=None,
-                      double_buffering=False):
+                      double_buffering=False, wire="auto"):
     steps = steps or _env("BENCH_STEPS", 4 if SMOKE else 20)
     step, jitted, args = _train_setup(
         comm, model, image, batch, n_classes, mutable_bn,
-        double_buffering=double_buffering,
+        double_buffering=double_buffering, wire=wire,
     )
     params, opt_state, batch_dev = args
     step_time, samples = _kloop_step_time(
@@ -583,18 +597,62 @@ def config_vgg16_double_buffering():
             arch="VGG16", b_per_chip=batch, img=image
         ),
     }
-    # row-level disclosure first (the bench-wide protocol fields every
-    # row must carry): total samples across both legs, and the spread
-    # is the WORSE leg's — the on/off ratio is only as trustworthy as
-    # its noisier side.  Per-leg fields follow, suffixed.
-    rec["n_measurements"] = (off.get("n_measurements", 0)
-                             + on.get("n_measurements", 0))
-    spreads = [r["spread_max_over_min"] for r in (off, on)
-               if "spread_max_over_min" in r]
-    if spreads:
-        rec["spread_max_over_min"] = max(spreads)
-    _copy_spread(rec, off, "_off")
-    _copy_spread(rec, on, "_on")
+    _ab_disclosure(rec, off, on, "_off", "_on")
+    return rec
+
+
+def config_grad_wire():
+    """Flat-wire gradient-sync A/B (ISSUE 4): the SAME ResNet tier
+    timed with the legacy per-leaf psum storm vs the bucketed fused
+    wire — the launch-count half of the wire win, on-chip.  The byte
+    half (int8) and the sync/dummy split live in
+    ``benchmarks/comm_overlap_bench.py``'s ``wire_*`` rungs; this row
+    is the driver-captured headline ratio, fingerprinted with the codec
+    and bucket count so cross-round trend lines can't silently compare
+    different plans."""
+    import jax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu.comm_wire import plan_of_tree
+    from chainermn_tpu.models import ResNet50, ResNet18
+
+    image = _env("BENCH_IMAGE", 64 if SMOKE else 224)
+    batch = _env("BENCH_BATCH", 8 if SMOKE else 128)
+    steps = _env("BENCH_STEPS", 3 if SMOKE else 10)
+    model_cls = ResNet18 if SMOKE else ResNet50
+    out = {}
+    for wire in ("per_leaf", "auto"):
+        comm = cmn.create_communicator("tpu")
+        model = model_cls(num_classes=1000, train=True)
+        out[wire] = bench_image_model(
+            comm, model, image=image, batch=batch * comm.size,
+            steps=steps, wire=wire,
+        )
+    leaf, bucketed = out["per_leaf"], out["auto"]
+    # the plan the "auto" leg compiled — a pure function of shapes, so
+    # eval_shape (abstract init, zero device work) is all it needs
+    model = model_cls(num_classes=1000, train=True)
+    variables = jax.eval_shape(
+        model.init, jax.random.PRNGKey(0),
+        jax.ShapeDtypeStruct((1, image, image, 3), jax.numpy.float32),
+    )
+    plan = plan_of_tree(variables)
+    rec = {
+        "metric": "grad_wire_bucketed_speedup",
+        "value": round(
+            leaf["step_time_ms"] / bucketed["step_time_ms"], 3
+        ),
+        "unit": "x (per-leaf step time / bucketed step time)",
+        "step_time_ms_per_leaf": round(leaf["step_time_ms"], 2),
+        "step_time_ms_bucketed": round(bucketed["step_time_ms"], 2),
+        "wire_buckets": plan.n_buckets,
+        "wire_n_leaves": plan.n_leaves,
+        "config_fingerprint": _fingerprint(
+            arch=model_cls.__name__, b_per_chip=batch, img=image,
+            codec="none", buckets=plan.n_buckets,
+        ),
+    }
+    _ab_disclosure(rec, leaf, bucketed, "_per_leaf", "_bucketed")
     return rec
 
 
@@ -1097,6 +1155,7 @@ def main():
     secondary = [
         ("mnist", config_mnist_flat),
         ("vgg16_db", config_vgg16_double_buffering),
+        ("grad_wire", config_grad_wire),
         ("resnet50_mnbn", config_resnet50_mnbn),
         ("transformer_lm", config_transformer_lm),
         ("transformer_lm_long", config_transformer_lm_long),

@@ -77,20 +77,31 @@ def _time_kloop(ksteps, params, opt_state):
     )
 
 
-def _emit(name, dt, dts, batch):
-    print(json.dumps({
+def _emit(name, dt, dts, batch, **extra):
+    pos = [d for d in dts if d > 0]
+    rec = {
         "variant": name,
         "step_time_ms": round(dt * 1e3, 3),
         "samples_ms": [round(d * 1e3, 3) for d in dts],
+        # bench-wide min-of-N disclosure (the protocol every timed row
+        # carries): how many paired measurements, how far apart
+        "n_measurements": len(dts),
         "k": K,
         "global_batch": batch,
-    }), flush=True)
+    }
+    if len(pos) >= 2:
+        rec["spread_max_over_min"] = round(max(pos) / min(pos), 3)
+    rec.update(extra)
+    print(json.dumps(rec), flush=True)
 
 
 def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
-              double_buffering=False, comm_name="tpu"):
+              double_buffering=False, comm_name="tpu", wire="auto",
+              **extra):
     """Multi-node tier: build_train_step over the communicator's mesh —
-    grad psum + update in one program (k of them in one fori_loop)."""
+    grad psum + update in one program (k of them in one fori_loop).
+    ``wire`` selects the gradient wire (per_leaf / auto-bucketed /
+    codec name / WireConfig) — the wire_* rung axis."""
     import chainermn_tpu as cmn
 
     comm = cmn.create_communicator(comm_name)
@@ -98,7 +109,7 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
     x, y, init_arg = batch_fn(comm)
     params = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
     opt = cmn.create_multi_node_optimizer(
-        tx, comm, double_buffering=double_buffering
+        tx, comm, double_buffering=double_buffering, wire=wire
     )
     step = cmn.build_train_step(
         comm, lambda p, b: loss_of(model, p, b), opt, donate=False
@@ -117,8 +128,24 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
 
         return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
 
+    extra = dict(extra)
+    if getattr(opt, "wire", None) is not None:
+        from chainermn_tpu import comm_wire as _cw
+
+        plan = _cw.plan_of_tree(
+            params, opt.wire.bucket_bytes, opt.wire.max_buckets
+        )
+        extra.setdefault("wire_codec", opt.wire.codec)
+        extra.setdefault("wire_buckets", plan.n_buckets)
+        extra.setdefault("wire_n_leaves", plan.n_leaves)
+    else:
+        extra.setdefault("wire_codec", "per_leaf")
+        extra.setdefault(
+            "wire_n_leaves",
+            len(jax.tree_util.tree_leaves(params)),
+        )
     dt, dts = _time_kloop(ksteps, params, opt_state)
-    _emit(name, dt, dts, int(x.shape[0]))
+    _emit(name, dt, dts, int(x.shape[0]), **extra)
 
 
 def _run_bare(name, model_ctor, batch_fn, loss_of, tx):
@@ -218,11 +245,14 @@ def _lm_cfg():
 def _mlp_cfg():
     from chainermn_tpu.models import MLP
 
+    units = int(os.environ.get("HUNT_MLP_UNITS", "1000"))
+    b_per = int(os.environ.get("HUNT_MLP_BATCH", "256"))
+
     def ctor():
-        return MLP(n_units=1000, dtype=jnp.bfloat16)
+        return MLP(n_units=units, dtype=jnp.bfloat16)
 
     def batch(comm):
-        b = 256 * comm.size
+        b = b_per * comm.size
         x = jnp.asarray(
             np.random.RandomState(0).rand(b, 28, 28), jnp.float32
         )
@@ -262,7 +292,7 @@ def _variants():
     lm_ctor, lm_batch, lm_loss_of, lm_tx = _lm_cfg()
     ml_ctor, ml_batch, ml_loss_of, ml_tx = _mlp_cfg()
     r18_ctor, r18_batch, r18_tx = _resnet18_cfg()
-    return {
+    variants = {
         # real-chip tier.  *_dummy = DummyCommunicator at the compiled
         # tier: the identical program minus the gradient exchange —
         # (sync - dummy)/sync is the exposed-communication share.
@@ -316,6 +346,31 @@ def _variants():
             "mesh_comm_two_dimensional", ml_ctor, ml_batch, ml_loss_of,
             ml_tx, comm_name="two_dimensional"),
     }
+    # wire_* rungs: the gradient-wire A/B ladder (per-leaf vs bucketed
+    # vs bucketed+int8, sync/dummy pairs so exposed-comm share divides
+    # into launch-count savings vs byte savings; db on/off rides the
+    # bucketed path).  Runs on the CPU mesh (--cpu-mesh) in CI and on
+    # chip for driver captures.
+    from chainermn_tpu.comm_wire import WireConfig
+
+    int8_ef = WireConfig(codec="int8", error_feedback=True)
+    for rung, kw in {
+        "wire_perleaf_sync": dict(wire="per_leaf"),
+        "wire_perleaf_dummy": dict(wire="per_leaf", comm_name="dummy"),
+        "wire_bucketed_sync": dict(wire="auto"),
+        "wire_bucketed_dummy": dict(wire="auto", comm_name="dummy"),
+        "wire_int8_sync": dict(wire=int8_ef),
+        "wire_int8_dummy": dict(wire=int8_ef, comm_name="dummy"),
+        # the db-off leg IS wire_bucketed_sync (identical config) — no
+        # separate rung, or the sweep times the same program twice
+        "wire_db_on": dict(wire="auto", double_buffering=True),
+    }.items():
+        variants[rung] = (
+            lambda rung=rung, kw=kw: _run_sync(
+                rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw
+            )
+        )
+    return variants
 
 
 def main():
@@ -323,7 +378,10 @@ def main():
     default = (
         ["mesh_sync", "mesh_dummy", "mesh_db_off", "mesh_db_on",
          "mesh_resnet_sync", "mesh_resnet_dummy", "mesh_resnet_db_off",
-         "mesh_resnet_db_on"]
+         "mesh_resnet_db_on",
+         "wire_perleaf_sync", "wire_perleaf_dummy", "wire_bucketed_sync",
+         "wire_bucketed_dummy", "wire_int8_sync", "wire_int8_dummy",
+         "wire_db_on"]
         if CPU_MESH else
         ["resnet_sync", "resnet_dummy", "resnet_bare", "lm_sync",
          "lm_dummy", "lm_bare"]

@@ -375,25 +375,34 @@ class NonCudaAwareCommunicator(XlaCommunicatorBase):
     def allreduce_grad(self, grads, *, mean: bool = True):
         # Host-staged contract AND numerics-oracle contract: with a wire
         # dtype, accumulation happens in that dtype (cast -> reduce ->
-        # scale -> cast back), matching the XLA tier's fused program —
-        # including its overflow behavior.
+        # cast back -> scale), matching the XLA tier's fused program —
+        # including its overflow behavior.  Bucketed: the whole tree
+        # comes off the device in ONE device_get, the host reduce runs
+        # per wire bucket, and each bucket returns in one device_put —
+        # the plan turns a per-leaf storm of host round trips into a
+        # handful (the host-staged analogue of the compiled flat wire).
+        from .. import comm_wire as _cw
+
         dt = self._allreduce_grad_dtype
-
-        def one(g):
-            host = self._host(g)
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads
+        hosts = [self._host(g) for g in jax.device_get(leaves)]
+        size = self.size
+        plan = _cw.make_plan([h[0] for h in hosts])
+        placed = []
+        for cat in _cw.pack_stacked(plan, hosts, size, xp=np):
             if dt is None:
-                red = host.mean(axis=0) if mean else host.sum(axis=0)
+                red = cat.mean(axis=0) if mean else cat.sum(axis=0)
             else:
-                acc = host.astype(dt)
-                red = np.sum(acc, axis=0, dtype=dt)
+                red = np.sum(cat.astype(dt), axis=0, dtype=dt)
+                red = red.astype(cat.dtype)
                 if mean:
-                    red = (red / dt.type(self.size)).astype(dt)
-                red = red.astype(host.dtype)
-            return self._put(
-                jnp.asarray(np.broadcast_to(red, host.shape).copy())
-            )
-
-        return jax.tree_util.tree_map(one, grads)
+                    red = red / size
+            stacked = np.broadcast_to(red, cat.shape).copy()
+            placed.append(self._put(jnp.asarray(stacked)))
+        out = _cw.unpack_stacked(plan, placed, [h.shape for h in hosts])
+        return jax.tree_util.tree_unflatten(treedef, out)
 
 
 class NaiveCommunicator(CommunicatorBase):

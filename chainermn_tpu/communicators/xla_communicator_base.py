@@ -339,29 +339,60 @@ class XlaCommunicatorBase(CommunicatorBase):
 
     # -- reduced-precision gradient reduction --------------------------
     @functools.cached_property
-    def _allreduce_grad_cast_fn(self):
+    def _allreduce_grad_cast_fns(self):
         axes = self.axis_names
         comm_dtype = self._allreduce_grad_dtype
+        fns = {}
+        for op in ("sum", "mean"):
+            def f(g, _op=op):
+                # cast -> reduce -> cast back -> mean-scale, one fused
+                # program (parity: pure_nccl_communicator.py fp16
+                # pack/scale kernels).  The divide runs AFTER the cast
+                # back: the psum result is already off the wire, so
+                # dividing in comm_dtype would only add a second
+                # low-precision rounding.
+                orig = g.dtype
+                r = lax.psum(g.astype(comm_dtype), axes).astype(orig)
+                return r / len(self.devices) if _op == "mean" else r
 
-        def f(g):
-            # cast -> reduce -> mean-scale -> cast back, one fused program
-            # (parity: pure_nccl_communicator.py fp16 pack/scale kernels).
-            orig = g.dtype
-            r = lax.psum(g.astype(comm_dtype), axes)
-            return (r / len(self.devices)).astype(orig)
-
-        return self._shard(f)
+            fns[op] = self._shard(f)
+        return fns
 
     def allreduce_grad(self, grads, *, mean: bool = True):
-        if self._allreduce_grad_dtype is None:
-            return super().allreduce_grad(grads, mean=mean)
-        return resilient_call(
-            "collective.allreduce_grad",
-            lambda: jax.tree_util.tree_map(
-                lambda g: self._allreduce_grad_cast_fn(self._put(g)),
-                grads,
-            ),
+        """Bucketed eager gradient allreduce on stacked arrays.
+
+        The leaves are packed (per rank) into the deterministic wire
+        bucket plan and each bucket ships through ONE compiled
+        collective program — the eager tier's analogue of the compiled
+        path's flat wire (one launch per bucket instead of per leaf,
+        and a bounded number of cached jit programs).
+        """
+        from .. import comm_wire as _cw
+
+        leaves, treedef = jax.tree_util.tree_flatten(grads)
+        if not leaves:
+            return grads
+        op = "mean" if mean else "sum"
+        fn = (
+            self._allreduce_fns[op]
+            if self._allreduce_grad_dtype is None
+            else self._allreduce_grad_cast_fns[op]
         )
+        # plan on the PER-RANK portion of each stacked leaf (the wire
+        # payload each rank contributes)
+        per_rank = [l[0] if hasattr(l, "shape") and np.ndim(l) else l
+                    for l in leaves]
+        plan = _cw.make_plan(per_rank)
+
+        def run():
+            packed = _cw.pack_stacked(plan, leaves, self.size)
+            red = [fn(self._put(cat)) for cat in packed]
+            out = _cw.unpack_stacked(
+                plan, red, [jnp.shape(l) for l in leaves]
+            )
+            return jax.tree_util.tree_unflatten(treedef, out)
+
+        return resilient_call("collective.allreduce_grad", run)
 
 
 class _SplitCommunicator(XlaCommunicatorBase):

@@ -71,24 +71,80 @@ def _no_exchange(comm) -> bool:
     return bool(getattr(comm, "no_exchange", False))
 
 
-def _sync_grads(grads, comm, comm_dtype=None, axes=None):
-    """pmean gradients over mesh axes (compiled path).
-
-    ``axes`` defaults to the communicator's full axis set; hybrid DP x TP
-    steps pass the data axes only.
-    """
-    axes = comm.axis_names if axes is None else tuple(axes)
+def _axis_size(comm, axes) -> int:
     n = 1
     shape = dict(comm.mesh.shape)
     for a in axes:
         n *= shape[a]
+    return n
+
+
+def _sync_grads_per_leaf(grads, comm, comm_dtype=None, axes=None):
+    """Legacy wire: one collective PER GRADIENT LEAF (267 for
+    ResNet-50).  Kept as the `wire="per_leaf"` escape hatch and the
+    A/B baseline for the bucketed path (`benchmarks/comm_overlap_bench
+    .py wire_perleaf_*`)."""
+    axes = comm.axis_names if axes is None else tuple(axes)
+    n = _axis_size(comm, axes)
 
     def one(g):
         if comm_dtype is not None:
-            return (lax.psum(g.astype(comm_dtype), axes) / n).astype(g.dtype)
+            # divide AFTER casting off the wire: dividing while still in
+            # comm_dtype added a second low-precision rounding per
+            # element for no wire-byte saving (comm_wire.codecs doc)
+            return lax.psum(g.astype(comm_dtype), axes).astype(g.dtype) / n
         return lax.pmean(g, axes)
 
     return jax.tree_util.tree_map(one, grads)
+
+
+def _sync_grads_wire(grads, comm, wire, axes=None, residuals=None):
+    """Bucketed flat-wire gradient sync: flatten the grad pytree into
+    the deterministic bucket plan, ONE collective per bucket, unflatten.
+
+    Returns ``(synced_tree, new_residuals)``; ``new_residuals`` is ()
+    unless ``wire.error_feedback``.  Element order within a bucket is
+    tree-flatten order, so the uncompressed bucketed psum is
+    bit-identical to the per-leaf psum (elementwise reduction — grouping
+    changes neither summands nor rank order; pinned at 0 tolerance by
+    tests/test_comm_wire.py)."""
+    from . import comm_wire as _cw
+
+    axes = comm.axis_names if axes is None else tuple(axes)
+    n = _axis_size(comm, axes)
+    plan = _cw.plan_of_tree(grads, wire.bucket_bytes, wire.max_buckets)
+    buckets = _cw.flatten_to_buckets(plan, grads)
+    means, new_res = _cw.reduce_buckets(
+        buckets, axes, n, wire, residuals if residuals else None
+    )
+    return _cw.unflatten_from_buckets(plan, means, grads), tuple(new_res)
+
+
+def _sync_grads(grads, comm, comm_dtype=None, axes=None, wire="auto"):
+    """Gradient sync over mesh axes (compiled path).
+
+    Default: bucketed flat wire (the tentpole path — collective count =
+    bucket count, not leaf count) with the codec implied by
+    ``comm_dtype``.  ``wire="per_leaf"`` selects the legacy
+    one-psum-per-leaf lowering.  ``axes`` defaults to the communicator's
+    full axis set; hybrid DP x TP steps pass the data axes only.
+    """
+    from .comm_wire import codec_of_dtype, resolve_wire
+
+    cfg = resolve_wire(wire, comm)  # validates explicit WireConfigs too
+    if cfg is None:
+        return _sync_grads_per_leaf(grads, comm, comm_dtype, axes)
+    if comm_dtype is not None and wire in (None, "auto"):
+        try:
+            cfg = cfg._replace(codec=codec_of_dtype(comm_dtype))
+        except ValueError:
+            # an explicit comm_dtype with no wire codec (e.g. float64)
+            # gets the same treatment as the communicator's own
+            # allreduce_grad_dtype under "auto": the legacy per-leaf
+            # cast keeps working instead of raising at trace time
+            return _sync_grads_per_leaf(grads, comm, comm_dtype, axes)
+    synced, _ = _sync_grads_wire(grads, comm, cfg, axes)
+    return synced
 
 
 def _tree_all_finite(grads):
@@ -109,33 +165,89 @@ def _tree_all_finite(grads):
 class MultiNodeOptimizerState(NamedTuple):
     inner_state: Any
     step: jnp.ndarray
+    # error-feedback residual (flat wire buckets) when the wire codec is
+    # lossy and error_feedback is on; () otherwise — compressed rounding
+    # error is re-injected into the NEXT step's gradient instead of lost
+    wire_residual: Any = ()
 
 
 class DoubleBufferingState(NamedTuple):
     inner_state: Any
     step: jnp.ndarray
-    prev_grads: Any  # local grads of the previous step (pre-sync)
+    # local grads of the previous step (pre-sync).  On the bucketed wire
+    # this is the tuple of FLAT buckets in the wire's storage dtype —
+    # smaller state than a full param-shaped tree for cast codecs, and
+    # step i+1 issues a handful of large collectives instead of a leaf
+    # storm.  The legacy per-leaf wire keeps the param-shaped tree.
+    prev_grads: Any
 
 
 class _MultiNodeOptimizer:
     """Attribute-delegating wrapper (parity: ``_MultiNodeOptimizer``'s
-    ``__getattr__`` delegation to the actual optimizer)."""
+    ``__getattr__`` delegation to the actual optimizer).
 
-    def __init__(self, actual_optimizer: optax.GradientTransformation, comm):
+    ``wire`` selects the gradient wire (see ``create_multi_node_
+    optimizer``): "auto" derives the codec from the communicator's
+    ``allreduce_grad_dtype``; "per_leaf" is the legacy one-collective-
+    per-leaf path; a codec name or ``comm_wire.WireConfig`` selects
+    explicitly.
+    """
+
+    def __init__(self, actual_optimizer: optax.GradientTransformation,
+                 comm, wire="auto"):
+        from .comm_wire import resolve_wire
+
         self._opt = actual_optimizer
         self._comm = comm
+        self._wire = resolve_wire(wire, comm)  # None => per-leaf legacy
 
     @property
     def communicator(self):
         return self._comm
 
     @property
+    def wire(self):
+        """Resolved ``comm_wire.WireConfig`` (None on the legacy path)."""
+        return self._wire
+
+    @property
     def actual_optimizer(self):
         return self._opt
 
+    def _zero_residuals(self, params):
+        from . import comm_wire as _cw
+
+        w = self._wire
+        if w is None or not w.error_feedback:
+            return ()
+        plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
+        return _cw.zero_residuals(plan, params)
+
+    def _check_plan_agreement(self, params):
+        """Cross-process plan guard at init time: in a multi-controller
+        world a divergent bucket plan (the processes built different
+        models) would deadlock or silently mix wire layouts at the
+        first bucketed collective — fail loudly with
+        ``WirePlanMismatchError`` here instead.  Skipped under tracing
+        (the eager obj-store exchange is impossible) and in
+        single-process worlds (nothing to disagree with)."""
+        from . import comm_wire as _cw
+
+        w, comm = self._wire, self._comm
+        if w is None or getattr(comm, "process_count", 1) <= 1:
+            return
+        leaves = jax.tree_util.tree_leaves(params)
+        if any(isinstance(l, jax.core.Tracer) for l in leaves):
+            return
+        plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
+        _cw.plan_agreement(comm, plan)
+
     def init(self, params):
+        self._check_plan_agreement(params)
         return MultiNodeOptimizerState(
-            inner_state=self._opt.init(params), step=jnp.zeros((), jnp.int32)
+            inner_state=self._opt.init(params),
+            step=jnp.zeros((), jnp.int32),
+            wire_residual=self._zero_residuals(params),
         )
 
     def update(self, grads, state, params=None, sync_axes=None):
@@ -144,12 +256,20 @@ class _MultiNodeOptimizer:
         (hybrid steps whose autodiff already produced global grads)."""
         comm = self._comm
         axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
+        residual = getattr(state, "wire_residual", ())
         if axes and _axes_bound(axes) and not _no_exchange(comm):
-            grads = _sync_grads(
-                grads, comm, comm.allreduce_grad_dtype, axes=axes
-            )
+            if self._wire is None:
+                grads = _sync_grads_per_leaf(
+                    grads, comm, comm.allreduce_grad_dtype, axes=axes
+                )
+            else:
+                grads, residual = _sync_grads_wire(
+                    grads, comm, self._wire, axes=axes, residuals=residual
+                )
         updates, inner = self._opt.update(grads, state.inner_state, params)
-        return updates, MultiNodeOptimizerState(inner, state.step + 1)
+        return updates, MultiNodeOptimizerState(
+            inner, state.step + 1, residual
+        )
 
     # optax-compatible alias pair so the wrapper *is* a GradientTransformation
     def __iter__(self):
@@ -172,24 +292,67 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
     buffer swap).
     """
 
+    def _plan(self, tree):
+        from . import comm_wire as _cw
+
+        w = self._wire
+        return _cw.plan_of_tree(tree, w.bucket_bytes, w.max_buckets)
+
+    def _store(self, plan, tree):
+        """Flatten grads into the stale-grad buffer: flat buckets in the
+        wire's storage dtype (half the state bytes for cast codecs)."""
+        from . import comm_wire as _cw
+
+        buckets = _cw.flatten_to_buckets(plan, tree)
+        return tuple(
+            b.astype(_cw.storage_dtype(self._wire, spec.dtype))
+            for b, spec in zip(buckets, plan.buckets)
+        )
+
     def init(self, params):
-        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        self._check_plan_agreement(params)
+        if self._wire is None:  # legacy per-leaf wire: param-shaped tree
+            prev = jax.tree_util.tree_map(jnp.zeros_like, params)
+        else:
+            plan = self._plan(params)
+            prev = self._store(plan, jax.tree_util.tree_map(
+                jnp.zeros_like, params
+            ))
         return DoubleBufferingState(
             inner_state=self._opt.init(params),
             step=jnp.zeros((), jnp.int32),
-            prev_grads=zeros,
+            prev_grads=prev,
         )
 
     def update(self, grads, state, params=None, sync_axes=None):
+        from . import comm_wire as _cw
+
         comm = self._comm
-        prev = state.prev_grads
         axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
-        if axes and _axes_bound(axes) and not _no_exchange(comm):
-            prev = _sync_grads(
-                prev, comm, comm.allreduce_grad_dtype, axes=axes
-            )
+        do_sync = axes and _axes_bound(axes) and not _no_exchange(comm)
+        if self._wire is None:
+            prev = state.prev_grads
+            if do_sync:
+                prev = _sync_grads_per_leaf(
+                    prev, comm, comm.allreduce_grad_dtype, axes=axes
+                )
+            new_prev = grads
+        else:
+            plan = self._plan(grads)
+            # stored buckets back to the plan's native dtype: the codec
+            # re-casts onto the wire itself, the decode stays native
+            prev_buckets = [
+                b.astype(jnp.dtype(spec.dtype))
+                for b, spec in zip(state.prev_grads, plan.buckets)
+            ]
+            if do_sync:
+                prev_buckets, _ = _cw.reduce_buckets(
+                    prev_buckets, axes, _axis_size(comm, axes), self._wire
+                )
+            prev = _cw.unflatten_from_buckets(plan, prev_buckets, grads)
+            new_prev = self._store(plan, grads)
         updates, inner = self._opt.update(prev, state.inner_state, params)
-        return updates, DoubleBufferingState(inner, state.step + 1, grads)
+        return updates, DoubleBufferingState(inner, state.step + 1, new_prev)
 
 
 def _to_blocks(x, n):
@@ -233,6 +396,7 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
         return jax.tree_util.tree_map(lambda x: _to_blocks(x, n), tree)
 
     def init(self, params):
+        self._check_plan_agreement(params)
         return MultiNodeOptimizerState(
             inner_state=self._opt.init(self._blocks(params)),
             step=jnp.zeros((), jnp.int32),
@@ -253,11 +417,33 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
 
         return jax.tree_util.tree_map(spec, opt_state)
 
+    def _wire_groups(self, blocked_leaves):
+        """Group blocked ``(n, k)`` leaves into wire buckets (same
+        greedy dtype-homogeneous planner as the flat-wire path, applied
+        to the blocked view).  Returns the plan whose slots index into
+        ``blocked_leaves``; column offsets are reconstructed from the
+        per-leaf widths at pack time."""
+        from . import comm_wire as _cw
+
+        w = self._wire or _cw.WireConfig()
+        return _cw.make_plan(blocked_leaves, w.bucket_bytes, w.max_buckets)
+
     def update(self, grads, state, params=None):
+        from .comm_wire import codecs as _codecs
+
         comm = self._comm
         n = comm.size
         axes = comm.axis_names
-        wire_dtype = comm.allreduce_grad_dtype
+        if self._wire is not None:
+            if self._wire.codec == "int8":
+                raise ValueError(
+                    "int8 wire is not supported on the zero_redundancy "
+                    "path (the reduce-scatter would need per-shard "
+                    "scale agreement); use bf16/f16"
+                )
+            wire_dtype = _codecs._CAST_WIRE.get(self._wire.codec)
+        else:
+            wire_dtype = comm.allreduce_grad_dtype
         tree_map = jax.tree_util.tree_map
         g_blocks = self._blocks(grads)
         p_blocks = self._blocks(params) if params is not None else None
@@ -269,9 +455,57 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
                 local = lax.psum_scatter(
                     gw, axes, scatter_dimension=0, tiled=False
                 )
-                return (local / n).astype(g.dtype)[None]
+                # mean in the native dtype, not on the wire
+                return (local.astype(g.dtype) / n)[None]
 
-            local_g = tree_map(scatter, g_blocks)
+            def gather(u):
+                return lax.all_gather(u, axes, axis=0, tiled=True)
+
+            leaves, treedef = jax.tree_util.tree_flatten(g_blocks)
+            if self._wire is None or len(leaves) <= 1:
+                local_g = tree_map(scatter, g_blocks)
+                gather_blocks = lambda upd: tree_map(gather, upd)  # noqa: E731
+            else:
+                # Bucketed wire: concatenate blocked leaves column-wise
+                # into dtype-homogeneous buckets -> ONE reduce-scatter
+                # per bucket down, ONE all-gather per bucket up (the
+                # allreduce split in halves, per bucket instead of per
+                # leaf).  Columns here are the blocked width s.shape[1]
+                # (the (n, k) view must survive the scatter dimension),
+                # so comm_wire.pack_stacked's flat (size, -1) layout
+                # does not apply.
+                plan = self._wire_groups(leaves)
+                local_leaves = [None] * len(leaves)
+                packed = []
+                for b in plan.buckets:
+                    cat = jnp.concatenate(
+                        [leaves[s.index] for s in b.slots], axis=1
+                    )
+                    packed.append((b, scatter(cat)))  # (1, K)
+                for b, loc in packed:
+                    col = 0
+                    for s in b.slots:
+                        k = s.shape[1]
+                        local_leaves[s.index] = loc[:, col : col + k]
+                        col += k
+                local_g = jax.tree_util.tree_unflatten(
+                    treedef, local_leaves
+                )
+
+                def gather_blocks(upd):
+                    up_leaves = treedef.flatten_up_to(upd)
+                    out = [None] * len(up_leaves)
+                    for b in plan.buckets:
+                        cat = gather(jnp.concatenate(
+                            [up_leaves[s.index] for s in b.slots], axis=1
+                        ))
+                        col = 0
+                        for s in b.slots:
+                            k = s.shape[1]
+                            out[s.index] = cat[:, col : col + k]
+                            col += k
+                    return jax.tree_util.tree_unflatten(treedef, out)
+
             local_p = (
                 tree_map(
                     lambda p: lax.dynamic_slice_in_dim(p, idx, 1, axis=0),
@@ -283,10 +517,7 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
             upd_local, inner = self._opt.update(
                 local_g, state.inner_state, local_p
             )
-            upd_blocks = tree_map(
-                lambda u: lax.all_gather(u, axes, axis=0, tiled=True),
-                upd_local,
-            )
+            upd_blocks = gather_blocks(upd_local)
         else:
             # Eager / GSPMD path: full-width block update — identical
             # numerics for elementwise transforms, state shape unchanged.
@@ -302,12 +533,32 @@ def create_multi_node_optimizer(
     communicator,
     double_buffering: bool = False,
     zero_redundancy: bool = False,
+    wire="auto",
 ) -> _MultiNodeOptimizer:
     """Wrap an optax optimizer for multi-chip training.
 
     Parity: ``chainermn.create_multi_node_optimizer``.  ``zero_redundancy``
     shards the optimizer state across the communicator (ZeRO-1) — a TPU-era
     capability beyond the reference's feature set.
+
+    ``wire`` selects the gradient wire (``chainermn_tpu.comm_wire``):
+
+    * ``"auto"`` (default) — bucketed flat wire, codec derived from the
+      communicator's ``allreduce_grad_dtype`` (None -> ``none``,
+      bfloat16 -> ``bf16``, float16 -> ``f16`` — the reference's
+      ``PureNcclCommunicator(allreduce_grad_dtype=...)`` knob mapped
+      onto codecs).  The compiled step issues ONE collective per bucket
+      (default: 4 MiB targets coalesced into at most 6 buckets) instead
+      of one per gradient leaf.
+    * ``"per_leaf"`` — the pre-wire lowering (one psum per leaf), kept
+      as the A/B baseline and escape hatch.
+    * a codec name (``"none"``/``"f32"``/``"bf16"``/``"f16"``/
+      ``"int8"``) or a :class:`~chainermn_tpu.comm_wire.WireConfig`
+      (codec + bucket_bytes + max_buckets + error_feedback) — explicit
+      control.  ``int8`` ships 1 byte/element plus one f32 scale per
+      bucket; combine with ``error_feedback=True`` so rounding error is
+      carried into the next step (fp32-equivalent convergence, pinned
+      by the MLP convergence test).
 
     ``double_buffering`` (stale-by-one gradients, reference parity):
     LEAVE IT OFF unless you have measured a win on your topology.  On a
@@ -325,9 +576,32 @@ def create_multi_node_optimizer(
             "defeats the sharded-state memory saving"
         )
     if zero_redundancy:
-        return _ZeroRedundancyOptimizer(actual_optimizer, communicator)
-    cls = _DoubleBufferingOptimizer if double_buffering else _MultiNodeOptimizer
-    return cls(actual_optimizer, communicator)
+        cls = _ZeroRedundancyOptimizer
+    elif double_buffering:
+        cls = _DoubleBufferingOptimizer
+    else:
+        cls = _MultiNodeOptimizer
+    opt = cls(actual_optimizer, communicator, wire=wire)
+    cfg = opt.wire  # resolved + validated ONCE, by the constructor
+    if cfg is not None and cfg.error_feedback:
+        if double_buffering:
+            raise ValueError(
+                "error_feedback cannot be combined with double_buffering: "
+                "the residual would correct a gradient that is already "
+                "one step stale by the time it ships"
+            )
+        if zero_redundancy:
+            raise ValueError(
+                "error_feedback is not supported on the zero_redundancy "
+                "path (the residual of a reduce-scattered bucket lives "
+                "on no single rank)"
+            )
+    if zero_redundancy and cfg is not None and cfg.codec == "int8":
+        raise ValueError(
+            "int8 wire is not supported on the zero_redundancy path; "
+            "use bf16/f16"
+        )
+    return opt
 
 
 # ----------------------------------------------------------------------

@@ -636,6 +636,86 @@ def scenario_resilience(pid, nproc, scratch):
     return {"final_w": finals[0], "restarts": trainer.restarts}
 
 
+def scenario_wire_int8(pid, nproc, scratch):
+    """ISSUE 4 satellite: the bucketed+int8 gradient wire end to end in
+    a real 2-process world, under the fault injector.
+
+    The spawning test sets CHAINERMN_TPU_FAULTS to truncate the FIRST
+    ``obj_store.exchange`` payload on every process: each process
+    truncates its *own* outgoing plan-hash payload, so every process
+    observes the corruption (`PayloadCorruptionError`) and retries the
+    exchange in lockstep — the collective stream stays aligned, the
+    retry's clean exchange agrees on the plan hash, and the compiled
+    int8+error-feedback run completes with bit-identical params on all
+    processes.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.comm_wire import (
+        WireConfig, plan_agreement, plan_of_tree,
+    )
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = _comm()
+    rng = np.random.RandomState(0)  # same seed -> same model everywhere
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+    }
+    wire = WireConfig(codec="int8", error_feedback=True)
+
+    # plan agreement: the first exchange carries a truncated payload ->
+    # PayloadCorruptionError -> retried -> every process agrees
+    plan = plan_of_tree(params, wire.bucket_bytes, wire.max_buckets)
+    agreed = plan_agreement(comm, plan)
+    assert agreed == plan.plan_hash()
+    inj = fi.active()
+    assert inj is not None, "fault injector must be env-activated"
+    assert inj.log.counts.get("fault_injected", 0) >= 1, (
+        "the truncate fault must have fired before the retry succeeded"
+    )
+
+    # compiled bucketed+int8+EF training across the 2-process mesh
+    w_true = rng.randn(8, 4).astype(np.float32)
+    x_all = rng.randn(16, 8).astype(np.float32)
+    y_all = x_all @ w_true
+
+    def loss_fn(p, b):
+        bx, by = b
+        h = jnp.tanh(bx @ p["w1"])
+        return jnp.mean((h @ p["w2"] - by) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.05), comm,
+                                          wire=wire)
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    p, o = step.place(params, opt.init(params))
+    lo = pid * (16 // nproc)  # per-process slice of the global batch
+    hi = lo + 16 // nproc
+    batch = (x_all[lo:hi], y_all[lo:hi])
+    first = last = None
+    for _ in range(20):
+        p, o, m = step(p, o, batch)
+        last = float(m["loss"])
+        if first is None:
+            first = last
+    assert last < first, (first, last)
+    assert isinstance(o.wire_residual, tuple) and o.wire_residual
+
+    # bit-identical replicated params on every process (sha256, not
+    # hash(): bytes hashing is salted per process)
+    import hashlib
+
+    digests = comm.allgather_obj(hashlib.sha256(
+        b"".join(np.asarray(p[k]).tobytes() for k in sorted(p))
+    ).hexdigest())
+    assert all(d == digests[0] for d in digests), digests
+    return {"first_loss": first, "final_loss": last,
+            "faults": inj.log.counts.get("fault_injected", 0)}
+
+
 def scenario_except_hook(pid, nproc, scratch):
     """Failure containment: process 1 raises; its global except hook
     shuts the distributed client down; process 0, blocked in a KV recv,

@@ -226,6 +226,30 @@ class TestResilience:
         )
 
 
+class TestWireInt8:
+    def test_bucketed_int8_wire_under_fault_injector(self, tmp_path):
+        """ISSUE 4 satellite: the bucketed+int8 gradient wire end to end
+        in a real 2-process world.  The FIRST obj-store exchange (the
+        bucket-plan-hash agreement) ships a truncated payload on every
+        process -> PayloadCorruptionError everywhere in lockstep ->
+        plan_agreement retries -> the compiled int8+error-feedback run
+        completes with bit-identical params on both processes."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "truncate", "at": [1],
+             "truncate_to": 4},
+        ])
+        res = run_world(
+            "wire_int8", n_procs=2, local_devices=2, tmpdir=tmp_path,
+            timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "wire_int8")
+        assert all(p["faults"] >= 1 for p in payloads)
+        assert all(p["final_loss"] < p["first_loss"] for p in payloads)
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

@@ -106,6 +106,7 @@ def _fingerprint(**kw):
 
 from chainermn_tpu.utils.benchmarking import (  # noqa: E402
     force_completion as _force,
+    protocol_fields as _spread_fields,
     time_kloop as _time_kloop,
     time_steps as _time_steps_raw,
 )
@@ -140,16 +141,10 @@ def _burned_kloop(run_k, k, repeats=2):
     return _time_kloop(run_k, k, repeats)
 
 
-def _spread_fields(samples):
-    """min-of-N disclosure for one timed row: how many paired
-    measurements were taken and how far apart they landed (transport
-    noise only ADDS time, so the min is the number and the spread is
-    the honesty bar next to it)."""
-    pos = [s for s in samples if s > 0]
-    out = {"n_measurements": len(samples)}
-    if len(pos) >= 2:
-        out["spread_max_over_min"] = round(max(pos) / min(pos), 3)
-    return out
+# _spread_fields is utils.benchmarking.protocol_fields (imported above):
+# the min-of-N disclosure — n_measurements + spread_max_over_min — is
+# ONE protocol defined in one place, shared with every benchmarks/
+# script and enforced by analysis.lint's untimed-row rule.
 
 
 def _copy_spread(dst, src, suffix=""):
@@ -406,6 +401,9 @@ def _uint8_link_ceiling(dev, batch, image, k=8):
     rtt = h2d_bench.measure_rtt(dev)
     bw = h2d_bench.measure_h2d(dev, probe, arrs, depth=2)
     t_batch = arrs[0].nbytes / bw + rtt
+    # component fields merged (**link) into the native-input row, which
+    # carries the row-level n_measurements/spread disclosure itself
+    # mnlint: allow(untimed-row)
     return {
         "link_uint8_MBps": round(bw / 1e6, 1),
         "link_rtt_ms": round(rtt * 1e3, 2),
@@ -1225,7 +1223,9 @@ def main():
                     json.dump(full, f, indent=1)
             except OSError:
                 pass
-        headline["summary"] = {
+        # compact VIEW of rows already captured (with their protocol
+        # fields) in bench_out.json — not a measurement row
+        headline["summary"] = {  # mnlint: allow(untimed-row)
             k: {
                 "v": v.get("value"),
                 "mfu": v.get("mfu"),

@@ -29,7 +29,12 @@ import numpy as np
 
 from chainermn_tpu.ops.attention import multi_head_attention
 from chainermn_tpu.ops.pallas_attention import flash_attention
-from chainermn_tpu.utils.benchmarking import force_completion, time_steps
+from chainermn_tpu.utils.benchmarking import (
+    force_completion,
+    min_positive,
+    protocol_fields,
+    time_steps,
+)
 
 
 def _time(fn, *args, steps=20):
@@ -117,6 +122,9 @@ def bench_seq(seq, batch, heads, dim, causal, steps, taxonomy_ab=False):
     )
 
     res = {}
+    # variant-name -> (fn, args) map, NOT an emitted row; the row built
+    # in main() carries the protocol fields
+    # mnlint: allow(untimed-row)
     variants = {
         "fwd_flash_ms": (flash_f, (q, k, v)),
         "fwd_xla_ms": (xla_f, (q, k, v)),
@@ -146,11 +154,27 @@ def bench_seq(seq, batch, heads, dim, causal, steps, taxonomy_ab=False):
         leg_f, leg_g = with_tax("legacy")
         variants["fwd_flash_legacy_ms"] = (leg_f, (q, k, v))
         variants["bwd_flash_legacy_ms"] = (leg_g, (q, k, v))
+    # min-of-N per leg; the row-level disclosure follows bench.py's
+    # _ab_disclosure convention (n_measurements summed over legs,
+    # spread = the worst leg's)
+    repeats = int(os.environ.get("ATTN_REPEATS", "2"))
+    n_meas, spreads = 0, []
     for name, (fn, fargs) in variants.items():
         try:
-            res[name] = _time(fn, *fargs, steps=steps) * 1e3
+            samples = [
+                _time(fn, *fargs, steps=steps) * 1e3
+                for _ in range(repeats)
+            ]
+            res[name] = min_positive(samples)
+            leg = protocol_fields(samples)
+            n_meas += leg["n_measurements"]
+            if "spread_max_over_min" in leg:
+                spreads.append(leg["spread_max_over_min"])
         except Exception as e:
             res[name] = _classify(e)
+    res["protocol"] = {"n_measurements": n_meas}
+    if spreads:
+        res["protocol"]["spread_max_over_min"] = round(max(spreads), 3)
     res["max_abs_err_vs_xla"] = max_err
     return res
 
@@ -205,6 +229,7 @@ def main():
                 "bwd_flash_ms": fmt(r["bwd_flash_ms"]),
                 "bwd_xla_ms": fmt(r["bwd_xla_ms"]),
                 "bwd_speedup": ratio(r["bwd_xla_ms"], r["bwd_flash_ms"]),
+                **r["protocol"],
             }
             if args.taxonomy_ab:
                 rec.update({

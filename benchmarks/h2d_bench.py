@@ -130,6 +130,9 @@ def main():
         return args.batch / t_batch
 
     print(json.dumps({
+        # each bandwidth figure is ONE paired k/2k transfer measurement
+        # (constants cancelled, per-figure); no cross-repeat spread
+        "n_measurements": 1,
         "device": str(getattr(dev, "device_kind", dev)),
         "batch_bytes_MiB": round(batch_bytes / 2**20, 2),
         "u8_batch_bytes_MiB": round(u8_bytes / 2**20, 2),

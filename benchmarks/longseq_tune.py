@@ -37,7 +37,7 @@ from jax import lax
 from bench import _peak_flops
 from chainermn_tpu.models.transformer import TransformerLM, lm_loss
 from chainermn_tpu.ops.pallas_attention import flash_attention_fn
-from chainermn_tpu.utils.benchmarking import time_kloop
+from chainermn_tpu.utils.benchmarking import protocol_fields, time_kloop
 
 K = int(os.environ.get("HUNT_K", "8"))
 VOCAB, D, LAYERS, HEADS = 32768, 1024, 8, 8
@@ -131,6 +131,7 @@ def time_variant(name, *, batch=None, loss="lm", attention="flash",
         "step_time_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(batch * SEQ / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
+        **protocol_fields(dts),
     }
     if attention == "flash":
         # census of the geometry that ran (clamps applied) — see the

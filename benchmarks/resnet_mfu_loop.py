@@ -30,6 +30,7 @@ from flax import linen as nn
 from jax import lax
 
 from chainermn_tpu.models import ResNet50
+from chainermn_tpu.utils.benchmarking import protocol_fields
 from chainermn_tpu.models.resnet import Bottleneck, ResNet
 
 K = int(os.environ.get("HUNT_K", "40"))
@@ -203,6 +204,7 @@ def time_variant(name, model, batch, image=224, mutable_bn=True,
         "step_time_ms": round(dt * 1e3, 2),
         "img_per_sec": round(batch / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
+        **protocol_fields(dts),
     }
     if flops:
         out["tflops_per_step"] = round(flops / 1e12, 3)

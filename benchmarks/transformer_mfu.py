@@ -46,6 +46,7 @@ from jax import lax
 
 from bench import _flash_attn_tflops, _peak_flops
 from chainermn_tpu.models.transformer import TransformerLM, lm_loss
+from chainermn_tpu.utils.benchmarking import protocol_fields
 from chainermn_tpu.ops.pallas_attention import flash_attention_fn
 
 K = int(os.environ.get("HUNT_K", "10"))
@@ -169,6 +170,7 @@ def time_variant(name, *, batch=8, loss="lm", attention="flash",
         "step_time_ms": round(dt * 1e3, 2),
         "tokens_per_sec": round(batch * SEQ / dt, 1),
         "samples": [round(d * 1e3, 2) for d in dts],
+        **protocol_fields(dts),
     }
     if attention == "flash":
         # segment anatomy: the static block census this launch executes

@@ -1,0 +1,54 @@
+"""Static analysis of the collective surface.
+
+SPMD correctness hinges on every rank tracing the same ordered sequence
+of collectives; collective count/dtype/ordering are also the
+communication-performance levers (PAPERS.md: DynamiQ, multi-node
+inference comm studies).  This package makes both first-class:
+
+* :mod:`.trace` — walk any jittable function's closed jaxpr (through
+  ``pjit``/``scan``/``cond``/``while``/``shard_map``) into an ordered
+  :class:`CollectiveTrace`;
+* :mod:`.checks` — the check catalog: cross-process divergence guard
+  (:func:`trace_agreement`), deadlock lint on data-dependent ``cond``
+  branches, mesh-axis audit, narrowing-cast wire audit, and budget
+  enforcement;
+* :mod:`.hlo` — the lowered-text census the trace cross-checks against;
+* :mod:`.budgets` — pinned per-program collective ceilings;
+* :mod:`.lint` — the repo AST gate
+  (``python -m chainermn_tpu.analysis.lint``).
+
+The divergence guard is production-wired: ``build_train_step``'s first
+dispatch in a multi-process world exchanges the trace hash and raises
+``CollectiveTraceMismatchError`` before any collective runs (see
+docs/static_analysis.md).
+"""
+
+from .trace import (  # noqa: F401
+    COLLECTIVE_CLASS,
+    CollectiveRecord,
+    CollectiveTrace,
+    CondBranchReport,
+    NarrowingCast,
+    trace_collectives,
+    trace_jaxpr,
+)
+from .checks import (  # noqa: F401
+    CollectiveBudgetError,
+    Finding,
+    assert_within_budget,
+    check_axes,
+    check_deadlocks,
+    check_wire,
+    run_all,
+    trace_agreement,
+)
+from .hlo import (  # noqa: F401
+    assert_census_agreement,
+    hlo_census,
+    lowered_census,
+)
+from .budgets import BUDGETS, budget_for, enforce  # noqa: F401
+
+# re-exported so `except analysis.CollectiveTraceMismatchError` works at
+# the place the guard is documented
+from ..resilience.errors import CollectiveTraceMismatchError  # noqa: F401

@@ -1,0 +1,65 @@
+"""Pinned collective budgets for the repo's compiled paths.
+
+Collective *count* is a performance contract: the flat-wire layer exists
+precisely to turn ResNet-50's 267-leaf psum storm into <= 6 bucket
+reductions + 1 loss pmean, and regressions re-introduce themselves
+silently (one refactor that defeats bucketing costs nothing at trace
+time and everything on the wire).  Each entry here is a ceiling, not a
+target — enforced by :func:`~chainermn_tpu.analysis.checks.
+assert_within_budget` on the walker census in the tier-1 tests, where a
+string-grep of HLO used to live.
+
+Ceilings derive from the wire plan: ``DEFAULT_MAX_BUCKETS`` (6) grad
+buckets + 1 loss pmean (+1 int8 scale pmax where applicable).  ZeRO
+replaces the bucket all-reduces with one reduce-scatter down and one
+all-gather up per bucket.  The MoE expert path adds exactly 2 all_to_all
+per MoE layer (dispatch + return) and the pipeline path 1 ppermute per
+stage edge per direction.
+"""
+
+from __future__ import annotations
+
+from .trace import CollectiveTrace
+from .checks import assert_within_budget
+
+# The data-parallel all_reduce ceiling is the wire-plan contract:
+# comm_wire.DEFAULT_MAX_BUCKETS (6) grad buckets + 1 loss pmean, with
+# one ceiling notch of slack (= 8) so a bucket-count change inside the
+# promised <= 6 never trips the pin.  Numbers are literal (not imported)
+# so a planner default drift FAILS the pin instead of moving it.
+BUDGETS = {
+    # ISSUE 5 acceptance: the ResNet-50 train step stays <= 8 all-reduce
+    # (267 leaves -> 4 default buckets + 1 loss pmean measured; 8 is the
+    # contract ceiling the wire layer promised in ISSUE 4).
+    "resnet50_train_step": {"all_reduce": 8},
+    # transformer LM data-parallel step: same wire plan contract.
+    "transformer_train_step": {"all_reduce": 8},
+    # MLP/MNIST tier: small trees still bucket (never leaf-storm).
+    "mlp_train_step": {"all_reduce": 8},
+    # ZeRO-1: one reduce-scatter down + one all-gather up per bucket,
+    # loss pmean stays the only all-reduce.
+    "zero_train_step": {
+        "reduce_scatter": 6,
+        "all_gather": 6,
+        "all_reduce": 1,
+    },
+    # Expert-parallel MoE layer: dispatch + return = exactly 2
+    # all_to_all per call (``parallel.expert_parallel``).
+    "ep_moe_layer": {"all_to_all": 2},
+    # Pipeline forward chain: one ppermute edge per stage boundary and
+    # one loss-broadcast psum (``parallel.pipeline``).
+    "pipeline_forward": {"collective_permute": 1, "all_reduce": 1},
+}
+
+
+def budget_for(name: str) -> dict:
+    if name not in BUDGETS:
+        raise KeyError(
+            f"no pinned budget named {name!r}; known: {sorted(BUDGETS)}"
+        )
+    return dict(BUDGETS[name])
+
+
+def enforce(name: str, trace: CollectiveTrace) -> dict:
+    """Assert ``trace`` stays within the named pin; returns the census."""
+    return assert_within_budget(trace, budget_for(name), name=name)

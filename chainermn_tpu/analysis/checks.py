@@ -1,0 +1,232 @@
+"""Checks over a :class:`~chainermn_tpu.analysis.trace.CollectiveTrace`.
+
+The check catalog (docs/static_analysis.md):
+
+* **divergence guard** — :func:`trace_agreement`: exchange the canonical
+  trace hash across processes (like ``comm_wire.plan_agreement``) so
+  rank-divergent collective sequences raise
+  :class:`~chainermn_tpu.resilience.errors.CollectiveTraceMismatchError`
+  loudly on every rank *before* the first collective deadlocks.
+* **deadlock lint** — :func:`check_deadlocks`: collectives inside
+  data-dependent ``cond`` branches.  Arms with *different* collective
+  sequences are errors (a rank-dependent predicate then deadlocks);
+  arms with identical sequences are surfaced as warnings (aligned
+  today, one edit from divergent).
+* **axis audit** — :func:`check_axes`: every collective's axis names
+  must exist in the active mesh/topology.
+* **wire audit** — :func:`check_wire`: dtype-narrowing casts feeding a
+  reduction outside the sanctioned ``comm_wire`` codecs (the compressed
+  wire formats carry scale/error-feedback machinery; a bare
+  ``psum(g.astype(bf16))`` anywhere else is an unaudited precision
+  loss).
+* **budget pins** — :func:`assert_within_budget`: per-program
+  collective-count ceilings (``analysis.budgets``) enforced from the
+  trace census instead of string-grepping HLO.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Iterable, Mapping, Optional, Sequence
+
+from .trace import CollectiveTrace
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One check result.  ``severity``: "error" (will deadlock / is
+    wrong) or "warning" (legal but one edit from wrong)."""
+
+    check: str
+    severity: str
+    message: str
+    source: Optional[str] = None
+
+    def __str__(self) -> str:
+        where = f" [{self.source}]" if self.source else ""
+        return f"{self.check}/{self.severity}: {self.message}{where}"
+
+
+class CollectiveBudgetError(AssertionError):
+    """A traced program exceeds its pinned collective budget."""
+
+
+# ----------------------------------------------------------------------
+# deadlock lint
+# ----------------------------------------------------------------------
+def check_deadlocks(trace: CollectiveTrace) -> list:
+    findings = []
+    for rep in trace.cond_reports:
+        if not rep.has_collectives:
+            continue
+        counts = [len(s) for s in rep.branch_signatures]
+        if rep.diverges:
+            findings.append(Finding(
+                check="deadlock",
+                severity="error",
+                message=(
+                    f"{rep.cond_id}: branches trace different collective "
+                    f"sequences ({counts} collectives per branch) — a "
+                    "rank-dependent predicate deadlocks here"
+                ),
+                source=rep.source,
+            ))
+        else:
+            findings.append(Finding(
+                check="deadlock",
+                severity="warning",
+                message=(
+                    f"{rep.cond_id}: {counts[0]} collective(s) inside a "
+                    "data-dependent cond (branches currently agree; keep "
+                    "them in lockstep or hoist the collective out)"
+                ),
+                source=rep.source,
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# axis audit
+# ----------------------------------------------------------------------
+def check_axes(trace: CollectiveTrace, axis_names: Iterable[str]) -> list:
+    """``axis_names``: the active mesh/topology axes — pass
+    ``comm.axis_names`` or ``mesh.axis_names``."""
+    if isinstance(axis_names, str):  # a bare axis name, not its chars
+        axis_names = (axis_names,)
+    known = set(str(a) for a in axis_names)
+    findings = []
+    for r in trace.records:
+        bad = [a for a in r.axes if a not in known]
+        if bad:
+            findings.append(Finding(
+                check="axis",
+                severity="error",
+                message=(
+                    f"{r.primitive} over unknown axis "
+                    f"{'/'.join(bad)} (mesh has {sorted(known)})"
+                ),
+                source=r.source,
+            ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# wire audit
+# ----------------------------------------------------------------------
+def check_wire(trace: CollectiveTrace,
+               exempt_paths: Sequence[str] = ("comm_wire",)) -> list:
+    """Flag narrowing casts feeding reductions whose cast site is NOT
+    inside one of ``exempt_paths`` (substring match on the cast's source
+    file).  The default exempts only the ``comm_wire`` codecs — the one
+    audited place where narrowed wires carry scale/error-feedback."""
+    findings = []
+    for nc in trace.narrowing_casts:
+        if nc.cast_source is None:
+            # provenance unavailable (source_info API drift): cannot
+            # attribute the cast, so don't accuse — the audit
+            # under-reports rather than flagging the sanctioned codecs
+            continue
+        if any(p in nc.cast_source for p in exempt_paths):
+            continue
+        findings.append(Finding(
+            check="wire",
+            severity="warning",
+            message=(
+                f"{nc.src_dtype} -> {nc.dst_dtype} cast feeds "
+                f"{nc.collective.primitive} over "
+                f"{'/'.join(nc.collective.axes) or '?'} outside "
+                "comm_wire codecs (unaudited precision loss on the "
+                "wire; route through a wire codec)"
+            ),
+            source=nc.cast_source,
+        ))
+    return findings
+
+
+# ----------------------------------------------------------------------
+# budget pins
+# ----------------------------------------------------------------------
+def assert_within_budget(trace: CollectiveTrace,
+                         budget: Mapping[str, int],
+                         name: str = "") -> dict:
+    """Enforce per-class collective-count ceilings on the trace census.
+
+    ``budget``: ``{hlo_op_class: max_count}`` (see
+    ``analysis.budgets.BUDGETS`` for the pinned programs).  Classes not
+    named in the budget are unconstrained.  Returns the census on
+    success; raises :class:`CollectiveBudgetError` listing every
+    exceeded class otherwise.
+    """
+    census = trace.census()
+    over = {
+        cls: (census.get(cls, 0), ceiling)
+        for cls, ceiling in budget.items()
+        if census.get(cls, 0) > ceiling
+    }
+    if over:
+        detail = ", ".join(
+            f"{cls}: {got} > {ceiling}"
+            for cls, (got, ceiling) in sorted(over.items())
+        )
+        raise CollectiveBudgetError(
+            f"collective budget exceeded for {name or trace.label}: "
+            f"{detail} (census={census})"
+        )
+    return census
+
+
+# ----------------------------------------------------------------------
+# divergence guard
+# ----------------------------------------------------------------------
+def trace_agreement(comm, trace: CollectiveTrace, *,
+                    label: Optional[str] = None,
+                    max_attempts: int = 4) -> str:
+    """Verify every process traced the same collective sequence.
+
+    Exchanges the canonical trace hash over the communicator's object
+    store (host control plane — no device collective runs).  Like
+    ``comm_wire.plan_agreement``, the exchange retries transient faults
+    AND ``PayloadCorruptionError`` in lockstep (every process observes a
+    torn payload, so all retry together).  Returns the agreed hash;
+    raises :class:`~chainermn_tpu.resilience.errors.
+    CollectiveTraceMismatchError` (non-recoverable — restarting replays
+    the same divergent program) when any process disagrees.
+    """
+    from ..resilience.errors import (
+        CollectiveTraceMismatchError,
+        PayloadCorruptionError,
+    )
+    from ..resilience.retry import RetryPolicy, call_with_retry, is_transient
+
+    mine = trace.trace_hash()
+    site = f"analysis.trace_agreement({label or trace.label})"
+
+    hashes = call_with_retry(
+        lambda: comm.allgather_obj(mine),
+        site=site,
+        policy=RetryPolicy(max_attempts=max_attempts),
+        retryable=lambda e: is_transient(e)
+        or isinstance(e, PayloadCorruptionError),
+    )
+    if any(h != mine for h in hashes):
+        raise CollectiveTraceMismatchError(
+            f"collective trace hash mismatch across processes: {hashes} "
+            f"(mine={mine[:12]}..., {len(trace)} collectives traced) — "
+            "the ranks would issue divergent collective sequences and "
+            "deadlock; diff the per-rank CollectiveTrace.canonical() "
+            "output to find the divergent call",
+            site=site,
+        )
+    return mine
+
+
+def run_all(trace: CollectiveTrace, *, axis_names=None,
+            exempt_paths: Sequence[str] = ("comm_wire",)) -> list:
+    """Every local check in one call (the divergence guard needs a
+    communicator and budget pins need a ceiling, so neither is here).
+    """
+    findings = list(check_deadlocks(trace))
+    if axis_names is not None:
+        findings += check_axes(trace, axis_names)
+    findings += check_wire(trace, exempt_paths)
+    return findings

@@ -1,0 +1,78 @@
+"""HLO-text collective census — the analyzer's cross-check.
+
+The jaxpr walker (:mod:`.trace`) sees the program *before* XLA; this
+module counts collective ops in the *lowered* text (StableHLO or HLO),
+so the two censuses verify each other: a walker bug (a missed sub-jaxpr
+param) under-counts the trace, a lowering surprise (GSPMD inserting a
+reduce behind our back) over-counts the HLO.  ``TestHLOCollectiveCensus``
+pins both sides against each other on the ResNet-50 and transformer
+train steps.
+
+Counting caveats, so the cross-check is honest about what it can see:
+
+* one multi-operand ``psum`` eqn lowers to ONE variadic ``all_reduce``
+  op — both sides count 1 (the walker records one eqn);
+* a collective inside ``scan`` appears once in the while-loop body on
+  both sides;
+* the GSPMD path (``use_shard_map=False``) materializes collectives the
+  jaxpr never contained — the cross-check is only meaningful for
+  explicitly-partitioned (shard_map) programs, which is what every
+  communicator tier builds.
+"""
+
+from __future__ import annotations
+
+import re
+from typing import Mapping
+
+from .trace import CollectiveTrace
+
+# op class -> (stablehlo spelling, classic-HLO spelling)
+_PATTERNS = {
+    "all_reduce": (r"stablehlo\.all_reduce", r"\ball-reduce(?:-start)?\("),
+    "all_gather": (r"stablehlo\.all_gather", r"\ball-gather(?:-start)?\("),
+    "reduce_scatter": (r"stablehlo\.reduce_scatter", r"\breduce-scatter\("),
+    "collective_permute": (
+        r"stablehlo\.collective_permute",
+        r"\bcollective-permute(?:-start)?\(",
+    ),
+    "all_to_all": (r"stablehlo\.all_to_all", r"\ball-to-all\("),
+}
+
+
+def hlo_census(text: str) -> dict:
+    """``{op_class: count}`` over lowered program text (zero counts
+    omitted).  Accepts StableHLO (``lowered.as_text()``) and classic
+    HLO (``compiled.as_text()``) spellings."""
+    dialect = 0 if "stablehlo" in text else 1
+    out = {}
+    for cls, pats in _PATTERNS.items():
+        n = len(re.findall(pats[dialect], text))
+        if n:
+            out[cls] = n
+    return out
+
+
+def lowered_census(jitted, *args, **kwargs) -> dict:
+    """Census of ``jitted.lower(*args).as_text()`` (compiles nothing)."""
+    return hlo_census(jitted.lower(*args, **kwargs).as_text())
+
+
+def assert_census_agreement(trace: CollectiveTrace, hlo_text: str,
+                            classes=("all_reduce",)) -> Mapping[str, int]:
+    """Assert the walker census equals the HLO-text census for the
+    given op classes; returns the agreed counts.  Default compares only
+    ``all_reduce`` — the class whose count is the wire-format contract —
+    because XLA may legally rewrite between the gather-ish classes
+    (all_gather <-> all_to_all decompositions on some backends)."""
+    mine = trace.census()
+    theirs = hlo_census(hlo_text)
+    agreed = {}
+    for cls in classes:
+        a, b = mine.get(cls, 0), theirs.get(cls, 0)
+        assert a == b, (
+            f"census disagreement on {cls}: jaxpr walker counts {a}, "
+            f"HLO text counts {b} (walker={mine}, hlo={theirs})"
+        )
+        agreed[cls] = a
+    return agreed

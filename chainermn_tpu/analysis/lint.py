@@ -1,0 +1,307 @@
+"""mnlint — repo-level AST lint for collective discipline.
+
+Run from the repo root (CI / conftest wire it into tier-1)::
+
+    python -m chainermn_tpu.analysis.lint          # lint the repo
+    python -m chainermn_tpu.analysis.lint PATH...  # lint specific paths
+
+Exit status 0 = clean, 1 = violations (one ``path:line: [rule] message``
+per line).
+
+Rules
+-----
+``raw-collective``
+    ``lax.psum``-family calls (psum / pmean / pmax / pmin / all_gather /
+    all_to_all / psum_scatter / ppermute) are forbidden outside the
+    sanctioned communication modules.  Everything else must route
+    through the audited wrappers (``functions.collectives`` /
+    ``functions.point_to_point``) or the communicator API — that is what
+    keeps the static analyzer's trace the single source of truth for
+    what ships on the wire.  Sanctioned: ``comm_wire/`` (wire codecs),
+    ``functions/`` (the audited wrappers themselves), ``parallel/``
+    (SP/TP/EP/pipeline layers), ``communicators/`` (the eager tier),
+    ``optimizers.py`` (the compiled-tier sync), ``_compat.py`` (shims),
+    and ``analysis/`` (this package names primitives to find them).
+
+``untimed-row``
+    A benchmark row (dict literal in ``bench.py`` / ``benchmarks/``)
+    carrying a timing-shaped key (``*_ms``, ``sec_per_*``, ``*_per_sec``,
+    ``tflops*``, ...) must also carry the min-of-N protocol disclosure
+    ``n_measurements`` (``spread_max_over_min`` rides along where >= 2
+    positive samples exist).  Rows assembled dynamically (``**`` /
+    ``.update``) are skipped — the rule targets literal rows that
+    silently present one-shot timings as measurements.
+
+Per-line escape hatch (same line or the line above)::
+
+    # mnlint: allow(raw-collective)
+    # mnlint: allow(untimed-row)
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+import sys
+from dataclasses import dataclass
+from typing import List, Optional, Sequence
+
+COLLECTIVE_CALLS = frozenset({
+    "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
+    "psum_scatter", "ppermute",
+})
+
+# repo-relative path prefixes (POSIX separators) sanctioned for raw
+# lax collective calls — the communication layer itself
+SANCTIONED = (
+    "chainermn_tpu/comm_wire/",
+    "chainermn_tpu/functions/",
+    "chainermn_tpu/parallel/",
+    "chainermn_tpu/communicators/",
+    "chainermn_tpu/analysis/",
+    "chainermn_tpu/optimizers.py",
+    "chainermn_tpu/_compat.py",
+)
+
+SKIP_DIRS = {"__pycache__", ".git", "csrc", "_build", ".claude"}
+
+TIMING_KEY_RE = re.compile(
+    r"(^|_)ms($|_)|_ms$"            # iter_ms, step_time_ms, rtt_ms, ms_*
+    r"|(^|_)sec(ond)?s?($|_)"       # sec_per_generate, seconds, *_sec
+    r"|_per_sec$|_per_s$"           # new_tokens_per_sec
+    r"|^tflops|^gflops"             # tflops_per_sec
+    r"|_per_step$"
+)
+
+PRAGMA_RE = re.compile(r"#\s*mnlint:\s*allow\(([a-z-]+)\)")
+
+
+@dataclass(frozen=True)
+class Violation:
+    path: str       # repo-relative
+    line: int
+    rule: str
+    message: str
+
+    def __str__(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message}"
+
+
+def _allowed(lines: Sequence[str], lineno: int, rule: str) -> bool:
+    """Pragma on the flagged line or the line directly above."""
+    for ln in (lineno, lineno - 1):
+        if 1 <= ln <= len(lines):
+            m = PRAGMA_RE.search(lines[ln - 1])
+            if m and m.group(1) == rule:
+                return True
+    return False
+
+
+def _is_lax_base(node: ast.expr) -> bool:
+    """True for ``lax`` / ``jax.lax`` / ``...lax`` attribute bases."""
+    if isinstance(node, ast.Name):
+        return node.id in ("lax", "plax")
+    if isinstance(node, ast.Attribute):
+        return node.attr == "lax"
+    return False
+
+
+def _lint_raw_collectives(tree: ast.AST, lines, rel: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Attribute
+        ):
+            if (node.func.attr in COLLECTIVE_CALLS
+                    and _is_lax_base(node.func.value)):
+                if not _allowed(lines, node.lineno, "raw-collective"):
+                    out.append(Violation(
+                        rel, node.lineno, "raw-collective",
+                        f"raw lax.{node.func.attr} outside the sanctioned "
+                        "communication modules; use functions.collectives"
+                        " / functions.point_to_point or the communicator "
+                        "API",
+                    ))
+        elif isinstance(node, ast.ImportFrom):
+            if node.module and node.module.endswith("lax"):
+                bad = [a.name for a in node.names
+                       if a.name in COLLECTIVE_CALLS]
+                if bad and not _allowed(lines, node.lineno,
+                                        "raw-collective"):
+                    out.append(Violation(
+                        rel, node.lineno, "raw-collective",
+                        f"importing {', '.join(bad)} from jax.lax "
+                        "smuggles raw collectives past the lint; call "
+                        "through functions.collectives",
+                    ))
+    return out
+
+
+_EMIT_FUNCS = {"dumps", "print", "write"}
+
+
+_SCOPE_NODES = (ast.FunctionDef, ast.AsyncFunctionDef, ast.Module)
+
+
+def _scope_body_walk(scope: ast.AST):
+    """Walk a scope's body WITHOUT descending into nested function
+    definitions — each nested function is its own scope, and pooling
+    their names would let function A's enriched ``rec`` exempt function
+    B's unrelated literal of the same name."""
+    stack = list(ast.iter_child_nodes(scope))
+    while stack:
+        n = stack.pop()
+        yield n
+        if not isinstance(n, _SCOPE_NODES[:2]):
+            stack.extend(ast.iter_child_nodes(n))
+
+
+def _dynamic_row_dicts(tree: ast.AST) -> set:
+    """Dict literals whose protocol fields may arrive dynamically: args
+    of ``.update()`` calls, and ``x = {...}`` literals whose name is
+    later handed to a non-emission helper (``_copy_spread(rec, ...)``
+    and friends enrich rows in place; ``json.dumps``/``print`` only
+    emit, so they don't exempt).  Name tracking is per actual scope."""
+    skip: set = set()
+    scopes = [n for n in ast.walk(tree) if isinstance(n, _SCOPE_NODES)]
+    for scope in scopes:
+        assigned: dict = {}   # name -> [dict nodes]
+        enriched: set = set()  # names passed to a non-emission call
+        for n in _scope_body_walk(scope):
+            if isinstance(n, ast.Call):
+                fname = None
+                if isinstance(n.func, ast.Attribute):
+                    fname = n.func.attr
+                    if fname == "update":
+                        skip.update(
+                            a for a in n.args if isinstance(a, ast.Dict)
+                        )
+                elif isinstance(n.func, ast.Name):
+                    fname = n.func.id
+                if fname and fname not in _EMIT_FUNCS:
+                    for a in list(n.args) + [kw.value for kw in n.keywords]:
+                        if isinstance(a, ast.Name):
+                            enriched.add(a.id)
+            elif isinstance(n, ast.Assign) and isinstance(
+                n.value, ast.Dict
+            ):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        assigned.setdefault(t.id, []).append(n.value)
+        for name in enriched:
+            skip.update(assigned.get(name, []))
+    return skip
+
+
+def _lint_untimed_rows(tree: ast.AST, lines, rel: str) -> List[Violation]:
+    out = []
+    dynamic = _dynamic_row_dicts(tree)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Dict) or node in dynamic:
+            continue
+        if any(k is None for k in node.keys):
+            continue  # ** expansion: protocol fields may arrive there
+        keys = [k.value for k in node.keys
+                if isinstance(k, ast.Constant) and isinstance(k.value, str)]
+        timed = [k for k in keys if TIMING_KEY_RE.search(k)]
+        if not timed or "n_measurements" in keys:
+            continue
+        if _allowed(lines, node.lineno, "untimed-row"):
+            continue
+        out.append(Violation(
+            rel, node.lineno, "untimed-row",
+            f"timed bench row (key {timed[0]!r}) lacks the "
+            "'n_measurements' min-of-N disclosure "
+            "(add it, with 'spread_max_over_min' where >= 2 positive "
+            "samples exist)",
+        ))
+    return out
+
+
+def _is_bench_file(rel: str) -> bool:
+    parts = rel.split("/")
+    return "benchmarks" in parts or parts[-1].startswith("bench")
+
+
+def lint_file(path: str, repo_root: str) -> List[Violation]:
+    rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except (OSError, UnicodeDecodeError):
+        return []
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [Violation(rel, e.lineno or 0, "syntax",
+                          f"file does not parse: {e.msg}")]
+    lines = src.splitlines()
+    out: List[Violation] = []
+    if not any(rel.startswith(p) for p in SANCTIONED):
+        out += _lint_raw_collectives(tree, lines, rel)
+    if _is_bench_file(rel):
+        out += _lint_untimed_rows(tree, lines, rel)
+    return sorted(out, key=lambda v: (v.path, v.line))
+
+
+def _iter_py_files(root: str):
+    if os.path.isfile(root):
+        if root.endswith(".py"):
+            yield root
+        return
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = [d for d in dirnames if d not in SKIP_DIRS]
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def repo_root() -> str:
+    """The checkout containing this package."""
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__)
+    )))
+
+
+def default_targets(root: Optional[str] = None) -> List[str]:
+    """What the repo gate lints: the package, the benchmarks, the
+    examples, and bench.py.  Tests are deliberately excluded — they
+    construct raw collectives on purpose to exercise the analyzer."""
+    root = root or repo_root()
+    out = []
+    for name in ("chainermn_tpu", "benchmarks", "examples", "bench.py"):
+        p = os.path.join(root, name)
+        if os.path.exists(p):
+            out.append(p)
+    return out
+
+
+def run_lint(paths: Optional[Sequence[str]] = None,
+             root: Optional[str] = None) -> List[Violation]:
+    root = root or repo_root()
+    targets = list(paths) if paths else default_targets(root)
+    out: List[Violation] = []
+    for t in targets:
+        for f in _iter_py_files(t):
+            out += lint_file(f, root)
+    return out
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    violations = run_lint(argv or None)
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"mnlint: {len(violations)} violation(s)", file=sys.stderr)
+        return 1
+    print("mnlint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

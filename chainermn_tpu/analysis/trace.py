@@ -1,0 +1,444 @@
+"""Collective trace extraction from jaxprs.
+
+The SPMD contract under every communicator tier is that all ranks
+execute the *same ordered sequence of collectives*; one divergent psum
+deadlocks the job or silently mixes wire layouts.  This module makes
+that sequence a first-class object: :func:`trace_collectives` traces any
+jittable function (a compiled train step, an eager communicator method,
+a bare shard_map body) to a :class:`CollectiveTrace` — the ordered list
+of collective primitives with axis names, dtypes, shapes, and the
+enclosing control-flow context — by walking the closed jaxpr recursively
+through ``pjit`` / ``scan`` / ``cond`` / ``while`` / ``shard_map``
+sub-jaxprs (including the ``_compat`` shard_map shim on old jax, which
+binds the same primitive).
+
+The walk is static: nothing is compiled or executed, so tracing even a
+ResNet-50 train step costs milliseconds.  Counting is per jaxpr
+*occurrence* — a collective inside ``scan`` appears once, exactly as it
+appears once in the lowered HLO while-loop body — which is what lets the
+trace census cross-check against the HLO text census
+(:mod:`chainermn_tpu.analysis.hlo`) instead of replacing one grep with
+another.
+
+Two audits are gathered during the same walk (they need dataflow and
+branch structure that the flat record list no longer has):
+
+* narrowing casts feeding a reduction (the wire audit's raw material) —
+  ``convert_element_type`` eqns that shrink the element and whose result
+  is consumed by a psum-family reduction, annotated with the cast's
+  source file so :func:`~chainermn_tpu.analysis.checks.check_wire` can
+  exempt the sanctioned ``comm_wire`` codecs;
+* per-branch collective signatures of every ``cond`` (the deadlock
+  lint's raw material) — a data-dependent branch whose arms trace
+  different collective sequences is the canonical SPMD deadlock.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import re
+from dataclasses import dataclass
+from typing import Callable, Optional, Tuple
+
+import jax
+
+# strips the walk-global cond counter out of branch-relative signatures
+# (see _Walker._walk_cond)
+_COND_ID_RE = re.compile(r"cond#\d+")
+
+# Communication primitives and the HLO op class each lowers to.  pmean
+# has no primitive of its own (psum + divide), pgather/all_gather_invariant
+# are folded into the gather class.  axis_index / axis_size are *not*
+# communication and are deliberately absent.
+COLLECTIVE_CLASS = {
+    "psum": "all_reduce",
+    "pmax": "all_reduce",
+    "pmin": "all_reduce",
+    "all_gather": "all_gather",
+    "all_gather_invariant": "all_gather",
+    "pgather": "all_gather",
+    "reduce_scatter": "reduce_scatter",
+    "psum_scatter": "reduce_scatter",
+    "ppermute": "collective_permute",
+    "pshuffle": "collective_permute",
+    "all_to_all": "all_to_all",
+}
+
+# classes whose semantics are a cross-rank *reduction* (the wire audit
+# only cares about narrowed inputs to these — a narrowed ppermute
+# payload loses precision locally, it does not corrupt a sum)
+REDUCTION_CLASSES = ("all_reduce", "reduce_scatter")
+
+# eqn params that distinguish two otherwise-identical collectives (a
+# ppermute with a different perm is a different program)
+_DETAIL_PARAMS = (
+    "axis_index_groups",
+    "all_gather_dimension",
+    "scatter_dimension",
+    "split_axis",
+    "concat_axis",
+    "axis_size",
+    "tiled",
+    "perm",
+)
+
+
+def _axes_of(params) -> Tuple[str, ...]:
+    axes = params.get("axes", params.get("axis_name", ()))
+    if axes is None:
+        return ()
+    if isinstance(axes, (str, int)):
+        return (str(axes),)
+    return tuple(str(a) for a in axes)
+
+
+def _source_of(eqn) -> Optional[str]:
+    """``file:line`` of the user frame that issued this eqn, if known."""
+    try:
+        from jax._src import source_info_util as siu
+
+        fr = siu.user_frame(eqn.source_info)
+        if fr is None:
+            return None
+        return f"{fr.file_name}:{fr.start_line}"
+    except Exception:
+        return None
+
+
+@dataclass(frozen=True)
+class CollectiveRecord:
+    """One collective primitive occurrence in program order."""
+
+    primitive: str          # jaxpr primitive name (psum, all_gather, ...)
+    cls: str                # HLO op class (all_reduce, all_to_all, ...)
+    axes: Tuple[str, ...]   # mesh axis names reduced/permuted over
+    dtypes: Tuple[str, ...]  # operand dtypes, in operand order
+    shapes: Tuple[Tuple[int, ...], ...]  # operand shapes
+    context: Tuple[str, ...]  # enclosing sub-jaxpr path, outermost first
+    detail: str = ""        # canonicalized distinguishing params
+    source: Optional[str] = None  # file:line of the issuing call
+
+    def signature(self, context_from: int = 0) -> str:
+        """Canonical string for hashing/comparison.  Excludes ``source``
+        (formatting-only edits must not change the trace hash) and keeps
+        everything that changes the compiled program.  ``context_from``
+        drops that many leading context elements — the cond deadlock
+        lint compares branch bodies *relative to the branch*, so two
+        arms with identical collectives compare equal even though their
+        absolute contexts carry different branch labels."""
+        return "|".join(
+            (
+                self.primitive,
+                ",".join(self.axes),
+                ",".join(self.dtypes),
+                ";".join("x".join(map(str, s)) for s in self.shapes),
+                "/".join(self.context[context_from:]),
+                self.detail,
+            )
+        )
+
+    def in_cond(self) -> bool:
+        return any(c.startswith("cond#") for c in self.context)
+
+
+@dataclass(frozen=True)
+class NarrowingCast:
+    """A dtype-narrowing ``convert_element_type`` feeding a reduction."""
+
+    collective: CollectiveRecord
+    src_dtype: str
+    dst_dtype: str
+    cast_source: Optional[str]  # file:line of the cast
+
+
+@dataclass(frozen=True)
+class CondBranchReport:
+    """Per-branch collective signatures of one ``cond`` eqn."""
+
+    cond_id: str                 # "cond#<k>" — unique within the trace
+    context: Tuple[str, ...]     # context of the cond eqn itself
+    branch_signatures: Tuple[Tuple[str, ...], ...]
+    source: Optional[str] = None
+
+    @property
+    def has_collectives(self) -> bool:
+        return any(self.branch_signatures)
+
+    @property
+    def diverges(self) -> bool:
+        """True when the arms trace different collective sequences —
+        rank-dependent predicates then deadlock or mis-pair wires."""
+        sigs = self.branch_signatures
+        return any(s != sigs[0] for s in sigs[1:])
+
+
+@dataclass(frozen=True)
+class CollectiveTrace:
+    """Ordered collective records of one traced program + walk-time
+    audit material.  Immutable; all checks live in ``analysis.checks``.
+    """
+
+    records: Tuple[CollectiveRecord, ...]
+    narrowing_casts: Tuple[NarrowingCast, ...] = ()
+    cond_reports: Tuple[CondBranchReport, ...] = ()
+    label: str = "trace"
+
+    def __len__(self) -> int:
+        return len(self.records)
+
+    def __iter__(self):
+        return iter(self.records)
+
+    def census(self) -> dict:
+        """``{hlo_op_class: count}`` over all records (zero counts
+        omitted) — the analyzer-side half of the HLO cross-check."""
+        out: dict = {}
+        for r in self.records:
+            out[r.cls] = out.get(r.cls, 0) + 1
+        return out
+
+    def count(self, cls: str) -> int:
+        return self.census().get(cls, 0)
+
+    def axis_names(self) -> Tuple[str, ...]:
+        seen: list = []
+        for r in self.records:
+            for a in r.axes:
+                if a not in seen:
+                    seen.append(a)
+        return tuple(seen)
+
+    def canonical(self) -> str:
+        """Canonical multi-line serialization (one signature per record,
+        program order) — the thing the divergence guard hashes.  Pure
+        function of the traced program: values, device placement, and
+        source locations do not enter."""
+        return "\n".join(r.signature() for r in self.records)
+
+    def trace_hash(self) -> str:
+        """sha256 of :meth:`canonical` — the cross-process agreement
+        token (salted ``hash()`` would differ per interpreter)."""
+        return hashlib.sha256(self.canonical().encode()).hexdigest()
+
+
+# ----------------------------------------------------------------------
+# jaxpr walking
+# ----------------------------------------------------------------------
+def _eqns(jaxpr_like):
+    """Eqn list of a Jaxpr or ClosedJaxpr (shard_map carries an open
+    Jaxpr; pjit/scan/cond carry ClosedJaxprs)."""
+    inner = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    return inner.eqns, inner
+
+
+def _avals(eqn):
+    dtypes, shapes = [], []
+    for v in eqn.invars:
+        aval = getattr(v, "aval", None)
+        if aval is None or not hasattr(aval, "dtype"):
+            continue
+        dtypes.append(str(aval.dtype))
+        shapes.append(tuple(int(d) for d in aval.shape))
+    return tuple(dtypes), tuple(shapes)
+
+
+def _detail_of(params) -> str:
+    parts = []
+    for k in _DETAIL_PARAMS:
+        if k in params and params[k] is not None:
+            parts.append(f"{k}={params[k]}")
+    return ";".join(parts)
+
+
+_CTX_LABELS = {
+    "pjit": "pjit",
+    "xla_call": "pjit",
+    "scan": "scan",
+    "shard_map": "shard_map",
+    "remat": "remat",
+    "remat2": "remat",
+    "checkpoint": "remat",
+    "custom_jvp_call": "custom_jvp",
+    "custom_vjp_call": "custom_vjp",
+    "custom_vjp_call_jaxpr": "custom_vjp",
+}
+
+
+def _is_jaxpr(x) -> bool:
+    return hasattr(x, "eqns") or hasattr(x, "jaxpr")
+
+
+class _Walker:
+    def __init__(self):
+        self.records: list = []
+        self.narrowing: list = []
+        self.cond_reports: list = []
+        self._cond_counter = 0
+
+    def walk(self, jaxpr_like, context: Tuple[str, ...] = (),
+             narrow_in: Optional[dict] = None) -> None:
+        """``narrow_in``: vars of this scope known (from the caller's
+        scope) to carry a narrowing-cast result, mapped to their
+        (src_dtype, dst_dtype, source) provenance."""
+        eqns, jaxpr = _eqns(jaxpr_like)
+        narrow: dict = dict(narrow_in or {})
+        for eqn in eqns:
+            name = eqn.primitive.name
+            params = eqn.params
+
+            if name == "convert_element_type":
+                self._note_cast(eqn, narrow)
+            elif name in COLLECTIVE_CLASS:
+                rec = self._record(eqn, context)
+                self.records.append(rec)
+                if rec.cls in REDUCTION_CLASSES:
+                    for v in eqn.invars:
+                        if id(v) in narrow:
+                            src, dst, where = narrow[id(v)]
+                            self.narrowing.append(
+                                NarrowingCast(rec, src, dst, where)
+                            )
+
+            if name == "cond" and "branches" in params:
+                self._walk_cond(eqn, context, narrow)
+            elif name == "while":
+                for key, lbl in (("cond_jaxpr", "while/cond"),
+                                 ("body_jaxpr", "while/body")):
+                    if key in params:
+                        self.walk(params[key], context + (lbl,))
+            else:
+                self._walk_generic_subs(eqn, context, narrow)
+
+    # -- helpers -------------------------------------------------------
+    def _record(self, eqn, context) -> CollectiveRecord:
+        dtypes, shapes = _avals(eqn)
+        return CollectiveRecord(
+            primitive=eqn.primitive.name,
+            cls=COLLECTIVE_CLASS[eqn.primitive.name],
+            axes=_axes_of(eqn.params),
+            dtypes=dtypes,
+            shapes=shapes,
+            context=context,
+            detail=_detail_of(eqn.params),
+            source=_source_of(eqn),
+        )
+
+    def _note_cast(self, eqn, narrow) -> None:
+        inv = eqn.invars[0]
+        outv = eqn.outvars[0]
+        src = getattr(getattr(inv, "aval", None), "dtype", None)
+        dst = getattr(getattr(outv, "aval", None), "dtype", None)
+        if src is None or dst is None:
+            return
+        import numpy as np
+
+        if np.dtype(dst).itemsize < np.dtype(src).itemsize:
+            narrow[id(outv)] = (str(src), str(dst), _source_of(eqn))
+        elif id(inv) in narrow:
+            # widening a previously-narrowed value does not undo the
+            # precision loss (int8 -> int32 before an integer psum is
+            # still an int8 wire): provenance follows the value
+            narrow[id(outv)] = narrow[id(inv)]
+
+    def _walk_cond(self, eqn, context, narrow) -> None:
+        self._cond_counter += 1
+        cond_id = f"cond#{self._cond_counter}"
+        sigs = []
+        for i, branch in enumerate(eqn.params["branches"]):
+            label = f"{cond_id}[{i}]"
+            start = len(self.records)
+            sub_narrow = self._map_into(eqn, branch, narrow,
+                                        skip_leading=1)  # predicate
+            self.walk(branch, context + (label,), sub_narrow)
+            # branch-RELATIVE signatures: arms with identical
+            # collective bodies must compare equal despite carrying
+            # different branch labels in their absolute contexts — and
+            # despite NESTED conds drawing different ids from the
+            # global counter (arm 0's inner cond is cond#2, arm 1's
+            # identical one cond#3), so the ids are stripped here; the
+            # trace hash keeps them (the counter sequence is a
+            # deterministic function of the program, so equal programs
+            # still hash equal)
+            sigs.append(tuple(
+                _COND_ID_RE.sub("cond", r.signature(
+                    context_from=len(context) + 1
+                ))
+                for r in self.records[start:]
+            ))
+        self.cond_reports.append(CondBranchReport(
+            cond_id=cond_id,
+            context=context,
+            branch_signatures=tuple(sigs),
+            source=_source_of(eqn),
+        ))
+
+    def _walk_generic_subs(self, eqn, context, narrow) -> None:
+        label_base = _CTX_LABELS.get(
+            eqn.primitive.name, eqn.primitive.name
+        )
+        for key, val in eqn.params.items():
+            vals = val if isinstance(val, (tuple, list)) else (val,)
+            for i, sub in enumerate(vals):
+                if not _is_jaxpr(sub):
+                    continue
+                label = (
+                    label_base
+                    if len(vals) == 1
+                    else f"{label_base}:{key}[{i}]"
+                )
+                self.walk(
+                    sub,
+                    context + (label,),
+                    self._map_into(eqn, sub, narrow),
+                )
+
+    @staticmethod
+    def _map_into(eqn, sub, narrow, skip_leading: int = 0) -> dict:
+        """Translate narrowing provenance across a sub-jaxpr boundary by
+        positional invar alignment (exact for pjit / shard_map / cond
+        branches; scan's const/carry/xs packing is skipped rather than
+        guessed — a missed propagation under-reports, never
+        mis-reports)."""
+        if not narrow:
+            return {}
+        inner = getattr(sub, "jaxpr", sub)
+        outer = list(eqn.invars)[skip_leading:]
+        inner_vars = list(inner.invars)
+        if len(outer) != len(inner_vars):
+            return {}
+        out = {}
+        for o, s in zip(outer, inner_vars):
+            if id(o) in narrow:
+                out[id(s)] = narrow[id(o)]
+        return out
+
+
+def trace_jaxpr(jaxpr_like, label: str = "trace") -> CollectiveTrace:
+    """Walk an already-made (closed) jaxpr into a
+    :class:`CollectiveTrace`."""
+    w = _Walker()
+    w.walk(jaxpr_like)
+    return CollectiveTrace(
+        records=tuple(w.records),
+        narrowing_casts=tuple(w.narrowing),
+        cond_reports=tuple(w.cond_reports),
+        label=label,
+    )
+
+
+def trace_collectives(fn: Callable, *args, label: Optional[str] = None,
+                      **kwargs) -> CollectiveTrace:
+    """Trace ``fn(*args, **kwargs)`` to its ordered collective sequence.
+
+    ``fn`` is anything jax can trace: a plain function, a jitted train
+    step, a ``shard_map``-wrapped body, or an eager communicator method
+    whose dispatch is built from cached jit programs (the jaxpr then
+    contains ``pjit`` eqns that the walker descends into).  Args may be
+    arrays or ``jax.ShapeDtypeStruct``\\ s — only shapes/dtypes matter.
+
+    Nothing is compiled or executed; no collective runs.
+    """
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    return trace_jaxpr(
+        jaxpr, label=label or getattr(fn, "__name__", "trace")
+    )

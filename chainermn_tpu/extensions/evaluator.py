@@ -18,8 +18,9 @@ from typing import Callable, Dict, Optional
 
 import jax
 import numpy as np
-from jax import lax
 from jax.sharding import NamedSharding, PartitionSpec as P
+
+from chainermn_tpu.functions import collectives as _cc
 
 
 class Evaluator:
@@ -48,7 +49,7 @@ class Evaluator:
 
         def _step(params, batch):
             metrics = metric_fn(params, batch)
-            return {k: lax.pmean(v, axes) for k, v in metrics.items()}
+            return {k: _cc.pmean(v, axes) for k, v in metrics.items()}
 
         self._step = jax.jit(
             jax.shard_map(

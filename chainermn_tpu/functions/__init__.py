@@ -7,10 +7,14 @@ from .collectives import (  # noqa: F401
     scatter,
     reduce_scatter,
     psum,
+    pmean,
+    pmax,
+    pmin,
+    ppermute,
 )
 
 __all__ = [
     "send", "recv", "exchange", "pseudo_connect",
     "all_gather", "all_to_all", "bcast", "gather", "scatter",
-    "reduce_scatter", "psum",
+    "reduce_scatter", "psum", "pmean", "pmax", "pmin", "ppermute",
 ]

@@ -23,12 +23,16 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
 
+# cross-chip statistics ride the audited collective wrappers
+# (analysis.lint forbids raw lax collectives outside the comm layer)
+from chainermn_tpu.functions import collectives as _cc
+
 
 def _reduce_axes_mean(x: jnp.ndarray, reduction_axes, axis_names):
     """Mean over local reduction axes, then over mesh axes if bound."""
     m = jnp.mean(x, axis=reduction_axes)
     if axis_names:
-        m = lax.pmean(m, axis_names)
+        m = _cc.pmean(m, axis_names)
     return m
 
 
@@ -92,7 +96,7 @@ class MultiNodeBatchNormalization(nn.Module):
                 # disabling cross-chip sync is the exact failure mode this
                 # link exists to prevent.  Eval-mode calls (running stats)
                 # and init never reach here.
-                stats = lax.pmean(stats, self.axis_name)
+                stats = _cc.pmean(stats, self.axis_name)
             mean, sq_mean = stats[0], stats[1]
             var = sq_mean - jnp.square(mean)
             if not self.is_initializing():

@@ -31,6 +31,10 @@ import jax.numpy as jnp
 from flax import linen as nn
 from jax import lax
 
+# Communication goes through the audited wrappers — raw lax collectives
+# outside the sanctioned comm modules are a lint error (analysis.lint).
+from chainermn_tpu.functions import collectives as _cc
+
 
 class MlpBlock(nn.Module):
     d_ff: int
@@ -471,7 +475,7 @@ def _sp_targets(tokens: jnp.ndarray, axis_name: str):
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
     b, s = tokens.shape
-    nxt = lax.ppermute(
+    nxt = _cc.ppermute(
         tokens[:, :1], axis_name,
         [((i + 1) % n, i) for i in range(n)],
     )
@@ -485,8 +489,8 @@ def _sp_targets(tokens: jnp.ndarray, axis_name: str):
 def _sp_masked_mean(ce: jnp.ndarray, valid: jnp.ndarray,
                     axis_name: str) -> jnp.ndarray:
     valid = jnp.broadcast_to(valid.astype(ce.dtype), ce.shape)
-    total = lax.psum(jnp.sum(ce * valid), axis_name)
-    count = lax.psum(jnp.sum(valid), axis_name)
+    total = _cc.psum(jnp.sum(ce * valid), axis_name)
+    count = _cc.psum(jnp.sum(valid), axis_name)
     return total / count
 
 
@@ -762,7 +766,7 @@ def _full_vocab(step_logits, vp_axis):
     vp training path exists to avoid."""
     if vp_axis is None:
         return step_logits
-    return lax.all_gather(step_logits, vp_axis, axis=-1, tiled=True)
+    return _cc.all_gather(step_logits, vp_axis, axis=-1, tiled=True)
 
 
 def _sample(step_logits, key, temperature: float):

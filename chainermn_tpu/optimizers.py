@@ -1104,6 +1104,85 @@ def build_train_step(
 
     compiled: dict = {}
 
+    # -- collective divergence guard (chainermn_tpu.analysis) ----------
+    # In a multi-process world, the first dispatch of EVERY compiled
+    # program variant (keyed by params/opt_state structure AND batch
+    # avals — anything that can retrace into a different collective
+    # sequence) first walks the step's jaxpr into its ordered
+    # CollectiveTrace and exchanges the canonical hash over the host
+    # control plane (like comm_wire's plan_agreement): rank-divergent
+    # collective sequences raise CollectiveTraceMismatchError loudly on
+    # EVERY rank before any device collective can deadlock.  Pure
+    # tracing — nothing compiles or executes; single-process worlds
+    # skip it entirely.  Opt out with CHAINERMN_TPU_TRACE_GUARD=0.
+    _guard_enabled = [getattr(comm, "process_count", 1) > 1]
+    _guard_verified: set = set()
+
+    def _guard_key(params, opt_state, batch):
+        # structure AND leaf avals of all three args: a same-structure
+        # tree with resized/recast leaves retraces into a program whose
+        # collective sequence can differ (the bucket plan is a function
+        # of shapes), so it must be re-guarded, not skipped.  Cost: one
+        # flatten per arg per step, multi-process worlds only —
+        # single-process pays a single bool check.
+        def sig(tree):
+            leaves, treedef = jax.tree_util.tree_flatten(tree)
+            return (treedef, tuple(
+                (tuple(getattr(l, "shape", ())),
+                 str(getattr(l, "dtype", "")))
+                for l in leaves
+            ))
+
+        return (sig(params), sig(opt_state), sig(batch))
+
+    def _collective_trace(params, opt_state, batch):
+        """The step program's ordered CollectiveTrace (static; does not
+        compile or execute).  The batch is placed/shaped first so the
+        traced program is the one a real call would dispatch."""
+        from .analysis import trace_collectives
+
+        if not _is_placed(batch):
+            batch = _place_batch(batch)
+        return trace_collectives(
+            _get_step(params, opt_state), params, opt_state, batch,
+            label="train_step",
+        )
+
+    def _verify_collective_trace(params, opt_state, batch, *, _key=None):
+        """Force the divergence guard now (any world size): trace, then
+        exchange the hash across processes.  Returns the agreed hash.
+
+        Disarm semantics, per program variant: the variant's key is
+        marked verified on success and on a MISMATCH (fatal —
+        re-checking would replay the same divergent program), but a
+        transient exchange failure leaves it UNverified so an
+        auto-resumed run re-verifies instead of silently skipping
+        straight into the potential deadlock."""
+        from .analysis import trace_agreement
+        from .resilience.errors import CollectiveTraceMismatchError
+
+        key = _key if _key is not None else _guard_key(
+            params, opt_state, batch
+        )
+        try:
+            agreed = trace_agreement(
+                comm, _collective_trace(params, opt_state, batch),
+                label="train_step",
+            )
+        except CollectiveTraceMismatchError:
+            _guard_verified.add(key)
+            raise
+        _guard_verified.add(key)
+        return agreed
+
+    def _maybe_trace_guard(params, opt_state, batch, key):
+        import os as _os
+
+        if _os.environ.get("CHAINERMN_TPU_TRACE_GUARD", "1") == "0":
+            _guard_enabled[0] = False
+            return
+        _verify_collective_trace(params, opt_state, batch, _key=key)
+
     def _get_step(params, opt_state):
         key = (
             jax.tree_util.tree_structure(params),
@@ -1126,6 +1205,10 @@ def build_train_step(
     def checked_step(params, opt_state, batch):
         if not _is_placed(batch):
             batch = _place_batch(batch)
+        if _guard_enabled[0]:
+            key = _guard_key(params, opt_state, batch)
+            if key not in _guard_verified:
+                _maybe_trace_guard(params, opt_state, batch, key)
         return _get_step(params, opt_state)(params, opt_state, batch)
 
     def place(params, opt_state=None, batch=None):
@@ -1159,4 +1242,9 @@ def build_train_step(
     # The trainer reads this to apply the host-side half of the policy
     # (raise StepDivergedError on "abort", warn/log on the others).
     checked_step.nonfinite_policy = nonfinite
+    # Static-analysis surface (chainermn_tpu.analysis): the step's
+    # ordered collective trace, and the explicit form of the divergence
+    # guard the first multi-process dispatch runs automatically.
+    checked_step.collective_trace = _collective_trace
+    checked_step.verify_collective_trace = _verify_collective_trace
     return checked_step

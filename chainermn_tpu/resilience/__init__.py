@@ -11,6 +11,7 @@ auto-resume lives in ``training.trainer.Trainer.run(max_restarts=N)``.
 """
 
 from .errors import (  # noqa: F401
+    CollectiveTraceMismatchError,
     PayloadCorruptionError,
     ResilienceError,
     RestartBudgetExceededError,

@@ -74,6 +74,18 @@ class StepDivergedError(ResilienceError):
     recoverable = False
 
 
+class CollectiveTraceMismatchError(ResilienceError):
+    """Processes traced divergent collective sequences for the same
+    compiled step (the divergence guard of ``chainermn_tpu.analysis``).
+    Raised on EVERY rank before the first collective dispatches — the
+    alternative is a silent deadlock at whichever collective mis-pairs
+    first.  NOT recoverable: restarting replays the same divergent
+    program — the model/step construction differs across ranks and must
+    be fixed at the source."""
+
+    recoverable = False
+
+
 class RestartBudgetExceededError(ResilienceError):
     """Auto-resume gave up: more recoverable failures than
     ``max_restarts``.  Carries the last underlying error as

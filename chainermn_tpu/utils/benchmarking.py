@@ -65,6 +65,35 @@ def time_steps(run_fn, steps: int, warmup: int = 1,
     return dt
 
 
+def protocol_fields(samples) -> dict:
+    """The min-of-N disclosure every timed bench row carries
+    (``analysis.lint``'s ``untimed-row`` rule enforces its presence):
+    ``n_measurements`` = how many paired measurements produced the
+    reported number, ``spread_max_over_min`` = how far apart the
+    positive ones landed (omitted honestly when fewer than 2 samples
+    are positive — fabricating a spread from noise-floor readings would
+    overstate confidence).  ``samples`` is in any unit; the spread is
+    unit-free."""
+    samples = list(samples)
+    out = {"n_measurements": len(samples)}
+    pos = [s for s in samples if s > 0]
+    if len(pos) >= 2:
+        out["spread_max_over_min"] = round(max(pos) / min(pos), 3)
+    return out
+
+
+def min_positive(samples):
+    """The reported number under the min-of-N protocol: the smallest
+    POSITIVE sample (noise only adds time, so min bounds from above);
+    when every paired difference landed non-positive (noise floor) the
+    last sample is the honest fallback.  Companion of
+    :func:`protocol_fields` — the selection and the disclosure are one
+    protocol, defined in one place."""
+    samples = list(samples)
+    pos = [s for s in samples if s > 0]
+    return min(pos) if pos else samples[-1]
+
+
 def time_kloop(run_k, k: int, repeats: int = 2):
     """Seconds per step for a k-steps-in-ONE-dispatch harness.
 

@@ -184,8 +184,10 @@ def main(argv=None):
             certify.append(comm.model_axis_name)
         elif not args.vocab_parallel:
             certify.append(tp_axis)
+        from chainermn_tpu.functions import collectives as cc
+
         for ax in certify:
-            main = jax.lax.pmean(main, ax)
+            main = cc.pmean(main, ax)
         return main
 
     step = cmn.build_train_step(

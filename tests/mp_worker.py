@@ -716,6 +716,55 @@ def scenario_wire_int8(pid, nproc, scratch):
             "faults": inj.log.counts.get("fault_injected", 0)}
 
 
+def scenario_trace_divergence(pid, nproc, scratch):
+    """ISSUE 5 satellite: two processes build INTENTIONALLY divergent
+    train steps (the rank named by CHAINERMN_TPU_DIVERGE_RANK adds one
+    extra psum to its loss), and the collective divergence guard —
+    wired into build_train_step's first dispatch — raises the
+    non-recoverable ``CollectiveTraceMismatchError`` on BOTH ranks
+    before any device collective runs.  Without the guard this world
+    deadlocks at the first mis-paired collective (the spawning test's
+    timeout is the regression detector for that)."""
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.functions import collectives as cc
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience.errors import CollectiveTraceMismatchError
+
+    comm = _comm()
+    diverge_rank = int(os.environ["CHAINERMN_TPU_DIVERGE_RANK"])
+
+    def loss_fn(params, batch):
+        l = 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+        if pid == diverge_rank:
+            # the divergent collective: an extra (value-neutral) psum
+            # only THIS rank's program contains
+            l = l + 0.0 * cc.psum(l, comm.axis_names)
+        return l
+
+    opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+    params = {"w": jnp.zeros((4,))}
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    # opt.init's wire-plan agreement PASSES (same shapes everywhere);
+    # only the collective TRACE diverges — exactly the gap ISSUE 5's
+    # guard exists to close
+    p, o = step.place(params, opt.init(params))
+    n_local = comm.size // comm.process_count
+    rows = np.zeros((n_local, 4), np.float32)
+    try:
+        step(p, o, rows)
+    except CollectiveTraceMismatchError as e:
+        assert e.recoverable is False
+        return {"raised": type(e).__name__,
+                "hash_len": len(step.collective_trace(
+                    p, o, rows).trace_hash())}
+    raise AssertionError(
+        "divergence guard did not fire on a divergent world"
+    )
+
+
 def scenario_except_hook(pid, nproc, scratch):
     """Failure containment: process 1 raises; its global except hook
     shuts the distributed client down; process 0, blocked in a KV recv,

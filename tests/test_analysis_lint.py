@@ -1,0 +1,250 @@
+"""mnlint repo gate (ISSUE 5 satellite): the repo self-lints in tier-1,
+and the rules behave as documented on synthetic files.
+
+Fast by construction: pure AST work, no jax import in the linted path.
+"""
+
+import os
+import subprocess
+import sys
+import textwrap
+
+from chainermn_tpu.analysis.lint import (
+    SANCTIONED,
+    Violation,
+    default_targets,
+    lint_file,
+    repo_root,
+    run_lint,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, src, name="offender.py"):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    # tmp files live outside the repo: lint relative to tmp_path so
+    # sanctioned-prefix matching sees a clean relative name
+    return lint_file(str(p), str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# the gate itself
+# ----------------------------------------------------------------------
+class TestRepoGate:
+    def test_repo_self_lints_clean(self):
+        """Acceptance: the repo AST lint runs clean in tier-1.  Every
+        raw-collective site is either routed through the audited
+        wrappers or inside the sanctioned comm modules; every timed
+        bench row carries the min-of-N disclosure (or an explicit
+        pragma naming why not)."""
+        violations = run_lint()
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_console_entry_exits_zero_on_clean_repo(self):
+        """``python -m chainermn_tpu.analysis.lint`` is the CI gate."""
+        proc = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis.lint"],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "clean" in proc.stdout
+
+    def test_console_entry_exits_nonzero_on_violation(self, tmp_path):
+        bad = tmp_path / "offender.py"
+        bad.write_text("from jax import lax\nlax.psum(1, 'mn')\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis.lint",
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 1, proc.stdout + proc.stderr
+        assert "raw-collective" in proc.stdout
+
+    def test_default_targets_cover_the_surface(self):
+        names = {os.path.basename(t) for t in default_targets()}
+        assert {"chainermn_tpu", "benchmarks", "examples",
+                "bench.py"} <= names
+        # tests are deliberately NOT linted: they construct raw
+        # collectives on purpose to exercise the analyzer
+        assert "tests" not in names
+        assert repo_root() == REPO
+
+
+# ----------------------------------------------------------------------
+# rule: raw-collective
+# ----------------------------------------------------------------------
+class TestRawCollectiveRule:
+    def test_lax_attribute_calls_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'mn') + lax.pmean(x, 'mn')
+        """)
+        assert [v.rule for v in vs] == ["raw-collective"] * 2
+
+    def test_jax_lax_dotted_calls_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import jax
+            def f(x):
+                return jax.lax.all_gather(x, 'mn', axis=0, tiled=True)
+        """)
+        assert len(vs) == 1 and vs[0].line == 4
+
+    def test_from_import_smuggling_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax.lax import psum, ppermute
+        """)
+        assert len(vs) == 1
+        assert "smuggles" in vs[0].message
+
+    def test_non_collective_lax_ok(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                return lax.axis_index('mn') + lax.rsqrt(x) + lax.scan
+        """)
+        assert vs == []
+
+    def test_wrapper_calls_ok(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from chainermn_tpu.functions import collectives as cc
+            def f(x):
+                return cc.psum(x, 'mn') + cc.pmean(x, 'mn')
+        """)
+        assert vs == []
+
+    def test_pragma_allows(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'mn')  # mnlint: allow(raw-collective)
+        """)
+        assert vs == []
+
+    def test_pragma_on_preceding_line_allows(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                # mnlint: allow(raw-collective)
+                return lax.psum(x, 'mn')
+        """)
+        assert vs == []
+
+    def test_wrong_pragma_rule_does_not_allow(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                return lax.psum(x, 'mn')  # mnlint: allow(untimed-row)
+        """)
+        assert len(vs) == 1
+
+    def test_sanctioned_prefixes_are_the_comm_layer(self):
+        assert "chainermn_tpu/comm_wire/" in SANCTIONED
+        assert "chainermn_tpu/functions/" in SANCTIONED
+        assert "chainermn_tpu/parallel/" in SANCTIONED
+        assert "chainermn_tpu/_compat.py" in SANCTIONED
+        # models/links/extensions are NOT sanctioned — they must route
+        # through the wrappers (fixed in this PR)
+        assert not any(p.startswith("chainermn_tpu/models") for p in SANCTIONED)
+
+    def test_sanctioned_file_not_flagged(self):
+        # optimizers.py is the compiled-tier sync layer: full of psums,
+        # sanctioned by name
+        path = os.path.join(REPO, "chainermn_tpu", "optimizers.py")
+        assert [v for v in lint_file(path, REPO)
+                if v.rule == "raw-collective"] == []
+
+
+# ----------------------------------------------------------------------
+# rule: untimed-row
+# ----------------------------------------------------------------------
+class TestUntimedRowRule:
+    def test_timed_row_without_protocol_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import json
+            print(json.dumps({"variant": "x", "step_time_ms": 1.2}))
+        """, name="bench_x.py")
+        assert [v.rule for v in vs] == ["untimed-row"]
+
+    def test_row_with_n_measurements_ok(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import json
+            print(json.dumps({
+                "step_time_ms": 1.2, "n_measurements": 3,
+                "spread_max_over_min": 1.1,
+            }))
+        """, name="bench_x.py")
+        assert vs == []
+
+    def test_double_star_expansion_skipped(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import json
+            fields = {"n_measurements": 2}
+            print(json.dumps({"step_time_ms": 1.2, **fields}))
+        """, name="bench_x.py")
+        assert vs == []
+
+    def test_update_arg_skipped(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            rec = {"n_measurements": 2}
+            rec.update({"extra_ms": 3.4})
+        """, name="bench_x.py")
+        assert vs == []
+
+    def test_dict_enriched_by_helper_skipped(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import json
+            def emit(merge_protocol):
+                rec = {"step_time_ms": 1.2}
+                merge_protocol(rec)
+                print(json.dumps(rec))
+        """, name="bench_x.py")
+        assert vs == []
+
+    def test_enrichment_in_one_function_does_not_exempt_another(
+        self, tmp_path
+    ):
+        """Regression: name tracking is per actual scope.  Function B's
+        enriched ``out`` must not exempt function A's unrelated literal
+        of the same name."""
+        vs = _lint_src(tmp_path, """
+            import json
+            def a():
+                out = {"step_time_ms": 1.2}
+                print(json.dumps(out))
+            def b():
+                out = {"other": 1}
+                enrich(out)
+        """, name="bench_x.py")
+        assert [v.rule for v in vs] == ["untimed-row"]
+        assert vs[0].line == 4
+
+    def test_emission_calls_do_not_exempt(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import json
+            def emit():
+                rec = {"step_time_ms": 1.2}
+                print(json.dumps(rec))
+        """, name="bench_x.py")
+        assert len(vs) == 1
+
+    def test_rule_only_applies_to_bench_files(self, tmp_path):
+        src = """
+            row = {"step_time_ms": 1.2}
+        """
+        assert _lint_src(tmp_path, src, name="bench_y.py") != []
+        assert _lint_src(tmp_path, src, name="module.py") == []
+
+    def test_untimed_keys_ok(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            cfg = {"batch": 8, "layers": 2, "milestones": [1, 2]}
+        """, name="bench_x.py")
+        assert vs == []
+
+    def test_violation_formatting(self):
+        v = Violation("b.py", 3, "untimed-row", "msg")
+        assert str(v) == "b.py:3: [untimed-row] msg"

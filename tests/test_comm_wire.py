@@ -390,15 +390,20 @@ class TestReducedPrecisionMeanULP:
 
 
 def _count_all_reduce(step, p, o, batch):
-    txt = step.get_jitted(p, o).lower(p, o, batch).as_text()
-    return len(re.findall(r"stablehlo\.all_reduce", txt))
+    """Collective count via the STATIC analyzer (jaxpr walk — nothing
+    lowers or compiles), which ISSUE 5 makes the source of truth for
+    these pins; the HLO-text cross-check below keeps the walker honest
+    against what XLA actually sees."""
+    return step.collective_trace(p, o, batch).count("all_reduce")
 
 
 class TestHLOCollectiveCensus:
-    """Structural verification: the lowered train step's all-reduce op
-    count equals bucket count + 1 (the loss pmean), not leaf count + 1.
-    The same pin style as PR 2's block_census — the claim is about the
-    program XLA sees, not a timing artifact."""
+    """Structural verification: the train step's all-reduce count equals
+    bucket count + 1 (the loss pmean), not leaf count + 1.  Rewritten on
+    the ISSUE 5 analyzer — the count pin reads the jaxpr walker's
+    census, so the pin and the walk cannot drift apart — with ONE
+    HLO-text cross-check retained (test_census_agrees_with_hlo_text)
+    proving the walker counts the same program XLA lowers."""
 
     def _mnist_setup(self, comm, wire):
         from chainermn_tpu.models import MLP
@@ -432,7 +437,28 @@ class TestHLOCollectiveCensus:
         step, p, o, batch, params = self._mnist_setup(comm, "auto")
         plan = plan_of_tree(params)
         assert plan.n_buckets < n_leaves
-        assert _count_all_reduce(step, p, o, batch) == plan.n_buckets + 1
+        tr = step.collective_trace(p, o, batch)
+        assert tr.count("all_reduce") == plan.n_buckets + 1
+        # the MLP-tier budget pin: small trees still bucket (a bucketing
+        # regression back to the leaf storm trips this, not just resnet)
+        from chainermn_tpu.analysis import enforce
+
+        enforce("mlp_train_step", tr)
+
+    def test_census_agrees_with_hlo_text(self, comm):
+        """The retained HLO-text cross-check: the jaxpr walker and a
+        grep of the lowered StableHLO count the same all-reduces on the
+        bucketed MNIST step — the two censuses verify each other, so a
+        walker regression (missed sub-jaxpr) or a lowering surprise
+        (GSPMD inserting a reduce) fails here."""
+        from chainermn_tpu.analysis import assert_census_agreement
+
+        step, p, o, batch, params = self._mnist_setup(comm, "auto")
+        tr = step.collective_trace(p, o, batch)
+        txt = step.get_jitted(p, o).lower(p, o, batch).as_text()
+        n_text = len(re.findall(r"stablehlo\.all_reduce", txt))
+        agreed = assert_census_agreement(tr, txt)
+        assert agreed["all_reduce"] == n_text == tr.count("all_reduce")
 
     def test_mnist_int8_adds_exactly_one_scale_collective(self, comm):
         # the per-bucket absmax agreement is ONE batched pmax, not one
@@ -445,7 +471,11 @@ class TestHLOCollectiveCensus:
 
     def test_resnet50_lowers_to_at_most_8_all_reduces(self, comm):
         """Acceptance criterion: 267 gradient leaves -> default plan's
-        4 buckets -> 5 all-reduce ops (4 grad buckets + loss pmean)."""
+        4 buckets -> 5 all-reduce ops (4 grad buckets + loss pmean),
+        enforced via the analyzer's pinned budget AND cross-checked
+        against the lowered HLO text (ISSUE 5 acceptance: the walker
+        agrees with the HLO census on the ResNet-50 step)."""
+        from chainermn_tpu.analysis import assert_census_agreement, enforce
         from chainermn_tpu.models import ResNet50
 
         model = ResNet50(num_classes=1000, train=False)
@@ -468,13 +498,15 @@ class TestHLOCollectiveCensus:
             jax.device_put(jnp.zeros((8, 32, 32, 3)), step.batch_sharding),
             jax.device_put(jnp.zeros((8,), jnp.int32), step.batch_sharding),
         )
-        n = _count_all_reduce(step, p, o, batch)
+        tr = step.collective_trace(p, o, batch)
+        n = tr.count("all_reduce")
         plan = plan_of_tree(params)
         assert n == plan.n_buckets + 1
-        assert n <= 8, (
-            f"ResNet-50 step lowered to {n} all-reduce ops; the bucket "
-            f"plan promises {plan.n_buckets} + 1 (loss pmean)"
-        )
+        # the pinned budget (analysis.budgets): <= 8 all-reduce
+        enforce("resnet50_train_step", tr)
+        # the walker counts the same program XLA lowers
+        txt = step.get_jitted(p, o).lower(p, o, batch).as_text()
+        assert_census_agreement(tr, txt)
 
 
 # ----------------------------------------------------------------------

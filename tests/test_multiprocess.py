@@ -250,6 +250,25 @@ class TestWireInt8:
         assert all(p["final_loss"] < p["first_loss"] for p in payloads)
 
 
+class TestTraceDivergence:
+    def test_divergent_steps_fail_fast_on_both_ranks(self, tmp_path):
+        """ISSUE 5 acceptance: rank 1 builds a step with one extra psum
+        (env-selected); the divergence guard exchanges trace hashes at
+        the first dispatch and raises CollectiveTraceMismatchError on
+        BOTH ranks before any collective runs — instead of the silent
+        deadlock this world produces without the guard (this test's
+        timeout is the deadlock detector)."""
+        res = run_world(
+            "trace_divergence", n_procs=2, local_devices=2,
+            tmpdir=tmp_path, timeout=240,
+            extra_env={"CHAINERMN_TPU_DIVERGE_RANK": "1"},
+        )
+        payloads = _assert_ok(res, "trace_divergence")
+        assert all(
+            p["raised"] == "CollectiveTraceMismatchError" for p in payloads
+        )
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

@@ -12,10 +12,25 @@ inference comm studies).  This package makes both first-class:
   (:func:`trace_agreement`), deadlock lint on data-dependent ``cond``
   branches, mesh-axis audit, narrowing-cast wire audit, and budget
   enforcement;
-* :mod:`.hlo` — the lowered-text census the trace cross-checks against;
-* :mod:`.budgets` — pinned per-program collective ceilings;
+* :mod:`.hlo` — the lowered-text census the trace cross-checks against,
+  plus per-op extraction with XLA metadata (the attribution citations);
+* :mod:`.shardflow` — the sharding-flow pass: propagate PartitionSpecs
+  through the jaxpr, record every :class:`ReshardSite` where the SPMD
+  partitioner must insert communication — joined with the HLO census
+  into the ``implicit_collectives`` check (every lowered collective is
+  matched to an authored record or flagged with an equation citation);
+* :mod:`.memory` — live-range per-rank HBM estimation (params + grads +
+  opt state + activation peak, remat-aware) with ceilings pinned in
+  :mod:`.budgets` (``enforce_memory``);
+* :mod:`.budgets` — pinned per-program collective AND per-rank HBM
+  ceilings;
 * :mod:`.lint` — the repo AST gate
   (``python -m chainermn_tpu.analysis.lint``).
+
+Every :class:`CollectiveRecord` additionally carries the cost model the
+comm_wire planner consumes: ``bytes_on_wire`` (ring-algorithm per-rank
+wire bytes) and ``hop`` (inter/intra/flat link class from the
+hierarchical ``mn_inter``/``mn_intra`` axis naming).
 
 The divergence guard is production-wired: ``build_train_step``'s first
 dispatch in a multi-process world exchanges the trace hash and raises
@@ -29,25 +44,55 @@ from .trace import (  # noqa: F401
     CollectiveTrace,
     CondBranchReport,
     NarrowingCast,
+    WhileReport,
+    hop_class,
     trace_collectives,
     trace_jaxpr,
+    wire_bytes,
 )
 from .checks import (  # noqa: F401
     CollectiveBudgetError,
     Finding,
+    ImplicitCollectiveError,
+    assert_attributed,
     assert_within_budget,
+    attribute_collectives,
     check_axes,
     check_deadlocks,
+    check_implicit_collectives,
     check_wire,
+    implicit_agreement,
     run_all,
     trace_agreement,
 )
 from .hlo import (  # noqa: F401
+    HloCollectiveOp,
     assert_census_agreement,
     hlo_census,
+    hlo_collective_ops,
     lowered_census,
 )
-from .budgets import BUDGETS, budget_for, enforce  # noqa: F401
+from .budgets import (  # noqa: F401
+    BUDGETS,
+    HBM_BUDGETS,
+    MemoryBudgetError,
+    budget_for,
+    enforce,
+    enforce_memory,
+    memory_budget_for,
+)
+from .shardflow import (  # noqa: F401
+    ReshardSite,
+    ShardFlowReport,
+    shardflow,
+    shardflow_jaxpr,
+)
+from .memory import (  # noqa: F401
+    MemoryEstimate,
+    estimate_hbm,
+    estimate_jaxpr_hbm,
+    train_step_memory,
+)
 
 # re-exported so `except analysis.CollectiveTraceMismatchError` works at
 # the place the guard is documented

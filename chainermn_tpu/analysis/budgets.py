@@ -49,7 +49,46 @@ BUDGETS = {
     # Pipeline forward chain: one ppermute edge per stage boundary and
     # one loss-broadcast psum (``parallel.pipeline``).
     "pipeline_forward": {"collective_permute": 1, "all_reduce": 1},
+    # ISSUE 6 satellite: the seq2seq pipeline BACKWARD was unguarded —
+    # only the forward ppermute was pinned.  Differentiating the gpipe
+    # scan yields exactly ONE transposed ppermute (the reverse ring
+    # edge, in the backward scan body) and one transposed loss psum:
+    # the full train step is fwd + bwd = 2 ppermute + 2 psum, and a
+    # schedule regression that unrolls the reverse ring (one permute
+    # per microbatch) trips this pin.
+    "pipeline_train_step": {"collective_permute": 2, "all_reduce": 2},
 }
+
+# ----------------------------------------------------------------------
+# per-rank HBM ceilings (ISSUE 6): bytes a rank may hold at the live-
+# range peak of the pinned train-step FIXTURES (the tier-1 test
+# configs — tiny models on the 8-way CPU mesh; the estimator scales
+# with the real model when you pin your own).  Ceilings carry one
+# notch of slack over the measured estimate, and — like the collective
+# ceilings — are literal numbers so an estimator or model drift FAILS
+# the pin instead of silently moving it.  Enforced by
+# :func:`enforce_memory` from ``analysis.memory.train_step_memory``.
+MiB = 1024 * 1024
+HBM_BUDGETS = {
+    # ResNet-50 fixture (b=8 global, 64x64 imgs): 97.7 MiB params
+    # resident + ~131 MiB transient (grads + conv activation chain +
+    # fresh output params) = 229 MiB measured; 320 is the ceiling.
+    "resnet50_train_step": 320 * MiB,
+    # tiny transformer LM fixture (d=32, L=2, seq 16): 0.34 MiB
+    # measured.
+    "transformer_train_step": 1 * MiB,
+    # ZeRO fixture (6144 params, adam): 0.10 MiB measured — per-rank
+    # opt state is 1/8 of the replicated wrapper's; the pin is what
+    # keeps the state_partition_spec annotation honest.
+    "zero_train_step": 1 * MiB,
+    # MoE transformer fixture (4 experts over the (2,2,2) mesh, top-2,
+    # capacity 2x): 1.2 MiB measured.
+    "moe_train_step": 4 * MiB,
+}
+
+
+class MemoryBudgetError(AssertionError):
+    """A traced program exceeds its pinned per-rank HBM ceiling."""
 
 
 def budget_for(name: str) -> dict:
@@ -63,3 +102,28 @@ def budget_for(name: str) -> dict:
 def enforce(name: str, trace: CollectiveTrace) -> dict:
     """Assert ``trace`` stays within the named pin; returns the census."""
     return assert_within_budget(trace, budget_for(name), name=name)
+
+
+def memory_budget_for(name: str) -> int:
+    if name not in HBM_BUDGETS:
+        raise KeyError(
+            f"no pinned HBM budget named {name!r}; "
+            f"known: {sorted(HBM_BUDGETS)}"
+        )
+    return int(HBM_BUDGETS[name])
+
+
+def enforce_memory(name: str, estimate) -> int:
+    """Assert a :class:`~chainermn_tpu.analysis.memory.MemoryEstimate`'s
+    per-rank peak stays under the named ceiling; returns the peak bytes.
+    Raises :class:`MemoryBudgetError` with the estimate's breakdown
+    otherwise — the memory analogue of :func:`enforce`."""
+    ceiling = memory_budget_for(name)
+    peak = int(estimate.peak_bytes)
+    if peak > ceiling:
+        raise MemoryBudgetError(
+            f"per-rank HBM budget exceeded for {name}: peak "
+            f"{peak / MiB:.1f} MiB > ceiling {ceiling / MiB:.1f} MiB "
+            f"({estimate})"
+        )
+    return peak

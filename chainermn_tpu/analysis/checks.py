@@ -51,10 +51,22 @@ class CollectiveBudgetError(AssertionError):
     """A traced program exceeds its pinned collective budget."""
 
 
+class ImplicitCollectiveError(AssertionError):
+    """The lowered/compiled HLO carries collectives the author never
+    wrote — the SPMD partitioner inserted reshards/all-gathers that
+    silently eat wire bandwidth."""
+
+
 # ----------------------------------------------------------------------
 # deadlock lint
 # ----------------------------------------------------------------------
 def check_deadlocks(trace: CollectiveTrace) -> list:
+    findings = list(_check_cond_deadlocks(trace))
+    findings += _check_while_deadlocks(trace)
+    return findings
+
+
+def _check_cond_deadlocks(trace: CollectiveTrace) -> list:
     findings = []
     for rep in trace.cond_reports:
         if not rep.has_collectives:
@@ -79,6 +91,51 @@ def check_deadlocks(trace: CollectiveTrace) -> list:
                     f"{rep.cond_id}: {counts[0]} collective(s) inside a "
                     "data-dependent cond (branches currently agree; keep "
                     "them in lockstep or hoist the collective out)"
+                ),
+                source=rep.source,
+            ))
+    return findings
+
+
+def _check_while_deadlocks(trace: CollectiveTrace) -> list:
+    """The while half of the lint (ISSUE 6 satellite; PR 4 compared
+    only ``cond`` arms): a collective inside a ``while`` executes once
+    per iteration, so a rank-divergent trip count issues rank-divergent
+    collective sequences — the loop analogue of divergent cond arms.
+    Statically safe shapes (counter-only predicates, predicates
+    computed through a cross-rank reduction) warn instead of erroring,
+    exactly as lockstep cond arms do."""
+    findings = []
+    for rep in trace.while_reports:
+        if not rep.has_collectives:
+            continue
+        n = len(rep.cond_signatures) + len(rep.body_signatures)
+        if not rep.trip_count_agreed:
+            findings.append(Finding(
+                check="deadlock",
+                severity="error",
+                message=(
+                    f"{rep.while_id}: {n} collective(s) inside a "
+                    "data-dependent while — the exit predicate is "
+                    "neither counter-only nor cross-rank reduced, so "
+                    "rank-divergent trip counts issue divergent "
+                    "collective sequences and deadlock"
+                ),
+                source=rep.source,
+            ))
+        else:
+            how = (
+                "counter-only predicate"
+                if rep.counter_only_predicate
+                else "predicate agreed through a cross-rank reduction"
+            )
+            findings.append(Finding(
+                check="deadlock",
+                severity="warning",
+                message=(
+                    f"{rep.while_id}: {n} collective(s) inside a while "
+                    f"with a {how} (trip counts currently agree; keep "
+                    "the predicate rank-invariant)"
                 ),
                 source=rep.source,
             ))
@@ -173,6 +230,176 @@ def assert_within_budget(trace: CollectiveTrace,
             f"{detail} (census={census})"
         )
     return census
+
+
+# ----------------------------------------------------------------------
+# implicit-collective attribution (ISSUE 6 tentpole)
+# ----------------------------------------------------------------------
+# XLA may legally rewrite WITHIN the gather family (all_gather <->
+# all_to_all decompositions on some backends), so attribution pools
+# those two classes; the reduction and permute classes must match
+# exactly — they are the wire-format contract.
+_ATTRIBUTION_GROUPS = (
+    ("all_reduce",),
+    ("reduce_scatter",),
+    ("collective_permute",),
+    ("all_gather", "all_to_all"),
+)
+
+
+def attribute_collectives(trace: CollectiveTrace, hlo_text: str,
+                          flow=None) -> dict:
+    """Match every collective in the lowered/compiled HLO text to an
+    authored trace record.
+
+    Returns ``{group_label: {"authored": n, "lowered": n, "implicit":
+    [citation, ...]}}`` where ``implicit`` lists the surplus ops the
+    partitioner inserted, each cited with the responsible equation: the
+    XLA op metadata (compiled text carries ``op_name``/``source_file``
+    per op) joined with the sharding-flow pass's reshard sites
+    (``flow``: a :class:`~chainermn_tpu.analysis.shardflow.
+    ShardFlowReport`).  Pass the *compiled* text
+    (``jitted.lower(...).compile().as_text()``) — the SPMD partitioner
+    runs at compile time, so the StableHLO lowering cannot contain its
+    insertions.
+    """
+    from .hlo import hlo_collective_ops
+
+    ops = hlo_collective_ops(hlo_text)
+    census = trace.census()
+    report: dict = {}
+    for group in _ATTRIBUTION_GROUPS:
+        label = "/".join(group)
+        authored = sum(census.get(c, 0) for c in group)
+        group_ops = [o for o in ops if o.cls in group]
+        surplus = max(len(group_ops) - authored, 0)
+        # cite the RIGHT surplus ops: an op whose source matches an
+        # authored record's call site is the author's own collective —
+        # prefer citing the ops no authored record issued (an inserted
+        # reshard can appear textually BEFORE the authored ops, so
+        # plain tail-slicing would name the wrong equation)
+        authored_sources = {
+            r.source for r in trace.records
+            if r.cls in group and r.source
+        }
+        unmatched = [
+            o for o in group_ops
+            if not (o.source and o.source in authored_sources)
+        ]
+        pool = unmatched if len(unmatched) >= surplus else group_ops
+        sites = (
+            [s for s in flow.reshard_sites if s.cls in group]
+            if flow is not None else []
+        )
+        implicit = []
+        for i, op in enumerate(pool[len(pool) - surplus:]):
+            cites = [op.citation()]
+            # pair op to flow site 1:1 (both in program order) when the
+            # counts line up; otherwise the pairing is ambiguous — cite
+            # every candidate site once, on the first surplus op only
+            if len(sites) == surplus:
+                cites.append(sites[i].citation())
+            elif sites and i == 0:
+                cites += [s.citation() for s in sites]
+            implicit.append("; ".join(cites))
+        report[label] = {
+            "authored": authored,
+            "lowered": len(group_ops),
+            "implicit": implicit,
+        }
+    return report
+
+
+def check_implicit_collectives(trace: CollectiveTrace, hlo_text: str,
+                               flow=None) -> list:
+    """Findings for every partitioner-inserted collective (error — it
+    ships bytes the author never audited) and for authored collectives
+    the lowering dropped (warning — usually a walker/lowering mismatch
+    worth a look, not a deadlock)."""
+    findings = []
+    for label, rep in attribute_collectives(
+        trace, hlo_text, flow
+    ).items():
+        for citation in rep["implicit"]:
+            findings.append(Finding(
+                check="implicit_collectives",
+                severity="error",
+                message=(
+                    f"{label}: {rep['lowered']} in HLO vs "
+                    f"{rep['authored']} authored — partitioner-inserted "
+                    f"collective: {citation}"
+                ),
+            ))
+        if rep["lowered"] < rep["authored"]:
+            findings.append(Finding(
+                check="implicit_collectives",
+                severity="warning",
+                message=(
+                    f"{label}: only {rep['lowered']} in HLO vs "
+                    f"{rep['authored']} authored — the lowering "
+                    "elided/rewrote authored collectives"
+                ),
+            ))
+    return findings
+
+
+def assert_attributed(trace: CollectiveTrace, hlo_text: str, *,
+                      flow=None, name: str = "") -> dict:
+    """Assert zero partitioner-inserted collectives; returns the
+    attribution report.  Raises :class:`ImplicitCollectiveError` citing
+    every responsible equation otherwise."""
+    report = attribute_collectives(trace, hlo_text, flow)
+    bad = [
+        f"{label}: {c}"
+        for label, rep in report.items()
+        for c in rep["implicit"]
+    ]
+    if bad:
+        raise ImplicitCollectiveError(
+            f"unattributed collectives in {name or trace.label}: "
+            + "; ".join(bad)
+        )
+    return report
+
+
+def implicit_agreement(comm, trace: CollectiveTrace, hlo_text: str, *,
+                       flow=None, label: Optional[str] = None) -> dict:
+    """Cross-process form of :func:`assert_attributed`: every process
+    checks its own program, then the per-rank implicit-collective
+    counts are exchanged over the host control plane — if ANY rank's
+    program carries a partitioner-inserted collective, EVERY rank
+    raises :class:`ImplicitCollectiveError` before dispatch (a one-rank
+    reshard is a divergent collective sequence: dispatching it would
+    deadlock, not just waste bandwidth)."""
+    from ..resilience.errors import PayloadCorruptionError
+    from ..resilience.retry import RetryPolicy, call_with_retry, is_transient
+
+    report = attribute_collectives(trace, hlo_text, flow)
+    mine = [
+        f"{label_}: {c}"
+        for label_, rep in report.items()
+        for c in rep["implicit"]
+    ]
+    site = f"analysis.implicit_agreement({label or trace.label})"
+    # same lockstep retry as trace_agreement/plan_agreement: a torn
+    # payload is observed by every process, so all retry together
+    everyone = call_with_retry(
+        lambda: comm.allgather_obj(mine),
+        site=site,
+        policy=RetryPolicy(max_attempts=4),
+        retryable=lambda e: is_transient(e)
+        or isinstance(e, PayloadCorruptionError),
+    )
+    if any(everyone):
+        detail = "; ".join(
+            f"rank {r}: {'; '.join(v)}"
+            for r, v in enumerate(everyone) if v
+        )
+        raise ImplicitCollectiveError(
+            f"partitioner-inserted collectives detected at {site} — "
+            f"{detail}"
+        )
+    return report
 
 
 # ----------------------------------------------------------------------

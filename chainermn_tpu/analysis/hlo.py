@@ -23,7 +23,8 @@ Counting caveats, so the cross-check is honest about what it can see:
 from __future__ import annotations
 
 import re
-from typing import Mapping
+from dataclasses import dataclass
+from typing import List, Mapping, Optional
 
 from .trace import CollectiveTrace
 
@@ -31,13 +32,69 @@ from .trace import CollectiveTrace
 _PATTERNS = {
     "all_reduce": (r"stablehlo\.all_reduce", r"\ball-reduce(?:-start)?\("),
     "all_gather": (r"stablehlo\.all_gather", r"\ball-gather(?:-start)?\("),
-    "reduce_scatter": (r"stablehlo\.reduce_scatter", r"\breduce-scatter\("),
+    "reduce_scatter": (
+        r"stablehlo\.reduce_scatter",
+        r"\breduce-scatter(?:-start)?\(",
+    ),
     "collective_permute": (
         r"stablehlo\.collective_permute",
         r"\bcollective-permute(?:-start)?\(",
     ),
-    "all_to_all": (r"stablehlo\.all_to_all", r"\ball-to-all\("),
+    "all_to_all": (r"stablehlo\.all_to_all", r"\ball-to-all(?:-start)?\("),
 }
+
+# metadata={op_name="..." source_file="..." source_line=N} on classic-HLO
+# ops: XLA stamps every op — including the collectives the SPMD
+# partitioner inserts — with the jaxpr equation it came from, which is
+# exactly the citation the implicit-collective attribution needs.
+_METADATA_RE = re.compile(
+    r'metadata=\{[^}]*?op_name="(?P<op>[^"]*)"'
+    r'(?:[^}]*?source_file="(?P<file>[^"]*)")?'
+    r"(?:[^}]*?source_line=(?P<line>\d+))?"
+)
+
+
+@dataclass(frozen=True)
+class HloCollectiveOp:
+    """One collective op occurrence in lowered/compiled program text."""
+
+    cls: str                      # HLO op class (all_reduce, ...)
+    line_no: int                  # 1-based line in the text
+    op_name: Optional[str] = None  # metadata op_name (the jaxpr eqn)
+    source: Optional[str] = None   # "file:line" of the issuing eqn
+
+    def citation(self) -> str:
+        """Human-readable provenance for findings/errors."""
+        parts = [self.cls, f"hlo line {self.line_no}"]
+        if self.op_name:
+            parts.append(f"eqn {self.op_name!r}")
+        if self.source:
+            parts.append(f"at {self.source}")
+        return " ".join(parts)
+
+
+def hlo_collective_ops(text: str) -> List[HloCollectiveOp]:
+    """Every collective op in lowered (StableHLO) or compiled (classic
+    HLO) text, in textual order, each carrying the XLA op metadata when
+    the dialect records it (classic HLO does; StableHLO's pretty form
+    drops locations).  ``-done`` halves of async pairs are not counted
+    (the ``-start`` op is the one occurrence)."""
+    dialect = 0 if "stablehlo" in text else 1
+    ops: List[HloCollectiveOp] = []
+    for i, line in enumerate(text.splitlines(), start=1):
+        for cls, pats in _PATTERNS.items():
+            if not re.search(pats[dialect], line):
+                continue
+            op_name = source = None
+            m = _METADATA_RE.search(line)
+            if m:
+                op_name = m.group("op") or None
+                if m.group("file") and m.group("line"):
+                    source = f"{m.group('file')}:{m.group('line')}"
+            ops.append(HloCollectiveOp(
+                cls=cls, line_no=i, op_name=op_name, source=source
+            ))
+    return ops
 
 
 def hlo_census(text: str) -> dict:

@@ -12,8 +12,12 @@ Rules
 -----
 ``raw-collective``
     ``lax.psum``-family calls (psum / pmean / pmax / pmin / all_gather /
-    all_to_all / psum_scatter / ppermute) are forbidden outside the
-    sanctioned communication modules.  Everything else must route
+    all_gather_invariant / all_to_all / psum_scatter / ppermute /
+    pshuffle / pgather) are forbidden outside the sanctioned
+    communication modules — in every spelling: ``lax.psum``,
+    ``jax.lax.psum``, module aliases (``import jax.lax as jl`` /
+    ``from jax import lax as L`` / ``mylax = jax.lax``), and
+    ``from jax.lax import psum`` smuggling.  Everything else must route
     through the audited wrappers (``functions.collectives`` /
     ``functions.point_to_point``) or the communicator API — that is what
     keeps the static analyzer's trace the single source of truth for
@@ -49,7 +53,8 @@ from typing import List, Optional, Sequence
 
 COLLECTIVE_CALLS = frozenset({
     "psum", "pmean", "pmax", "pmin", "all_gather", "all_to_all",
-    "psum_scatter", "ppermute",
+    "psum_scatter", "ppermute", "pshuffle", "pgather",
+    "all_gather_invariant",
 })
 
 # repo-relative path prefixes (POSIX separators) sanctioned for raw
@@ -98,23 +103,56 @@ def _allowed(lines: Sequence[str], lineno: int, rule: str) -> bool:
     return False
 
 
-def _is_lax_base(node: ast.expr) -> bool:
-    """True for ``lax`` / ``jax.lax`` / ``...lax`` attribute bases."""
+def _is_lax_base(node: ast.expr, aliases=frozenset()) -> bool:
+    """True for ``lax`` / ``jax.lax`` / ``...lax`` attribute bases and
+    for any name the file has aliased to the lax module."""
     if isinstance(node, ast.Name):
-        return node.id in ("lax", "plax")
+        return node.id in ("lax", "plax") or node.id in aliases
     if isinstance(node, ast.Attribute):
         return node.attr == "lax"
     return False
 
 
+def _lax_aliases(tree: ast.AST) -> frozenset:
+    """Names the file binds to the lax module — the satellite gap:
+    ``import jax.lax as jl`` / ``from jax import lax as L`` /
+    ``mylax = jax.lax`` all put raw collectives one attribute access
+    away without the ``lax`` spelling the base check keys on."""
+    out = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.name.endswith(".lax") and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            for a in node.names:
+                if a.name == "lax" and a.asname:
+                    out.add(a.asname)
+        elif isinstance(node, ast.Assign):
+            if isinstance(node.value, ast.Attribute) and (
+                node.value.attr == "lax"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+            elif isinstance(node.value, ast.Name) and node.value.id in (
+                "lax", "plax"
+            ):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        out.add(t.id)
+    return frozenset(out)
+
+
 def _lint_raw_collectives(tree: ast.AST, lines, rel: str) -> List[Violation]:
     out = []
+    aliases = _lax_aliases(tree)
     for node in ast.walk(tree):
         if isinstance(node, ast.Call) and isinstance(
             node.func, ast.Attribute
         ):
             if (node.func.attr in COLLECTIVE_CALLS
-                    and _is_lax_base(node.func.value)):
+                    and _is_lax_base(node.func.value, aliases)):
                 if not _allowed(lines, node.lineno, "raw-collective"):
                     out.append(Violation(
                         rel, node.lineno, "raw-collective",

@@ -1,0 +1,269 @@
+"""Live-range per-rank HBM estimation from the jaxpr.
+
+Peak device memory is the other silent contract next to collective
+count: a refactor that extends an activation's live range (or defeats
+remat) costs nothing at trace time and an OOM at scale.  This module
+bounds it statically: :func:`estimate_hbm` walks a program's jaxpr with
+a classic live-range analysis — a buffer is born at the equation that
+defines it and dies after its last use — and reports the peak live
+bytes plus the equations where the peak occurs.
+
+Per-rank by construction: the analysis descends into the outermost
+``shard_map`` region, where every aval is already the *per-shard* shape
+(replicated params full-size, batch shards ``1/n``-size, ZeRO state
+blocks ``1/n``-size via their ``state_partition_spec``) — so the walk
+measures exactly what one rank holds, with no division heuristics.
+
+Remat-aware for free: ``jax.checkpoint`` changes the *jaxpr* (residuals
+are not saved; recompute equations appear in the backward), so the same
+live-range walk sees the smaller footprint without special-casing.
+
+Estimator assumptions (documented in docs/static_analysis.md):
+
+* no buffer donation/aliasing — arguments stay resident for the whole
+  program and outputs are fresh buffers (matches ``donate=False``
+  steps; donating steps peak lower than the estimate);
+* no XLA fusion — fused producers never materialize their
+  intermediates, so the estimate is an upper bound on the scheduler's
+  actual peak (cross-checked against XLA's own
+  ``compiled.memory_analysis()`` within a pinned tolerance in tier-1);
+* sub-jaxprs (``scan``/``cond``/``while``/``pjit``) contribute their
+  own internal peak on top of the live set at their call site — serial
+  execution, one body at a time.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Optional, Tuple
+
+import jax
+import numpy as np
+
+
+def _aval_bytes(v) -> int:
+    aval = getattr(v, "aval", None)
+    if aval is None or not hasattr(aval, "dtype"):
+        return 0
+    n = 1
+    for d in getattr(aval, "shape", ()):
+        n *= int(d)
+    return n * np.dtype(aval.dtype).itemsize
+
+
+def _mib(b: int) -> float:
+    return round(b / (1024 * 1024), 2)
+
+
+@dataclass(frozen=True)
+class MemoryEstimate:
+    """Per-rank HBM estimate of one program."""
+
+    label: str
+    inputs_bytes: int       # arguments, resident for the whole program
+    outputs_bytes: int      # program results (fresh buffers)
+    peak_bytes: int         # live-range peak (inputs + transients)
+    n_eqns: int
+    # the equation where the peak occurs:
+    # (primitive, source, live bytes at that equation)
+    top_sites: Tuple[Tuple[str, Optional[str], int], ...] = ()
+    # train-step breakdown (0 when not derived from a train step)
+    params_bytes: int = 0
+    opt_state_bytes: int = 0
+    batch_bytes: int = 0
+
+    @property
+    def transient_bytes(self) -> int:
+        """Peak minus resident arguments — activations, gradients, and
+        update buffers at the worst point of the schedule."""
+        return max(self.peak_bytes - self.inputs_bytes, 0)
+
+    def __str__(self) -> str:
+        parts = [
+            f"{self.label}: peak {_mib(self.peak_bytes)} MiB "
+            f"(inputs {_mib(self.inputs_bytes)} + transient "
+            f"{_mib(self.transient_bytes)})"
+        ]
+        if self.params_bytes:
+            parts.append(
+                f"params {_mib(self.params_bytes)} / opt "
+                f"{_mib(self.opt_state_bytes)} / batch "
+                f"{_mib(self.batch_bytes)} MiB"
+            )
+        return "; ".join(parts)
+
+
+def _sub_jaxprs(eqn):
+    for val in eqn.params.values():
+        vals = val if isinstance(val, (tuple, list)) else (val,)
+        for sub in vals:
+            if hasattr(sub, "eqns") or hasattr(sub, "jaxpr"):
+                yield sub
+
+
+def _inner_peak(jaxpr_like) -> int:
+    """Peak bytes of a sub-jaxpr's INTERMEDIATES (its invars/constvars
+    are the caller's operands, already counted in the caller's live
+    set)."""
+    inner = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    peak, _sites = _live_range(inner, count_inputs=False)
+    return peak
+
+
+def _live_range(jaxpr, count_inputs: bool = True):
+    """(peak_bytes, sites): linear live-range scan over one jaxpr.
+
+    ``count_inputs``: whether invars/constvars are resident (True at the
+    top level; False for sub-jaxprs, whose operands belong to the
+    caller's live set).  Resident inputs are PINNED for the whole
+    program — the documented no-donation assumption: an argument
+    consumed early still occupies HBM at the later activation peak."""
+    live: dict = {}
+    pinned: set = set()
+    if count_inputs:
+        for v in list(jaxpr.invars) + list(jaxpr.constvars):
+            live[id(v)] = _aval_bytes(v)
+            pinned.add(id(v))
+
+    last_use: dict = {}
+    for i, eqn in enumerate(jaxpr.eqns):
+        for v in eqn.invars:
+            if not hasattr(v, "val"):
+                last_use[id(v)] = i
+    for v in jaxpr.outvars:
+        if not hasattr(v, "val"):
+            last_use[id(v)] = len(jaxpr.eqns)  # results outlive the body
+
+    peak = sum(live.values())
+    peak_site = None
+    for i, eqn in enumerate(jaxpr.eqns):
+        inner = 0
+        for sub in _sub_jaxprs(eqn):
+            inner = max(inner, _inner_peak(sub))
+        for ov in eqn.outvars:
+            if type(ov).__name__ == "DropVar":
+                continue
+            live[id(ov)] = _aval_bytes(ov)
+        here = sum(live.values()) + inner
+        if here > peak:
+            peak = here
+            peak_site = (eqn.primitive.name, _src(eqn), here)
+        for iv in eqn.invars:
+            if (not hasattr(iv, "val") and id(iv) not in pinned
+                    and last_use.get(id(iv)) == i):
+                live.pop(id(iv), None)
+    return peak, ([peak_site] if peak_site else [])
+
+
+def _src(eqn) -> Optional[str]:
+    from .trace import _source_of
+
+    return _source_of(eqn)
+
+
+def _find_shard_map_body(jaxpr_like, depth: int = 0):
+    """The outermost shard_map body (per-shard avals), or None."""
+    inner = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    if depth > 4:
+        return None
+    for eqn in inner.eqns:
+        if eqn.primitive.name == "shard_map":
+            return eqn.params.get("jaxpr")
+        for sub in _sub_jaxprs(eqn):
+            found = _find_shard_map_body(sub, depth + 1)
+            if found is not None:
+                return found
+    return None
+
+
+def estimate_jaxpr_hbm(jaxpr_like, label: str = "program",
+                       per_rank: bool = True) -> MemoryEstimate:
+    """Estimate HBM for an already-made (closed) jaxpr.
+
+    ``per_rank=True`` descends to the outermost ``shard_map`` body —
+    where every aval is the per-shard shape — and analyzes that; when
+    the program has no shard_map (plain jit / GSPMD), the top-level
+    jaxpr is analyzed as-is (global shapes; divide by the mesh yourself
+    if the partitioner shards it).
+    """
+    target = None
+    if per_rank:
+        target = _find_shard_map_body(jaxpr_like)
+    if target is None:
+        target = getattr(jaxpr_like, "jaxpr", jaxpr_like)
+    else:
+        target = getattr(target, "jaxpr", target)
+
+    inputs = sum(
+        _aval_bytes(v)
+        for v in list(target.invars) + list(target.constvars)
+    )
+    outputs = sum(
+        _aval_bytes(v) for v in target.outvars if not hasattr(v, "val")
+    )
+    peak, sites = _live_range(target, count_inputs=True)
+    return MemoryEstimate(
+        label=label,
+        inputs_bytes=inputs,
+        outputs_bytes=outputs,
+        peak_bytes=peak,
+        n_eqns=len(target.eqns),
+        top_sites=tuple(sites),
+    )
+
+
+def estimate_hbm(fn, *args, label: Optional[str] = None,
+                 per_rank: bool = True, **kwargs) -> MemoryEstimate:
+    """Trace ``fn(*args)`` (nothing compiles or executes) and estimate
+    its per-rank peak HBM."""
+    jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
+    return estimate_jaxpr_hbm(
+        jaxpr,
+        label=label or getattr(fn, "__name__", "program"),
+        per_rank=per_rank,
+    )
+
+
+def train_step_memory(step, params, opt_state, batch,
+                      label: str = "train_step") -> MemoryEstimate:
+    """HBM estimate of a built train step with the params / opt-state /
+    batch breakdown attached.
+
+    The breakdown reads the per-rank sizes straight off the shard_map
+    body's invars (which arrive in ``(params, opt_state, batch)``
+    flatten order), so ZeRO's ``1/n`` state shards and sharded batches
+    are counted at their true per-rank size — the sharding annotations
+    (``state_partition_spec``, ``batch_sharding``) are what the
+    estimator is seeing.
+    """
+    if hasattr(step, "is_placed") and not step.is_placed(batch):
+        batch = step.place_batch(batch)
+    fn = step.get_jitted(params, opt_state) if hasattr(
+        step, "get_jitted"
+    ) else step
+    jaxpr = jax.make_jaxpr(fn)(params, opt_state, batch)
+    est = estimate_jaxpr_hbm(jaxpr, label=label, per_rank=True)
+
+    body = _find_shard_map_body(jaxpr)
+    n_p = len(jax.tree_util.tree_leaves(params))
+    n_o = len(jax.tree_util.tree_leaves(opt_state))
+    n_b = len(jax.tree_util.tree_leaves(batch))
+    p_bytes = o_bytes = b_bytes = 0
+    if body is not None:
+        inner = getattr(body, "jaxpr", body)
+        sizes = [_aval_bytes(v) for v in inner.invars]
+        if len(sizes) == n_p + n_o + n_b:
+            p_bytes = sum(sizes[:n_p])
+            o_bytes = sum(sizes[n_p:n_p + n_o])
+            b_bytes = sum(sizes[n_p + n_o:])
+    return MemoryEstimate(
+        label=est.label,
+        inputs_bytes=est.inputs_bytes,
+        outputs_bytes=est.outputs_bytes,
+        peak_bytes=est.peak_bytes,
+        n_eqns=est.n_eqns,
+        top_sites=est.top_sites,
+        params_bytes=p_bytes,
+        opt_state_bytes=o_bytes,
+        batch_bytes=b_bytes,
+    )

@@ -41,9 +41,12 @@ from dataclasses import dataclass
 from typing import Callable, Optional, Tuple
 
 import jax
+import numpy as np
 
-# strips the walk-global cond counter out of branch-relative signatures
-# (see _Walker._walk_cond)
+# strips the walk-global cond counter out of branch-/loop-relative
+# signatures (see _Walker._walk_cond / _Walker._walk_while — while
+# bodies label their contexts "while/cond"/"while/body" with no
+# counter, so nested cond ids are the only ids to strip in both)
 _COND_ID_RE = re.compile(r"cond#\d+")
 
 # Communication primitives and the HLO op class each lowers to.  pmean
@@ -92,6 +95,77 @@ def _axes_of(params) -> Tuple[str, ...]:
     return tuple(str(a) for a in axes)
 
 
+# ----------------------------------------------------------------------
+# per-collective cost model (ISSUE 6): bytes-on-wire + hop class
+# ----------------------------------------------------------------------
+# Hop classification follows the hierarchical communicator's axis naming
+# (``communicators/_topology.py`` derives the ('mn_inter', 'mn_intra')
+# pair): an axis whose name carries "inter" crosses node/slice
+# boundaries (DCN-class links), "intra" stays on one ICI island, and a
+# topology-agnostic axis ("mn") is "flat" — a single axis spanning the
+# whole communicator, intra-slice on one-slice worlds.  The comm_wire
+# planner consumes this to size buckets per link class (DynamiQ-style
+# byte/latency accounting, PAPERS.md).
+def hop_class(axes) -> str:
+    """"inter" / "intra" / "mixed" / "flat" / "local" for a collective's
+    mesh axis tuple."""
+    if not axes:
+        return "local"
+    kinds = set()
+    for a in axes:
+        a = str(a)
+        if "inter" in a:
+            kinds.add("inter")
+        elif "intra" in a:
+            kinds.add("intra")
+        else:
+            kinds.add("flat")
+    if kinds == {"flat"}:
+        return "flat"
+    if len(kinds) > 1:
+        return "mixed"
+    return kinds.pop()
+
+
+def _world_of(axis_sizes: Tuple[int, ...]) -> Optional[int]:
+    """Total ranks spanned by a collective's axis tuple; None when any
+    size is unknown (0).  The ONE definition behind both
+    ``CollectiveRecord.world`` and the walker's wire pricing."""
+    if not axis_sizes or any(s <= 0 for s in axis_sizes):
+        return None
+    n = 1
+    for s in axis_sizes:
+        n *= s
+    return n
+
+
+def wire_bytes(cls: str, payload_bytes: int,
+               world: Optional[int]) -> Optional[int]:
+    """Per-rank bytes shipped for one collective under the standard ring
+    algorithms; ``None`` when the axis size (``world``) is unknown.
+
+    ``payload_bytes`` is the operand bytes as the record carries them
+    (per-shard input for all_reduce/all_gather/ppermute, the full block
+    being scattered for reduce_scatter).  Formulas: ring all-reduce
+    moves ``2p(n-1)/n`` per rank (reduce-scatter + all-gather halves),
+    reduce-scatter/all-to-all ``p(n-1)/n``, all-gather receives the
+    other ``n-1`` shards (``p(n-1)``), collective-permute is one hop
+    (``p``).
+    """
+    if world is None or world <= 0:
+        return None
+    n = world
+    if cls == "all_reduce":
+        return int(2 * payload_bytes * (n - 1) / n)
+    if cls in ("reduce_scatter", "all_to_all"):
+        return int(payload_bytes * (n - 1) / n)
+    if cls == "all_gather":
+        return int(payload_bytes * (n - 1))
+    if cls == "collective_permute":
+        return int(payload_bytes)
+    return int(payload_bytes)
+
+
 def _source_of(eqn) -> Optional[str]:
     """``file:line`` of the user frame that issued this eqn, if known."""
     try:
@@ -117,6 +191,17 @@ class CollectiveRecord:
     context: Tuple[str, ...]  # enclosing sub-jaxpr path, outermost first
     detail: str = ""        # canonicalized distinguishing params
     source: Optional[str] = None  # file:line of the issuing call
+    # -- cost model (derived; excluded from signature()/hash) ----------
+    axis_sizes: Tuple[int, ...] = ()  # size per axis in `axes` (0 unknown)
+    payload_bytes: int = 0  # operand bytes entering the collective
+    bytes_on_wire: Optional[int] = None  # per-rank wire bytes (ring)
+    hop: str = "local"      # "inter"/"intra"/"mixed"/"flat"/"local"
+
+    @property
+    def world(self) -> Optional[int]:
+        """Total ranks this collective spans (None if any axis size is
+        unknown at trace time)."""
+        return _world_of(self.axis_sizes)
 
     def signature(self, context_from: int = 0) -> str:
         """Canonical string for hashing/comparison.  Excludes ``source``
@@ -173,6 +258,44 @@ class CondBranchReport:
 
 
 @dataclass(frozen=True)
+class WhileReport:
+    """Collective signatures of one ``while`` eqn's cond/body jaxprs —
+    the deadlock lint's raw material for data-dependent loops.
+
+    A collective inside a ``while`` body executes once per iteration:
+    rank-divergent trip counts issue rank-divergent collective sequences
+    (the while analogue of divergent ``cond`` arms).  Two statically
+    checkable mitigations are recorded:
+
+    * ``counter_only_predicate`` — the exit predicate reads only carry
+      slots that the body advances by a constant (the ``fori_loop``
+      shape), so the trip count is a pure function of loop-invariant
+      inputs (assumed rank-uniform, as for ``cond`` predicates);
+    * ``cond_has_reduction`` — the predicate itself is computed through
+      a cross-rank reduction (the convergence-loop shape: every rank
+      agrees on the continue/exit decision by construction).
+    """
+
+    while_id: str                 # "while#<k>" — unique within the trace
+    context: Tuple[str, ...]      # context of the while eqn itself
+    cond_signatures: Tuple[str, ...]
+    body_signatures: Tuple[str, ...]
+    counter_only_predicate: bool
+    cond_has_reduction: bool
+    source: Optional[str] = None
+
+    @property
+    def has_collectives(self) -> bool:
+        return bool(self.cond_signatures or self.body_signatures)
+
+    @property
+    def trip_count_agreed(self) -> bool:
+        """True when the trip count is statically rank-uniform (counter
+        predicate) or rank-agreed (reduction inside the predicate)."""
+        return self.counter_only_predicate or self.cond_has_reduction
+
+
+@dataclass(frozen=True)
 class CollectiveTrace:
     """Ordered collective records of one traced program + walk-time
     audit material.  Immutable; all checks live in ``analysis.checks``.
@@ -182,6 +305,7 @@ class CollectiveTrace:
     narrowing_casts: Tuple[NarrowingCast, ...] = ()
     cond_reports: Tuple[CondBranchReport, ...] = ()
     label: str = "trace"
+    while_reports: Tuple[WhileReport, ...] = ()
 
     def __len__(self) -> int:
         return len(self.records)
@@ -199,6 +323,17 @@ class CollectiveTrace:
 
     def count(self, cls: str) -> int:
         return self.census().get(cls, 0)
+
+    def wire_census(self) -> dict:
+        """``{hop_class: total bytes_on_wire}`` over records whose axis
+        sizes were known at trace time (zero totals omitted) — the
+        aggregate the comm_wire planner's hop-aware bucket sizing
+        consumes."""
+        out: dict = {}
+        for r in self.records:
+            if r.bytes_on_wire:
+                out[r.hop] = out.get(r.hop, 0) + r.bytes_on_wire
+        return out
 
     def axis_names(self) -> Tuple[str, ...]:
         seen: list = []
@@ -269,11 +404,17 @@ def _is_jaxpr(x) -> bool:
 
 
 class _Walker:
-    def __init__(self):
+    def __init__(self, axis_sizes=None):
         self.records: list = []
         self.narrowing: list = []
         self.cond_reports: list = []
+        self.while_reports: list = []
         self._cond_counter = 0
+        self._while_counter = 0
+        # mesh axis name -> size, for the cost model.  Seeded by the
+        # caller (eager paths whose mesh is not in the jaxpr) and
+        # updated authoritatively from every shard_map eqn's mesh param.
+        self._axis_env: dict = dict(axis_sizes or {})
 
     def walk(self, jaxpr_like, context: Tuple[str, ...] = (),
              narrow_in: Optional[dict] = None) -> None:
@@ -302,25 +443,36 @@ class _Walker:
             if name == "cond" and "branches" in params:
                 self._walk_cond(eqn, context, narrow)
             elif name == "while":
-                for key, lbl in (("cond_jaxpr", "while/cond"),
-                                 ("body_jaxpr", "while/body")):
-                    if key in params:
-                        self.walk(params[key], context + (lbl,))
+                self._walk_while(eqn, context)
             else:
                 self._walk_generic_subs(eqn, context, narrow)
 
     # -- helpers -------------------------------------------------------
     def _record(self, eqn, context) -> CollectiveRecord:
         dtypes, shapes = _avals(eqn)
+        axes = _axes_of(eqn.params)
+        cls = COLLECTIVE_CLASS[eqn.primitive.name]
+        sizes = tuple(int(self._axis_env.get(a, 0)) for a in axes)
+        payload = 0
+        for dt, sh in zip(dtypes, shapes):
+            n = 1
+            for d in sh:
+                n *= int(d)
+            payload += n * np.dtype(dt).itemsize
+        world = _world_of(sizes)
         return CollectiveRecord(
             primitive=eqn.primitive.name,
-            cls=COLLECTIVE_CLASS[eqn.primitive.name],
-            axes=_axes_of(eqn.params),
+            cls=cls,
+            axes=axes,
             dtypes=dtypes,
             shapes=shapes,
             context=context,
             detail=_detail_of(eqn.params),
             source=_source_of(eqn),
+            axis_sizes=sizes,
+            payload_bytes=payload,
+            bytes_on_wire=wire_bytes(cls, payload, world),
+            hop=hop_class(axes),
         )
 
     def _note_cast(self, eqn, narrow) -> None:
@@ -330,8 +482,6 @@ class _Walker:
         dst = getattr(getattr(outv, "aval", None), "dtype", None)
         if src is None or dst is None:
             return
-        import numpy as np
-
         if np.dtype(dst).itemsize < np.dtype(src).itemsize:
             narrow[id(outv)] = (str(src), str(dst), _source_of(eqn))
         elif id(inv) in narrow:
@@ -372,7 +522,53 @@ class _Walker:
             source=_source_of(eqn),
         ))
 
+    def _walk_while(self, eqn, context) -> None:
+        """Trace a ``while`` eqn's cond/body and file a
+        :class:`WhileReport` (the while half of the deadlock lint —
+        PR 4 only analyzed ``cond`` arms)."""
+        self._while_counter += 1
+        wid = f"while#{self._while_counter}"
+        params = eqn.params
+        sigs, recs = {}, {}
+        for key, lbl in (("cond_jaxpr", "while/cond"),
+                         ("body_jaxpr", "while/body")):
+            start = len(self.records)
+            if key in params:
+                self.walk(params[key], context + (lbl,))
+            recs[key] = self.records[start:]
+            # loop-relative signatures, nested-cond ids stripped (same
+            # treatment as cond arms): informational, stable across
+            # unrelated edits
+            sigs[key] = tuple(
+                _COND_ID_RE.sub("cond", r.signature(
+                    context_from=len(context) + 1
+                ))
+                for r in recs[key]
+            )
+        cond_recs_reduce = any(
+            r.cls == "all_reduce" for r in recs["cond_jaxpr"]
+        )
+        self.while_reports.append(WhileReport(
+            while_id=wid,
+            context=context,
+            cond_signatures=sigs.get("cond_jaxpr", ()),
+            body_signatures=sigs.get("body_jaxpr", ()),
+            counter_only_predicate=_predicate_is_counter_only(params),
+            cond_has_reduction=cond_recs_reduce,
+            source=_source_of(eqn),
+        ))
+
     def _walk_generic_subs(self, eqn, context, narrow) -> None:
+        if eqn.primitive.name == "shard_map":
+            mesh = eqn.params.get("mesh")
+            shape = getattr(mesh, "shape", None)
+            if shape:
+                try:
+                    self._axis_env.update(
+                        {str(k): int(v) for k, v in dict(shape).items()}
+                    )
+                except Exception:
+                    pass
         label_base = _CTX_LABELS.get(
             eqn.primitive.name, eqn.primitive.name
         )
@@ -413,21 +609,81 @@ class _Walker:
         return out
 
 
-def trace_jaxpr(jaxpr_like, label: str = "trace") -> CollectiveTrace:
+def _predicate_is_counter_only(while_params) -> bool:
+    """True when the ``while`` exit predicate reads ONLY carry slots the
+    body advances by a constant (the ``fori_loop`` shape) — the trip
+    count is then a pure function of loop-invariant inputs, which the
+    lint assumes rank-uniform (the same assumption it makes for ``cond``
+    predicates built from replicated values).
+
+    Conservative in the safe direction: any slot the analysis cannot
+    prove counter-like makes the predicate data-dependent.
+    """
+    try:
+        cond_jaxpr = while_params["cond_jaxpr"].jaxpr
+        body_jaxpr = while_params["body_jaxpr"].jaxpr
+        cond_nconsts = int(while_params.get("cond_nconsts", 0))
+        body_nconsts = int(while_params.get("body_nconsts", 0))
+    except (KeyError, AttributeError):
+        return False
+
+    # vars the predicate transitively depends on, within the cond jaxpr
+    needed = {id(v) for v in cond_jaxpr.outvars if not hasattr(v, "val")}
+    for eqn in reversed(cond_jaxpr.eqns):
+        if any(id(ov) in needed for ov in eqn.outvars):
+            needed.update(
+                id(iv) for iv in eqn.invars if not hasattr(iv, "val")
+            )
+    carry_in = list(cond_jaxpr.invars)[cond_nconsts:]
+    read_slots = [i for i, v in enumerate(carry_in) if id(v) in needed]
+
+    body_carry_in = list(body_jaxpr.invars)[body_nconsts:]
+    body_consts = {id(v) for v in body_jaxpr.constvars}
+    producers = {}
+    for eqn in body_jaxpr.eqns:
+        for ov in eqn.outvars:
+            producers[id(ov)] = eqn
+
+    def counter_like(slot: int) -> bool:
+        if slot >= len(body_jaxpr.outvars) or slot >= len(body_carry_in):
+            return False
+        out = body_jaxpr.outvars[slot]
+        src = body_carry_in[slot]
+        if out is src:  # unchanged slot: loop-invariant value
+            return True
+        eqn = producers.get(id(out))
+        if eqn is None or eqn.primitive.name not in ("add", "sub"):
+            return False
+        ids = [iv for iv in eqn.invars]
+        has_self = any(iv is src for iv in ids)
+        others_const = all(
+            iv is src or hasattr(iv, "val") or id(iv) in body_consts
+            for iv in ids
+        )
+        return has_self and others_const
+
+    return all(counter_like(i) for i in read_slots)
+
+
+def trace_jaxpr(jaxpr_like, label: str = "trace",
+                axis_sizes=None) -> CollectiveTrace:
     """Walk an already-made (closed) jaxpr into a
-    :class:`CollectiveTrace`."""
-    w = _Walker()
+    :class:`CollectiveTrace`.  ``axis_sizes`` seeds the cost model's
+    mesh-axis sizes for programs whose jaxpr carries no shard_map mesh
+    (every shard_map eqn's own mesh overrides the seed)."""
+    w = _Walker(axis_sizes=axis_sizes)
     w.walk(jaxpr_like)
     return CollectiveTrace(
         records=tuple(w.records),
         narrowing_casts=tuple(w.narrowing),
         cond_reports=tuple(w.cond_reports),
         label=label,
+        while_reports=tuple(w.while_reports),
     )
 
 
 def trace_collectives(fn: Callable, *args, label: Optional[str] = None,
-                      **kwargs) -> CollectiveTrace:
+                      axis_sizes=None, **kwargs) -> CollectiveTrace:
     """Trace ``fn(*args, **kwargs)`` to its ordered collective sequence.
 
     ``fn`` is anything jax can trace: a plain function, a jitted train
@@ -440,5 +696,6 @@ def trace_collectives(fn: Callable, *args, label: Optional[str] = None,
     """
     jaxpr = jax.make_jaxpr(fn)(*args, **kwargs)
     return trace_jaxpr(
-        jaxpr, label=label or getattr(fn, "__name__", "trace")
+        jaxpr, label=label or getattr(fn, "__name__", "trace"),
+        axis_sizes=axis_sizes,
     )

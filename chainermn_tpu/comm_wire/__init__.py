@@ -27,7 +27,9 @@ from .planner import (  # noqa: F401
     flatten_to_buckets,
     make_plan,
     pack_stacked,
+    plan_for_trace,
     plan_of_tree,
+    tune_wire_for_trace,
     unflatten_from_buckets,
     unpack_stacked,
 )

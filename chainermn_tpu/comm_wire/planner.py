@@ -177,6 +177,81 @@ def plan_of_tree(
     )
 
 
+# ----------------------------------------------------------------------
+# cost-model hookup (ISSUE 6): bucket sizing from the analyzer's
+# per-collective cost records
+# ----------------------------------------------------------------------
+# Collective launch latency per hop class, relative to an intra-slice
+# ICI hop.  Inter-slice (DCN-class) launches cost roughly an order of
+# magnitude more setup (PAPERS.md: DynamiQ and the multi-node inference
+# comm study both measure inter-node collective latency dominating at
+# small payloads), so amortizing them takes proportionally larger
+# buckets.  "flat"/"mixed" axes may cross slices — treated as one notch
+# below inter rather than assumed cheap.
+_HOP_LATENCY_SCALE = {
+    "intra": 1,
+    "local": 1,
+    "flat": 2,
+    "mixed": 2,
+    "inter": 4,
+}
+
+
+def tune_wire_for_trace(
+    records,
+    base_bytes: int = DEFAULT_BUCKET_BYTES,
+    max_buckets: int = DEFAULT_MAX_BUCKETS,
+):
+    """``(bucket_bytes, max_buckets)`` tuned from a program's
+    :class:`~chainermn_tpu.analysis.trace.CollectiveRecord` cost fields
+    — the decision path that consumes ``bytes_on_wire`` + ``hop``.
+
+    Two rules, both derived from the byte/latency accounting the
+    records carry:
+
+    * the byte target scales with the worst hop class any *reduction*
+      record crosses (``_HOP_LATENCY_SCALE``): an inter-slice launch
+      amortizes over 4x the bytes of an intra-slice one, so fewer,
+      larger buckets win there (DynamiQ's regime);
+    * when the total reduction ``bytes_on_wire`` fits inside ONE scaled
+      bucket, the slot budget collapses to 1 — a small model gains
+      nothing from splitting, and every extra bucket is a pure launch
+      latency loss.
+    """
+    reductions = [
+        r for r in records
+        if getattr(r, "cls", None) in ("all_reduce", "reduce_scatter")
+    ]
+    scale = max(
+        (_HOP_LATENCY_SCALE.get(getattr(r, "hop", "flat"), 2)
+         for r in reductions),
+        default=1,
+    )
+    bucket_bytes = int(base_bytes) * scale
+    total = sum(
+        r.bytes_on_wire for r in reductions
+        if getattr(r, "bytes_on_wire", None)
+    )
+    if total and total <= bucket_bytes:
+        return bucket_bytes, 1
+    return bucket_bytes, max_buckets
+
+
+def plan_for_trace(
+    trace,
+    tree,
+    base_bytes: int = DEFAULT_BUCKET_BYTES,
+    max_buckets: int = DEFAULT_MAX_BUCKETS,
+) -> BucketPlan:
+    """Plan buckets for ``tree`` with the byte target / slot budget
+    tuned by a :class:`CollectiveTrace`'s cost records (typically the
+    trace of the step that will ship these gradients)."""
+    bucket_bytes, slots = tune_wire_for_trace(
+        trace.records, base_bytes, max_buckets
+    )
+    return plan_of_tree(tree, bucket_bytes, slots)
+
+
 def flatten_to_buckets(plan: BucketPlan, tree) -> List[jnp.ndarray]:
     """Pack the tree's leaves into the plan's flat wire buffers.
 

@@ -1,6 +1,10 @@
 from .ring_attention import ring_attention  # noqa: F401
 from .ulysses import ulysses_attention  # noqa: F401
-from .pipeline import build_pipeline_train_step, gpipe  # noqa: F401
+from .pipeline import (  # noqa: F401
+    build_pipeline_train_step,
+    gpipe,
+    pipeline_flow_specs,
+)
 from .tensor_parallel import (  # noqa: F401
     ColumnParallelDense,
     RowParallelDense,
@@ -8,8 +12,10 @@ from .tensor_parallel import (  # noqa: F401
     vocab_parallel_cross_entropy,
     megatron_param_specs,
     sharded_init,
+    tp_flow_specs,
 )
 from .expert_parallel import (  # noqa: F401
+    ep_flow_specs,
     expert_parallel_moe,
     mlp_experts,
     top_k_routing,
@@ -29,12 +35,15 @@ __all__ = [
     "ulysses_attention",
     "gpipe",
     "build_pipeline_train_step",
+    "pipeline_flow_specs",
     "ColumnParallelDense",
     "RowParallelDense",
     "VocabParallelEmbed",
     "vocab_parallel_cross_entropy",
     "megatron_param_specs",
+    "tp_flow_specs",
     "sharded_init",
+    "ep_flow_specs",
     "expert_parallel_moe",
     "mlp_experts",
     "top_k_routing",

@@ -233,6 +233,27 @@ def load_balancing_loss(probs: jnp.ndarray,
     return e * jnp.sum(frac * mean_prob)
 
 
+def ep_flow_specs(axis_name: str) -> dict:
+    """The MoE layer's sharding declaration for the analysis pass
+    (``analysis.shardflow``): tokens arrive sharded over the expert
+    axis (each chip routes its own rows), the router is replicated, and
+    the stacked expert weights are sharded one block of
+    ``num_experts / axis_size`` experts per chip.  Matches the operand
+    layout ``expert_parallel_moe`` expects under shard_map — the
+    dispatch/return ``all_to_all`` pair is the ONLY communication this
+    layout requires, which is exactly what the ``ep_moe_layer`` budget
+    pin and the implicit-collective attribution verify."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "x": P(axis_name),
+        "router_w": P(),
+        "expert_w1": P(axis_name),
+        "expert_w2": P(axis_name),
+        "out": P(axis_name),
+    }
+
+
 def expert_parallel_moe(
     x: jnp.ndarray,
     router_w: jnp.ndarray,

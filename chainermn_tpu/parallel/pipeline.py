@@ -28,6 +28,26 @@ import jax.numpy as jnp
 from jax import lax
 
 
+def pipeline_flow_specs(axis_name: str) -> dict:
+    """The pipeline step's sharding declaration for the analysis pass
+    (``analysis.shardflow``): stacked stage params are sharded one
+    stage per chip over the pipeline axis; the microbatch stream and
+    targets are replicated (only stage 0 / the last stage consume
+    them); the loss psum replicates the output.  This is the layout
+    ``build_pipeline_train_step``'s shard_map declares — exporting it
+    lets the sharding-flow pass (and its implicit-collective
+    attribution) see the pipeline program without reverse-engineering
+    the builder."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "stage_params": P(axis_name),
+        "x_microbatches": P(),
+        "targets": P(),
+        "out": P(),
+    }
+
+
 def gpipe(
     stage_fn: Callable[[Any, jnp.ndarray], jnp.ndarray],
     stage_params: Any,

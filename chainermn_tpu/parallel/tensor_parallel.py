@@ -250,6 +250,26 @@ def megatron_param_specs(params, model_axis: str = "tp"):
     return jtu.tree_map_with_path(leaf_spec, params)
 
 
+def tp_flow_specs(params, model_axis: str = "tp",
+                  batch_spec=None) -> dict:
+    """The tensor-parallel step's sharding declaration for the analysis
+    pass (``analysis.shardflow``): the Megatron param layout
+    (:func:`megatron_param_specs`) bundled with the activation/batch
+    layout so the sharding-flow pass can seed a hybrid DP x TP step's
+    invars in one call.  Activations between TP blocks are replicated
+    along features by construction (Column(gather=False) -> Row ends in
+    its psum), which is why a correctly-composed Megatron block adds no
+    partitioner-inserted collectives — the attribution check's
+    invariant for this layout."""
+    from jax.sharding import PartitionSpec as P
+
+    return {
+        "params": megatron_param_specs(params, model_axis),
+        "batch": P() if batch_spec is None else batch_spec,
+        "out": P(),
+    }
+
+
 def sharded_init(init_fn: Callable, mesh, in_specs, param_specs_fn,
                  *args):
     """Initialize a model whose parameters live sharded on ``mesh``.

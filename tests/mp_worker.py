@@ -765,6 +765,61 @@ def scenario_trace_divergence(pid, nproc, scratch):
     )
 
 
+def scenario_mismatched_sharding(pid, nproc, scratch):
+    """ISSUE 6 satellite: rank 1 is handed a MISMATCHED input sharding
+    (row-sharded where every other rank declares replicated), so its
+    compiled program carries partitioner-inserted all-gathers the
+    author never wrote.  The ``implicit_collectives`` check — its
+    cross-process form ``implicit_agreement`` — exchanges per-rank
+    implicit counts over the host control plane and raises
+    ``ImplicitCollectiveError`` on BOTH ranks before any dispatch, with
+    an equation-level citation naming the responsible dot_general."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from chainermn_tpu.analysis import (
+        ImplicitCollectiveError,
+        implicit_agreement,
+        shardflow,
+        trace_collectives,
+    )
+
+    comm = _comm()
+    mismatch_rank = int(os.environ["CHAINERMN_TPU_MISMATCH_RANK"])
+
+    def f(x):
+        return x @ x.T
+
+    # the mismatched rank shards rows into a program whose matmul the
+    # partitioner can only resolve by gathering; everyone else runs the
+    # replicated (collective-free) program
+    spec = P("mn", None) if pid == mismatch_rank else P()
+    jitted = jax.jit(
+        f,
+        in_shardings=NamedSharding(comm.mesh, spec),
+        out_shardings=NamedSharding(comm.mesh, P()),
+    )
+    sds = jax.ShapeDtypeStruct((8, 8), jnp.float32)
+    txt = jitted.lower(sds).compile().as_text()  # static — no dispatch
+    tr = trace_collectives(f, sds)
+    flow = shardflow(f, sds, in_specs=(spec,), out_specs=(P(),))
+    assert len(tr) == 0  # nothing authored — any HLO collective is implicit
+    try:
+        implicit_agreement(comm, tr, txt, flow=flow, label="mismatched")
+    except ImplicitCollectiveError as e:
+        msg = str(e)
+        assert f"rank {mismatch_rank}" in msg, msg
+        # equation-level citation from the XLA metadata / flow pass
+        assert "dot_general" in msg, msg
+        return {"raised": type(e).__name__,
+                "cited_dot": "dot_general" in msg}
+    raise AssertionError(
+        "implicit_collectives agreement did not fire on a world with a "
+        "mismatched input sharding"
+    )
+
+
 def scenario_except_hook(pid, nproc, scratch):
     """Failure containment: process 1 raises; its global except hook
     shuts the distributed client down; process 0, blocked in a KV recv,

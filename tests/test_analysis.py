@@ -297,6 +297,84 @@ class TestDeadlockLint:
 
 
 # ----------------------------------------------------------------------
+# deadlock lint: while bodies (ISSUE 6 satellite — PR 4 only compared
+# cond arms)
+# ----------------------------------------------------------------------
+class TestWhileDeadlockLint:
+    def _findings(self, mesh8, fn):
+        tr = trace_collectives(_smap(fn, mesh8), jnp.zeros((8, 4)))
+        return tr, check_deadlocks(tr)
+
+    def test_counter_while_with_collective_warns(self, mesh8):
+        """The fori shape: predicate reads a carry slot the body
+        advances by a constant — trip count rank-uniform, so the
+        collective inside gets the lockstep-cond treatment (warning)."""
+        def f(x):
+            def wbody(c):
+                return (lax.psum(c[0], "mn"), c[1] + 1)
+
+            out, _ = lax.while_loop(lambda c: c[1] < 3, wbody, (x, 0))
+            return out
+
+        tr, findings = self._findings(mesh8, f)
+        assert tr.while_reports[0].counter_only_predicate
+        assert tr.while_reports[0].trip_count_agreed
+        assert [f.severity for f in findings] == ["warning"]
+        assert "counter-only" in findings[0].message
+
+    def test_data_dependent_while_with_collective_errors(self, mesh8):
+        """Predicate reads a data-carrying slot: rank-divergent trip
+        counts issue divergent collective sequences — error."""
+        def f(x):
+            def wbody(c):
+                return (lax.psum(c[0], "mn") * 0.5, c[1] + 1)
+
+            out, _ = lax.while_loop(
+                lambda c: c[0].sum() < 3.0, wbody, (x, 0)
+            )
+            return out
+
+        tr, findings = self._findings(mesh8, f)
+        assert not tr.while_reports[0].trip_count_agreed
+        assert [f.severity for f in findings] == ["error"]
+        assert "data-dependent while" in findings[0].message
+
+    def test_reduction_agreed_predicate_warns(self, mesh8):
+        """The convergence-loop shape: the predicate itself is computed
+        through a psum, so every rank agrees to continue or exit —
+        aligned today, warning not error."""
+        def f(x):
+            def wbody(c):
+                return (c[0] * 0.5, c[1] + 1)
+
+            out, _ = lax.while_loop(
+                lambda c: lax.psum(c[0].sum(), "mn") > 1.0, wbody,
+                (x, 0),
+            )
+            return out
+
+        tr, findings = self._findings(mesh8, f)
+        rep = tr.while_reports[0]
+        assert rep.cond_has_reduction and rep.trip_count_agreed
+        assert [f.severity for f in findings] == ["warning"]
+        assert "cross-rank reduction" in findings[0].message
+
+    def test_collective_free_while_is_clean(self, mesh8):
+        def f(x):
+            def wbody(c):
+                return (c[0] * 0.5, c[1] + 1)
+
+            out, _ = lax.while_loop(
+                lambda c: c[0].sum() < 3.0, wbody, (x, 0)
+            )
+            return out
+
+        tr, findings = self._findings(mesh8, f)
+        assert not tr.while_reports[0].has_collectives
+        assert findings == []
+
+
+# ----------------------------------------------------------------------
 # axis audit
 # ----------------------------------------------------------------------
 class TestAxisAudit:
@@ -490,6 +568,44 @@ class TestBudgets:
         # it appears once in the lowered while-loop body
         assert census["collective_permute"] == 1
         assert tr.records[0].context[-1] == "scan"
+
+    def test_pipeline_train_step_backward_permute_pinned(
+        self, comm, mesh8
+    ):
+        """ISSUE 6 satellite: only the FORWARD ppermute was pinned —
+        the transposed reverse-ring permute that autodiff generates was
+        unguarded.  The full train step traces to exactly 2
+        collective_permute (forward edge + transposed edge, each once
+        inside its scan body) and 2 all_reduce (loss psum + its
+        transpose), pinned by ``pipeline_train_step``."""
+        from chainermn_tpu.parallel.pipeline import gpipe
+
+        def stage_fn(sp, h):
+            return jnp.tanh(h @ sp)
+
+        def fwd(sp, xm):
+            y = gpipe(stage_fn, sp[0], xm, "mn")
+            is_last = lax.axis_index("mn") == lax.axis_size("mn") - 1
+            return lax.psum(jnp.where(is_last, y.sum(), 0.0), "mn")
+
+        def train(sp, xm):
+            return jax.grad(fwd)(sp, xm)
+
+        tr = trace_collectives(
+            jax.shard_map(
+                train, mesh=mesh8, in_specs=(P("mn"), P()),
+                out_specs=P("mn"), check_vma=False,
+            ),
+            jnp.zeros((8, 4, 4)),
+            jnp.zeros((4, 2, 4)),
+        )
+        census = enforce("pipeline_train_step", tr)
+        assert census["collective_permute"] == 2
+        # both ring edges live inside their scan bodies (fwd + bwd)
+        permutes = [r for r in tr if r.cls == "collective_permute"]
+        assert all("scan" in r.context for r in permutes)
+        # the reverse permute is the transpose of the forward one
+        assert permutes[0].detail != permutes[1].detail
 
     def test_budget_violation_raises_with_census(self, comm):
         from chainermn_tpu.models import MLP

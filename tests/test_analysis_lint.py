@@ -101,6 +101,53 @@ class TestRawCollectiveRule:
         assert len(vs) == 1
         assert "smuggles" in vs[0].message
 
+    def test_import_alias_flagged(self, tmp_path):
+        """ISSUE 6 satellite: module aliases put raw collectives one
+        attribute access away without the ``lax`` spelling the base
+        check keys on."""
+        vs = _lint_src(tmp_path, """
+            import jax.lax as jl
+            def f(x):
+                return jl.all_gather(x, 'mn', axis=0, tiled=True)
+        """)
+        assert [v.rule for v in vs] == ["raw-collective"]
+        assert vs[0].line == 4
+
+    def test_from_import_alias_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax as L
+            def f(x):
+                return L.psum_scatter(x, 'mn', scatter_dimension=0)
+        """)
+        assert [v.rule for v in vs] == ["raw-collective"]
+
+    def test_assignment_alias_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import jax
+            mylax = jax.lax
+            def f(x):
+                return mylax.psum(x, 'mn')
+        """)
+        assert [v.rule for v in vs] == ["raw-collective"]
+
+    def test_alias_of_non_lax_module_not_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            import numpy.linalg as jl
+            def f(x):
+                return jl.psum(x, 'mn')  # not lax: someone else's psum
+        """)
+        assert vs == []
+
+    def test_extended_collective_names_flagged(self, tmp_path):
+        vs = _lint_src(tmp_path, """
+            from jax import lax
+            def f(x):
+                a = lax.pshuffle(x, 'mn', [0])
+                b = lax.all_gather_invariant(x, 'mn')
+                return a + b
+        """)
+        assert [v.rule for v in vs] == ["raw-collective"] * 2
+
     def test_non_collective_lax_ok(self, tmp_path):
         vs = _lint_src(tmp_path, """
             from jax import lax

@@ -269,6 +269,27 @@ class TestTraceDivergence:
         )
 
 
+class TestMismatchedSharding:
+    def test_implicit_collectives_fail_both_ranks_before_dispatch(
+        self, tmp_path
+    ):
+        """ISSUE 6 satellite: rank 1's mismatched input sharding makes
+        the partitioner insert all-gathers into ITS program only; the
+        cross-process ``implicit_agreement`` check raises
+        ``ImplicitCollectiveError`` on BOTH ranks before dispatch, with
+        the responsible dot_general cited."""
+        res = run_world(
+            "mismatched_sharding", n_procs=2, local_devices=2,
+            tmpdir=tmp_path, timeout=240,
+            extra_env={"CHAINERMN_TPU_MISMATCH_RANK": "1"},
+        )
+        payloads = _assert_ok(res, "mismatched_sharding")
+        assert all(
+            p["raised"] == "ImplicitCollectiveError" for p in payloads
+        )
+        assert all(p["cited_dot"] for p in payloads)
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

@@ -1,0 +1,220 @@
+"""perf_history bench differ (ISSUE 6 satellite): the first slice of
+the ROADMAP perf-gate item runs in tier-1 as a smoke — the committed
+``BENCH_r*.json`` trajectory diffs clean, and the regression rules
+behave as documented on synthetic captures.
+
+Pure JSON/regex work: no jax import in the tool path.
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "benchmarks"))
+
+from perf_history import (  # noqa: E402
+    DEFAULT_TOLERANCE,
+    Regression,
+    bench_files,
+    diff_rows,
+    load_rows,
+    lower_is_better,
+    main,
+    newest_comparable_pair,
+)
+
+
+def _capture(tmp_path, name, rows):
+    tail = "\n".join(json.dumps(r) for r in rows) + "\n"
+    p = tmp_path / name
+    p.write_text(json.dumps({"n": 1, "rc": 0, "tail": tail}))
+    return str(p)
+
+
+# ----------------------------------------------------------------------
+# the smoke: the committed trajectory itself
+# ----------------------------------------------------------------------
+class TestCommittedTrajectory:
+    def test_repo_captures_diff_clean(self):
+        """Acceptance: the two newest comparable committed captures
+        carry shared rows and no regression beyond spread — the same
+        gate a new capture will face."""
+        pair = newest_comparable_pair(REPO)
+        assert pair is not None, "need two comparable BENCH_r*.json"
+        old, new = (load_rows(p) for p in pair)
+        shared = set(old) & set(new)
+        assert shared, (pair, sorted(old), sorted(new))
+        assert diff_rows(old, new) == []
+
+    def test_rich_captures_diff_many_rows_clean(self):
+        """The full-capture pair (r02 -> r05, summary rows flattened)
+        compares the whole tracked config set."""
+        old = load_rows(os.path.join(REPO, "BENCH_r02.json"))
+        new = load_rows(os.path.join(REPO, "BENCH_r05.json"))
+        assert len(set(old) & set(new)) >= 5
+        assert diff_rows(old, new) == []
+
+    def test_failed_captures_fall_back_to_local(self):
+        """r04's remote capture failed (null row) but its committed
+        _local capture carries the measurement — pair selection must
+        use the local fallback for revision 4, not skip the revision
+        (and never compare a revision against its own fallback)."""
+        files = bench_files(REPO)
+        assert any("BENCH_r04.json" in f for f in files)
+        assert load_rows(os.path.join(REPO, "BENCH_r04.json")) == {} or (
+            not any(
+                isinstance(r.get("value"), (int, float))
+                for r in load_rows(
+                    os.path.join(REPO, "BENCH_r04.json")
+                ).values()
+            )
+        )
+        local = load_rows(os.path.join(REPO, "BENCH_r04_local.json"))
+        assert any(
+            isinstance(r.get("value"), (int, float))
+            for r in local.values()
+        ), "the bare-row _local shape must parse"
+        pair = newest_comparable_pair(REPO)
+        assert "BENCH_r04_local" in pair[0]
+        assert "BENCH_r05.json" in pair[1]
+
+    def test_console_entry_exits_zero_on_clean_history(self):
+        proc = subprocess.run(
+            [sys.executable, "benchmarks/perf_history.py"],
+            capture_output=True, text=True, cwd=REPO,
+        )
+        assert proc.returncode == 0, proc.stdout + proc.stderr
+        assert "regression" in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# rule behavior on synthetic captures
+# ----------------------------------------------------------------------
+class TestDiffRules:
+    def test_regression_beyond_recorded_spread_flagged(self, tmp_path):
+        old = _capture(tmp_path, "BENCH_r01.json", [
+            {"metric": "step_time_ms", "value": 100.0,
+             "n_measurements": 3, "spread_max_over_min": 1.2},
+        ])
+        new = _capture(tmp_path, "BENCH_r02.json", [
+            {"metric": "step_time_ms", "value": 130.0,
+             "n_measurements": 3, "spread_max_over_min": 1.2},
+        ])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        assert len(regs) == 1
+        r = regs[0]
+        assert isinstance(r, Regression)
+        assert r.direction == "lower-better"
+        assert r.ratio > 1.2 and r.allowed == 1.2
+
+    def test_move_within_spread_not_flagged(self, tmp_path):
+        old = _capture(tmp_path, "a.json", [
+            {"metric": "step_time_ms", "value": 100.0,
+             "spread_max_over_min": 1.3},
+        ])
+        new = _capture(tmp_path, "b.json", [
+            {"metric": "step_time_ms", "value": 125.0,
+             "spread_max_over_min": 1.1},
+        ])
+        # tolerance = max recorded spread (1.3) — 1.25x is inside it
+        assert diff_rows(load_rows(old), load_rows(new)) == []
+
+    def test_throughput_direction(self, tmp_path):
+        old = _capture(tmp_path, "a.json", [
+            {"metric": "images_per_sec_per_chip", "value": 2000.0},
+        ])
+        worse = _capture(tmp_path, "b.json", [
+            {"metric": "images_per_sec_per_chip", "value": 1500.0},
+        ])
+        better = _capture(tmp_path, "c.json", [
+            {"metric": "images_per_sec_per_chip", "value": 2500.0},
+        ])
+        assert len(diff_rows(load_rows(old), load_rows(worse))) == 1
+        assert diff_rows(load_rows(old), load_rows(better)) == []
+
+    def test_per_sec_per_chip_is_higher_better(self):
+        # the spelling trap: "images_per_sec_per_chip" CONTAINS the
+        # substring "sec_per" — throughput must win
+        assert not lower_is_better("images_per_sec_per_chip", {})
+        assert lower_is_better("sec_per_generate", {})
+        assert lower_is_better("step_time_ms", {})
+        assert not lower_is_better("mnist.v", {"unit": "samples/sec"})
+
+    def test_throughput_collapse_to_zero_fails_the_gate(self, tmp_path):
+        """Regression: a tracked throughput recording 0 (harness bug
+        writing 0 instead of null) is the worst possible regression —
+        it must fail, not be skipped as unratioable."""
+        old = _capture(tmp_path, "a.json",
+                       [{"metric": "tokens_per_sec_per_chip",
+                         "value": 1000.0}])
+        new = _capture(tmp_path, "b.json",
+                       [{"metric": "tokens_per_sec_per_chip",
+                         "value": 0.0}])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        assert len(regs) == 1 and regs[0].ratio == float("inf")
+        # ...while a lower-better metric at 0 is bogus data, not a
+        # slowdown — skipped
+        old_ms = _capture(tmp_path, "c.json",
+                          [{"metric": "step_time_ms", "value": 10.0}])
+        new_ms = _capture(tmp_path, "d.json",
+                          [{"metric": "step_time_ms", "value": 0.0}])
+        assert diff_rows(load_rows(old_ms), load_rows(new_ms)) == []
+
+    def test_null_and_missing_rows_skipped(self, tmp_path):
+        old = _capture(tmp_path, "a.json", [
+            {"metric": "m1", "value": 10.0},
+            {"metric": "gone", "value": 5.0},
+        ])
+        new = _capture(tmp_path, "b.json", [
+            {"metric": "m1", "value": None},
+            {"metric": "fresh", "value": 7.0},
+        ])
+        assert diff_rows(load_rows(old), load_rows(new)) == []
+
+    def test_summary_values_flattened(self, tmp_path):
+        cap = _capture(tmp_path, "a.json", [
+            {"metric": "top", "value": 1.0, "summary": {
+                "mnist": {"v": 100.0, "ms": 0.5, "u": "samples/sec"},
+            }},
+        ])
+        rows = load_rows(cap)
+        assert rows["mnist.v"]["value"] == 100.0
+        # step-time pseudo-rows are NOT emitted: ms moves with config
+        # changes even when per-chip throughput improves
+        assert "mnist.ms" not in rows
+
+    def test_default_tolerance_without_spread(self, tmp_path):
+        old = _capture(tmp_path, "a.json",
+                       [{"metric": "x_per_sec", "value": 100.0}])
+        new = _capture(tmp_path, "b.json",
+                       [{"metric": "x_per_sec", "value": 95.0}])
+        # 5% inside the 10% default
+        assert diff_rows(load_rows(old), load_rows(new)) == []
+        assert DEFAULT_TOLERANCE == 1.10
+
+    def test_explicit_pair_with_unreadable_capture_fails(
+        self, tmp_path, capsys
+    ):
+        """Regression: a typo'd/truncated explicit path must not pass
+        the gate green as '0 shared rows'."""
+        good = _capture(tmp_path, "BENCH_r01.json",
+                        [{"metric": "x_per_sec", "value": 1.0}])
+        assert main([good, str(tmp_path / "BENCH_r99.json")]) == 2
+        assert "no parseable rows" in capsys.readouterr().err
+        empty = tmp_path / "empty.json"
+        empty.write_text(json.dumps({"tail": "", "parsed": None}))
+        assert main([good, str(empty)]) == 2
+
+    def test_main_on_explicit_pair(self, tmp_path, capsys):
+        old = _capture(tmp_path, "BENCH_r01.json", [
+            {"metric": "tokens_per_sec_per_chip", "value": 1000.0},
+        ])
+        new = _capture(tmp_path, "BENCH_r02.json", [
+            {"metric": "tokens_per_sec_per_chip", "value": 500.0},
+        ])
+        assert main([old, new]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out
+        assert main([old, old]) == 0

@@ -126,6 +126,27 @@ class CommunicatorBase:
     def inter_size(self) -> int:
         return self._topology.inter_size
 
+    def world_descriptor(self) -> dict:
+        """JSON-able description of this communicator's world, written
+        into checkpoint manifests (the elastic-restart contract,
+        ``resilience.elastic``: a resumed world whose descriptor differs
+        from the manifest routes the restore through the resharder).
+        ``world_size`` is the chip count the collectives span — what
+        ZeRO state blocks shard over; ``mesh_axes`` records the axis
+        factorization (the hierarchical ``mn_inter``/``mn_intra`` pair
+        re-derives from the surviving topology on a resize)."""
+        try:
+            axes = {
+                str(k): int(v) for k, v in dict(self.mesh.shape).items()
+            }
+        except Exception:
+            axes = {}
+        return {
+            "world_size": int(self.size),
+            "process_count": int(self.process_count),
+            "mesh_axes": axes,
+        }
+
     # ------------------------------------------------------------------
     # Array collectives (abstract; stacked-array semantics)
     # ------------------------------------------------------------------

@@ -14,6 +14,12 @@ The agreement protocol survives unchanged, but it agrees on complete
 *global* checkpoints (a step counts only if every process finished its
 shards — orbax's commit semantics make partial writes invisible, which is
 strictly stronger than the reference's per-rank npz inventory).
+
+Elastic restart (``resilience.elastic``): every snapshot carries a world
+manifest (world size, process count, mesh axes; the npz tier adds
+per-file integrity digests the inventory verifies).  ``resume()`` in a
+world whose size differs from the manifest routes the state through the
+checkpoint resharder instead of failing — see :meth:`resume`.
 """
 
 from __future__ import annotations
@@ -26,6 +32,10 @@ from typing import Any, Dict, Optional
 
 import jax
 import numpy as np
+
+from ..resilience import elastic as _elastic
+from ..resilience import fault_injection as _fi
+from ..resilience.log import emit as _emit
 
 
 class _MultiNodeCheckpointer:
@@ -70,6 +80,15 @@ class _MultiNodeCheckpointer:
         self._use_orbax = use_orbax
         self._use_async = use_async
         self._ckptr = None
+        # (old_world, new_world) of the last resume that routed through
+        # the elastic resharder; None when the worlds matched.
+        # last_manifest: the elected snapshot's world manifest.
+        self.last_resize = None
+        self.last_manifest = None
+        # integrity-verification memo: path -> (stat signature, ok).
+        # Committed snapshots never change, so one full-content hash per
+        # directory state is enough; the inventory scan re-stats only.
+        self._verified: dict = {}
         os.makedirs(self._root, exist_ok=True)
 
     # -- storage backends ----------------------------------------------
@@ -107,8 +126,23 @@ class _MultiNodeCheckpointer:
 
     def _is_complete(self, path: str) -> bool:
         # orbax writes atomically (tmp dir + rename); presence of the final
-        # dir (with no orbax tmp marker) means commit finished.
-        return os.path.isdir(path) and not path.endswith(".tmp")
+        # dir (with no orbax tmp marker) means commit finished.  On top of
+        # presence, the integrity manifest (written at save by the npz
+        # tier) is verified: a torn/corrupt snapshot (truncated npz,
+        # flipped byte) is EXCLUDED from the inventory, so the agreement
+        # protocol degrades to the previous step instead of electing a
+        # snapshot that raises at load.
+        if not os.path.isdir(path) or path.endswith(".tmp"):
+            return False
+        sig = _elastic.snapshot_signature(path)
+        cached = self._verified.get(path)
+        if cached is not None and cached[0] == sig:
+            return cached[1]
+        ok = _elastic.verify_snapshot(path)
+        self._verified[path] = (sig, ok)
+        if not ok:
+            _emit("snapshot_corrupt", "checkpoint.inventory", path=path)
+        return ok
 
     @property
     def _is_chief(self) -> bool:
@@ -126,6 +160,10 @@ class _MultiNodeCheckpointer:
         it (orbax writes each process's addressable shards); filesystem
         mutations of shared directories are chief-only with barriers.
         """
+        # resilience site: rank-death / slice-loss rehearsal point for
+        # the elastic mp tier (a `die` spec targeted at one process is a
+        # spot reclaim mid-snapshot); no-op when no injector is active
+        _fi.fire("checkpoint.save")
         target = self._step_dir(step)
         # Back-to-back saves serialize here (an in-flight async write of
         # an older step must commit before we mutate the directory
@@ -158,6 +196,16 @@ class _MultiNodeCheckpointer:
                 shutil.rmtree(target)
             self._comm.barrier()
             self._orbax().save(os.path.abspath(target), state)
+            # world manifest (elastic restart contract): chief-written
+            # SIBLING file — the orbax dir's contents belong to orbax.
+            # Atomic (tmp + rename); for async saves it may precede the
+            # data commit, which is safe: the step is only electable
+            # once the directory itself exists.
+            if self._is_chief:
+                _elastic.write_manifest(
+                    _elastic.world_manifest(self._comm),
+                    _elastic.manifest_sibling(target),
+                )
             if not self._use_async:
                 self._comm.barrier()
         else:
@@ -166,6 +214,10 @@ class _MultiNodeCheckpointer:
             if self._use_orbax:
                 try:
                     self._orbax().save(os.path.abspath(target), state)
+                    _elastic.write_manifest(
+                        _elastic.world_manifest(self._comm),
+                        _elastic.manifest_sibling(target),
+                    )
                 except Exception:
                     if self._use_async:
                         raise  # async failures must not silently degrade
@@ -205,6 +257,17 @@ class _MultiNodeCheckpointer:
         )
         with open(os.path.join(tmp, "treedef.pkl"), "wb") as f:
             pickle.dump(treedef, f)
+        # world manifest + per-file integrity digests, written INSIDE the
+        # tmp dir so manifest and payload commit in the same rename: a
+        # snapshot that later fails digest verification (torn write,
+        # bit rot) is excluded from the inventory by _is_complete and
+        # the agreement degrades to the previous step.
+        _elastic.write_manifest(
+            _elastic.world_manifest(
+                self._comm, files=_elastic.file_digests(tmp)
+            ),
+            os.path.join(tmp, _elastic.MANIFEST_NAME),
+        )
         # os.rename cannot replace a non-empty dir, so an existing
         # target (a re-save, or a failed orbax attempt's droppings) is
         # renamed ASIDE first.  The old snapshot survives until the new
@@ -227,9 +290,30 @@ class _MultiNodeCheckpointer:
     # -- agreement + resume --------------------------------------------
     def newest_common_step(self) -> Optional[int]:
         """The newest step every process has on disk (parity: the allgather
-        of snapshot inventories + max-common computation)."""
+        of snapshot inventories + max-common computation).
+
+        The inventory exchange rides the SAME lockstep retry as
+        ``comm_wire.plan_agreement`` / ``analysis.trace_agreement``: a
+        transient obj-store fault or a torn payload during resume is
+        observed by every process (each one unpickles each rank's
+        payload), so all ranks fail — and re-exchange — together instead
+        of desynchronizing the agreement.
+        """
+        from ..resilience.errors import PayloadCorruptionError
+        from ..resilience.retry import (
+            RetryPolicy,
+            call_with_retry,
+            is_transient,
+        )
+
         local = self._available_steps()
-        inventories = self._comm.allgather_obj(local)
+        inventories = call_with_retry(
+            lambda: self._comm.allgather_obj(local),
+            site="checkpoint.newest_common_step",
+            policy=RetryPolicy(max_attempts=4),
+            retryable=lambda e: is_transient(e)
+            or isinstance(e, PayloadCorruptionError),
+        )
         common = set(inventories[0])
         for inv in inventories[1:]:
             common &= set(inv)
@@ -237,12 +321,33 @@ class _MultiNodeCheckpointer:
 
     def resume(self, like: Optional[Dict[str, Any]] = None):
         """Load the newest common snapshot; returns (step, state) or
-        (None, None) when no checkpoint exists."""
+        (None, None) when no checkpoint exists.
+
+        Elastic restart: when the elected snapshot's world manifest
+        names a DIFFERENT world size than this communicator spans, the
+        load routes through the checkpoint resharder
+        (``resilience.elastic.reshard_state``, template-driven by
+        ``like``) instead of failing — ZeRO blocks re-partition, per-rank
+        residuals drop, world-size-independent leaves survive.  A
+        mismatch with no ``like`` template raises
+        ``WorldResizeRequiredError`` (resharding needs the new world's
+        freshly initialized state to re-partition onto).
+        ``self.last_resize`` records ``(old_world, new_world)`` when the
+        route was taken.
+        """
         self.wait_until_finished()  # async: the in-flight save counts
+        self.last_resize = None
+        self.last_manifest = None
         step = self.newest_common_step()
         if step is None:
             return None, None
         target = self._step_dir(step)
+        manifest = _elastic.read_world_manifest(target)
+        self.last_manifest = manifest
+        old_world = (manifest or {}).get("world_size")
+        resize = old_world is not None and int(old_world) != int(
+            self._comm.size
+        )
         npz = os.path.join(target, "state.npz")
         if os.path.exists(npz):
             treedef_path = os.path.join(target, "treedef.pkl")
@@ -258,7 +363,18 @@ class _MultiNodeCheckpointer:
             leaves = [data[f"leaf_{i}"] for i in range(treedef.num_leaves)]
             leaves = [l[()] if l.ndim == 0 and l.dtype == object else l
                       for l in leaves]
-            return step, jax.tree_util.tree_unflatten(treedef, leaves)
+            state = jax.tree_util.tree_unflatten(treedef, leaves)
+            if resize:
+                state = self._reshard(state, like, old_world, step)
+            return step, state
+        if resize:
+            # World mismatch: the template's shapes (and shardings)
+            # belong to the NEW world, so orbax must restore the SAVED
+            # shapes as host arrays; the resharder's walk tolerates the
+            # raw string-keyed-dict spelling of the saved structure.
+            state = self._restore_raw_host(target)
+            state = self._reshard(state, like, old_world, step)
+            return step, state
         restore_kwargs = {}
         if like is not None:
             try:
@@ -288,13 +404,81 @@ class _MultiNodeCheckpointer:
         )
         return step, state
 
+    def _restore_raw_host(self, target: str):
+        """Restore an orbax snapshot in its SAVED shapes as host numpy
+        arrays (no template): the elastic path's loader — a
+        world-mismatched snapshot cannot restore onto the new world's
+        template shapes/shardings, and arrays saved from sharded
+        ``jax.Array`` leaves need an explicit numpy restore type."""
+        import orbax.checkpoint as ocp
+
+        path = os.path.abspath(target)
+        ckptr = self._orbax()
+        try:
+            meta = ckptr.metadata(path)
+
+            def arg_of(m):
+                # true zarr-backed arrays must restore as numpy (the
+                # default would rebuild jax.Arrays and demand the dead
+                # world's shardings); scalar/string leaves live in the
+                # aggregate file and must keep the default restore —
+                # forcing np.ndarray makes orbax look for a zarr entry
+                # that does not exist
+                if type(m).__name__ == "ArrayMetadata":
+                    return ocp.RestoreArgs(restore_type=np.ndarray)
+                return ocp.RestoreArgs()
+
+            restore_args = jax.tree_util.tree_map(arg_of, meta)
+            return ckptr.restore(path, restore_args=restore_args)
+        except Exception:
+            # older orbax without metadata()/RestoreArgs spelling
+            return ckptr.restore(path)
+
+    def _reshard(self, state, like, old_world, step: int):
+        """Route a world-mismatched snapshot through the elastic
+        resharder (see :meth:`resume`)."""
+        from ..resilience.errors import WorldResizeRequiredError
+
+        new_world = int(self._comm.size)
+        old_world = int(old_world)
+        if like is None:
+            raise WorldResizeRequiredError(
+                f"checkpoint step {step} was written at world size "
+                f"{old_world} but this world spans {new_world} chips; "
+                "resharding needs a template of the new world's state — "
+                "call resume(like=...) with freshly initialized "
+                "params/opt_state (restore_trainer passes the trainer's "
+                "own), or restart via Trainer.run_elastic",
+                site="checkpoint.resume",
+            )
+        state = _elastic.reshard_state(
+            state, like, old_world, new_world, label=f"step_{step}"
+        )
+        self.last_resize = (old_world, new_world)
+        _emit(
+            "elastic_resume", "checkpoint.resume",
+            step=step, old_world=old_world, new_world=new_world,
+        )
+        return state
+
+    def _rm_step(self, step: int) -> None:
+        """Delete one snapshot AND its sibling world manifest (the npz
+        tier's manifest lives inside the dir and goes with it)."""
+        target = self._step_dir(step)
+        shutil.rmtree(target, ignore_errors=True)
+        try:
+            os.remove(_elastic.manifest_sibling(target))
+        except OSError:
+            pass
+        self._verified.pop(target, None)
+
     def _gc_local(self) -> None:
         """GC for the per-rank local-disk tier: every process owns its
         own directory, so deletion is local and barrier-free (a barrier
         here would turn one dead rank into a hang for all)."""
         steps = self._available_steps()
         for s in steps[: -self._keep] if self._keep else []:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            self._rm_step(s)
 
     def _gc(self) -> None:
         if self._multiproc and not self._use_orbax:
@@ -306,12 +490,12 @@ class _MultiNodeCheckpointer:
             if self._is_chief:
                 steps = self._available_steps()
                 for s in steps[: -self._keep] if self._keep else []:
-                    shutil.rmtree(self._step_dir(s), ignore_errors=True)
+                    self._rm_step(s)
             self._comm.barrier()
             return
         steps = self._available_steps()
         for s in steps[: -self._keep] if self._keep else []:
-            shutil.rmtree(self._step_dir(s), ignore_errors=True)
+            self._rm_step(s)
 
     def finalize(self, trainer=None) -> None:
         """Parity: the reference's finalize/GC of stale snapshots (plus,
@@ -339,6 +523,34 @@ class _MultiNodeCheckpointer:
         )
         if step is None:
             return None
+        if self.last_resize:
+            # Iterator cursors are per-PROCESS state (each controller
+            # feeds its own dataset shard), so they re-map by PROCESS
+            # count: pos rescaled onto the new shard width, order
+            # redrawn from the restored RNG.  An UNCHANGED process
+            # count (chips-per-process resize, or single-controller
+            # global batches) leaves the shard width — and therefore
+            # the cursor and the in-flight permutation — exactly valid,
+            # so they survive untouched.
+            old_pc = int((self.last_manifest or {}).get(
+                "process_count"
+            ) or 1)
+            new_pc = int(self._comm.process_count)
+            tr = state.get("trainer")
+            if old_pc != new_pc and isinstance(
+                tr, dict
+            ) and isinstance(tr.get("iterator"), dict):
+                tr["iterator"] = _elastic.reshard_iterator_state(
+                    tr["iterator"], old_pc, new_pc
+                )
+            # the resharded leaves are host arrays; lay them out onto
+            # the NEW world's mesh per the step's own placement rules
+            # (ZeRO state shards land sharded, params replicate)
+            place = getattr(trainer.updater.step_fn, "place", None)
+            if place is not None:
+                state["params"], state["opt_state"] = place(
+                    state["params"], state["opt_state"]
+                )
         trainer.updater.params = state["params"]
         trainer.updater.opt_state = state["opt_state"]
         trainer.load_state_dict(state["trainer"])

@@ -103,9 +103,13 @@ class SerialIterator:
         }
 
     def restore(self, state):
-        self.epoch = state["epoch"]
-        self._pos = state["pos"]
-        self._order = np.asarray(state["order"])
+        self.epoch = int(state["epoch"])
+        self._pos = int(state["pos"])
+        # RNG first: an elastic world resize clears ``order`` (the
+        # permutation is per-shard-width — resilience.elastic.
+        # reshard_iterator_state), and the redraw below must come from
+        # the RESTORED stream so the new world's shuffle is
+        # deterministic.
         if "rng_keys" in state:
             self._rng.set_state((
                 str(state.get("rng_kind", "MT19937")),
@@ -114,6 +118,10 @@ class SerialIterator:
                 int(state.get("rng_has_gauss", 0)),
                 float(state.get("rng_cached", 0.0)),
             ))
+        order = state.get("order")
+        self._order = (
+            self._new_order() if order is None else np.asarray(order)
+        )
 
 
 class EpochIterator:

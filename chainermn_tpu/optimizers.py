@@ -417,6 +417,45 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
 
         return jax.tree_util.tree_map(spec, opt_state)
 
+    def reshard_state(self, opt_state, old_world: int, params):
+        """Re-partition an optimizer state saved at ``old_world`` ranks
+        onto THIS communicator's world (elastic N→M restart).
+
+        Gather-to-global then re-split, template-driven by a fresh
+        ``init(params)``: every blocked ``(n, k)`` leaf
+        :meth:`state_partition_spec` declares sharded is re-blocked
+        **bit-identically** to a fresh partition of the gathered global
+        state (the blocking's zero padding lives at the tail, so
+        truncate/pad is exact — ``resilience.elastic``
+        ``reshard_blocked_leaf``).  ``init`` also re-runs the wire
+        ``plan_agreement`` in multi-process worlds, so the plan hash is
+        re-agreed for the new world as a side effect.  Checkpoint
+        ``resume()`` routes here automatically via the world manifest;
+        this method is the direct form.
+        """
+        from .resilience import elastic as _elastic
+        from .resilience.errors import WorldResizeRequiredError
+
+        template = self.init(params)
+        out = _elastic.reshard_state(
+            opt_state, template, int(old_world), int(self._comm.size),
+            label="zero_opt_state",
+        )
+        # cross-check against the exported layout: the resharded state
+        # must declare the SAME partitioning as a fresh init (a leaf the
+        # spec shards that came out unblocked means the resharder and
+        # the layout drifted apart)
+        if self.state_partition_spec(out) != self.state_partition_spec(
+            template
+        ):
+            raise WorldResizeRequiredError(
+                "resharded ZeRO state disagrees with "
+                "state_partition_spec's layout for this world — the "
+                "saved state's structure does not match this optimizer",
+                site="optimizers.reshard_state",
+            )
+        return out
+
     def hbm_bytes_per_rank(self, params, opt_state=None) -> dict:
         """``{"params": bytes, "opt_state": bytes}`` one rank actually
         holds — params replicated (full copy per rank), state leaves

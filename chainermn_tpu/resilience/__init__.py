@@ -13,11 +13,14 @@ auto-resume lives in ``training.trainer.Trainer.run(max_restarts=N)``.
 from .errors import (  # noqa: F401
     CollectiveTraceMismatchError,
     PayloadCorruptionError,
+    PreemptionError,
     ResilienceError,
     RestartBudgetExceededError,
     StepDivergedError,
     TransientCommError,
+    WorldResizeRequiredError,
 )
+from . import elastic  # noqa: F401  (N→M restart: manifests + resharding)
 from .fault_injection import (  # noqa: F401
     FaultInjector,
     FaultSpec,

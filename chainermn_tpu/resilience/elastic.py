@@ -1,0 +1,483 @@
+"""Elastic worlds: preemption-tolerant N→M restart with checkpoint
+resharding.
+
+The resilience layer's auto-resume (PR 1) covers "same world, same
+step": the world that resumes is the world that saved.  Production TPU
+fleets lose slices to preemption and spot reclaim, so this module adds
+the three layers that make a checkpoint written at world size N
+restorable at world size M:
+
+1. **World manifests + integrity digests** — every snapshot carries a
+   JSON manifest naming the world that wrote it (``world_size``,
+   ``process_count``, mesh axis factorization) and, on the npz tier, a
+   per-file checksum inventory.  :func:`verify_snapshot` lets the
+   checkpoint inventory exclude torn/corrupt snapshots so
+   ``newest_common_step`` degrades to the previous step instead of
+   raising at load.
+2. **Checkpoint resharding** — :func:`reshard_state` re-partitions a
+   saved state onto a new world, template-driven by the new world's
+   freshly initialized state: world-size-independent leaves (replicated
+   params, step counters) survive verbatim; ZeRO ``(N, k)`` optimizer
+   blocks are re-blocked to ``(M, k')`` **bit-identically** to a fresh
+   partition of the gathered global state (the zero padding the blocking
+   introduced lives at the tail, and every padded length is >= the true
+   element count, so truncate/pad-with-zeros is exact for any N→M — not
+   just the divisible cases); per-rank state that has no meaning in a
+   different world (error-feedback residuals, double-buffered stale
+   gradients) is dropped to fresh zeros with a logged warning; iterator
+   cursors are rescaled (:func:`reshard_iterator_state`).
+3. **World re-formation** — :func:`reform_world` re-invokes
+   ``create_communicator`` over the surviving world (the mesh
+   factorization, including the ``mn_inter``/``mn_intra`` axis pair,
+   re-derives from the new topology) and
+   :func:`reestablish_agreements` re-runs the agreement stack in order:
+   comm_wire ``plan_hash`` re-derivation + ``plan_agreement``, then the
+   analysis ``trace_agreement`` via the step's divergence guard.  Both
+   guards are keyed per compiled program variant, so a resized world
+   retraces and re-guards by construction — this function makes the
+   re-agreement explicit and returns the agreed tokens.
+
+``extensions/checkpoint.py`` routes ``resume()`` through layer 2 when
+the elected snapshot's manifest names a different world;
+``training.trainer.Trainer.run_elastic`` is the restart mode that
+composes all three.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import warnings
+from typing import Any, Mapping, Optional
+
+import numpy as np
+
+from .log import emit
+
+MANIFEST_FORMAT = 1
+MANIFEST_NAME = "manifest.json"
+
+# Optimizer-state fields that are PER-RANK by construction: the
+# error-feedback residual is the compression error of THIS rank's last
+# shipped gradient, the double-buffering buffer is THIS rank's stale
+# local gradient.  Neither has a meaning in a resized world (the rank's
+# gradient stream does not survive the resize), so both are dropped to
+# the new world's fresh zeros — with a logged warning, never silently.
+PER_RANK_FIELDS = ("wire_residual", "prev_grads")
+
+_MISSING = object()  # sentinel: the saved tree has no value for this slot
+
+
+# ----------------------------------------------------------------------
+# world manifests + integrity digests
+# ----------------------------------------------------------------------
+def world_manifest(comm, *, files: Optional[dict] = None) -> dict:
+    """The manifest written beside/inside every snapshot: the world's
+    descriptor (``communicator.world_descriptor()``) plus an optional
+    per-file checksum inventory (npz tier)."""
+    m = {"format": MANIFEST_FORMAT}
+    m.update(comm.world_descriptor())
+    if files is not None:
+        m["files"] = files
+    return m
+
+
+def manifest_sibling(step_dir: str) -> str:
+    """Sibling manifest path for backends that own the step directory's
+    contents (orbax): ``<step_dir>.manifest.json``.  The step scan's
+    ``step_<digits>`` regex never matches it."""
+    return step_dir.rstrip("/") + ".manifest.json"
+
+
+def write_manifest(manifest: dict, path: str) -> None:
+    """Atomic JSON write (tmp + rename) so a crash mid-write can never
+    leave a torn manifest electable."""
+    tmp = f"{path}.tmp{os.getpid()}"
+    with open(tmp, "w") as f:
+        json.dump(manifest, f, indent=1, sort_keys=True)
+    os.replace(tmp, path)
+
+
+_INVALID_MANIFEST = object()  # present on disk but unreadable/unparseable
+
+
+def _read_manifest_file(step_dir: str):
+    """The step's manifest: in-dir (npz tier, atomic with the snapshot)
+    first, then the sibling (orbax tier).  Returns the dict, ``None``
+    when NO manifest exists anywhere (the snapshot predates the elastic
+    format — presence-based semantics), or :data:`_INVALID_MANIFEST`
+    when a manifest file is present but torn/unparseable — which must
+    mark the snapshot corrupt, NOT masquerade as pre-elastic (that
+    would silently disable both integrity verification and resize
+    detection)."""
+    found_broken = False
+    for path in (os.path.join(step_dir, MANIFEST_NAME),
+                 manifest_sibling(step_dir)):
+        if not os.path.exists(path):
+            continue
+        try:
+            with open(path) as f:
+                return json.load(f)
+        except (OSError, ValueError):
+            found_broken = True
+    return _INVALID_MANIFEST if found_broken else None
+
+
+def read_world_manifest(step_dir: str) -> Optional[dict]:
+    """The step's manifest as a dict, or None when absent OR invalid
+    (an invalid manifest already excluded the snapshot from the
+    inventory via :func:`verify_snapshot`, so readers never elect
+    it)."""
+    m = _read_manifest_file(step_dir)
+    return m if isinstance(m, dict) else None
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def file_digests(root: str, *, exclude=(MANIFEST_NAME,)) -> dict:
+    """``{relpath: {"bytes": n, "sha256": hex}}`` for every file under
+    ``root`` (the manifest itself excluded — it cannot contain its own
+    digest)."""
+    out = {}
+    for dirpath, _dirs, names in os.walk(root):
+        for name in names:
+            full = os.path.join(dirpath, name)
+            rel = os.path.relpath(full, root)
+            if rel in exclude:
+                continue
+            out[rel] = {
+                "bytes": os.path.getsize(full),
+                "sha256": _sha256_file(full),
+            }
+    return out
+
+
+def snapshot_signature(step_dir: str) -> tuple:
+    """Cheap stat-based fingerprint of a snapshot's verifiable content,
+    for caching :func:`verify_snapshot` results (committed snapshots
+    never change, so one full hash per directory state suffices)."""
+    m = read_world_manifest(step_dir)
+    files = (m or {}).get("files")
+    if not files:
+        return ("nofiles",)
+    sig = []
+    for rel in sorted(files):
+        p = os.path.join(step_dir, rel)
+        try:
+            st = os.stat(p)
+            sig.append((rel, st.st_size, st.st_mtime_ns))
+        except OSError:
+            sig.append((rel, -1, -1))
+    return tuple(sig)
+
+
+def verify_snapshot(step_dir: str, manifest: Optional[dict] = None) -> bool:
+    """True iff every file the manifest inventories exists with the
+    recorded byte count and sha256.  Snapshots without a manifest (or
+    without digests — the orbax tiers, whose tmp-dir+rename commit is
+    already atomic) verify by presence, preserving pre-elastic
+    inventories."""
+    m = manifest if manifest is not None else _read_manifest_file(step_dir)
+    if m is _INVALID_MANIFEST:
+        return False  # torn/corrupt manifest: the snapshot is suspect
+    files = (m or {}).get("files")
+    if not files:
+        return True
+    for rel, info in files.items():
+        p = os.path.join(step_dir, rel)
+        if not os.path.isfile(p):
+            return False
+        try:
+            if os.path.getsize(p) != int(info["bytes"]):
+                return False
+            if _sha256_file(p) != info["sha256"]:
+                return False
+        except (OSError, KeyError, TypeError, ValueError):
+            return False
+    return True
+
+
+# ----------------------------------------------------------------------
+# checkpoint resharding (N -> M)
+# ----------------------------------------------------------------------
+def reshard_blocked_leaf(old, new_shape, *, dtype=None) -> np.ndarray:
+    """Re-block one ZeRO ``(N, k)`` leaf to ``new_shape = (M, k')``.
+
+    Gather-to-global then re-split, in one move: the blocking
+    (``optimizers._to_blocks``) flattens the true parameter and pads the
+    TAIL with zeros to ``N*k``; a fresh partition at M pads the same true
+    prefix to ``M*k'``.  Both padded lengths are >= the true element
+    count, so truncating (drops only tail zeros) or zero-padding the old
+    flat buffer to ``M*k'`` reproduces the fresh partition bit for bit —
+    for ANY N, M, divisible or not.
+    """
+    flat = np.asarray(old).reshape(-1)
+    target = int(np.prod(new_shape, dtype=np.int64))
+    if flat.size > target:
+        flat = flat[:target]
+    elif flat.size < target:
+        flat = np.concatenate(
+            [flat, np.zeros(target - flat.size, flat.dtype)]
+        )
+    out = flat.reshape(tuple(int(d) for d in new_shape))
+    if dtype is not None and out.dtype != np.dtype(dtype):
+        out = out.astype(dtype)
+    return out
+
+
+def _is_namedtuple(x) -> bool:
+    return isinstance(x, tuple) and hasattr(x, "_fields")
+
+
+def _shape_of(x):
+    try:
+        return tuple(np.shape(x))
+    except Exception:
+        return None
+
+
+def _has_content(x) -> bool:
+    """True when a per-rank field actually carries state worth warning
+    about (a non-empty residual/stale-gradient container)."""
+    if x is _MISSING or x is None:
+        return False
+    if isinstance(x, (tuple, list, Mapping)):
+        return len(x) > 0
+    return True
+
+
+def reshard_state(old_state, like, old_world: int, new_world: int,
+                  *, label: str = "checkpoint"):
+    """Re-partition ``old_state`` (saved at ``old_world`` ranks) onto the
+    structure/shapes of ``like`` (the NEW world's freshly initialized
+    state — ``restore_trainer`` passes the trainer's own params /
+    opt_state / state_dict).
+
+    Rules, applied leaf-by-leaf with the template driving the walk:
+
+    * equal shapes → the saved value survives verbatim (replicated
+      params, step counters, RNG state — world-size-independent);
+    * ``(old_world, k)`` vs ``(new_world, k')`` 2-D pairs → ZeRO block
+      re-partition via :func:`reshard_blocked_leaf` (bit-identical to a
+      fresh partition of the gathered global state);
+    * fields named in :data:`PER_RANK_FIELDS` (error-feedback residuals,
+      double-buffered stale gradients) → the template's fresh zeros,
+      with a logged warning when the saved value was non-empty;
+    * anything else (shape changed in a non-block way, slot missing from
+      the saved tree) → the template's fresh value, with a logged
+      warning — a reset, never a crash.
+
+    The walk tolerates the orbax raw-restore shape of the saved tree
+    (NamedTuples/tuples as string-keyed dicts, empty subtrees omitted),
+    so a world-mismatched orbax checkpoint reshards without its original
+    treedef.
+    """
+    old_world, new_world = int(old_world), int(new_world)
+    stats = {"resharded": 0, "dropped": [], "reset": []}
+
+    def leaf(o, t, path):
+        if o is _MISSING:
+            stats["reset"].append(path)
+            warnings.warn(
+                f"elastic reshard: {path} missing from the world-"
+                f"{old_world} snapshot; reset to the new world's fresh "
+                "value"
+            )
+            return t
+        if o is None and t is None:
+            return None
+        o_shape, t_shape = _shape_of(o), _shape_of(t)
+        if o_shape is not None and o_shape == t_shape:
+            return o
+        if (
+            o_shape is not None and t_shape is not None
+            and len(o_shape) == 2 and len(t_shape) == 2
+            and o_shape[0] == old_world and t_shape[0] == new_world
+        ):
+            stats["resharded"] += 1
+            return reshard_blocked_leaf(
+                o, t_shape, dtype=getattr(t, "dtype", None)
+            )
+        stats["reset"].append(path)
+        warnings.warn(
+            f"elastic reshard: {path}: shape {o_shape} cannot be "
+            f"re-partitioned {old_world}->{new_world} onto {t_shape}; "
+            "reset to the new world's fresh value"
+        )
+        return t
+
+    def child(o, key, fields=None):
+        """The saved tree's slot for template key ``key`` — tolerating
+        the raw-orbax spellings (namedtuple -> field-keyed dict,
+        tuple/list -> str(index)-keyed dict)."""
+        if o is _MISSING or o is None:
+            return _MISSING
+        if isinstance(key, int):
+            if _is_namedtuple(o) and fields is not None:
+                return getattr(o, fields[key], _MISSING)
+            if isinstance(o, (list, tuple)):
+                return o[key] if key < len(o) else _MISSING
+            if isinstance(o, Mapping):
+                if fields is not None and fields[key] in o:
+                    return o[fields[key]]
+                return o.get(str(key), _MISSING)
+            return _MISSING
+        if _is_namedtuple(o):
+            return getattr(o, key, _MISSING)
+        if isinstance(o, Mapping):
+            return o.get(key, _MISSING)
+        return _MISSING
+
+    def walk(o, t, path):
+        if _is_namedtuple(t):
+            vals = []
+            for i, f in enumerate(t._fields):
+                tv = getattr(t, f)
+                ov = child(o, f)
+                if ov is _MISSING:
+                    ov = child(o, i, t._fields)
+                if f in PER_RANK_FIELDS:
+                    if _has_content(ov):
+                        stats["dropped"].append(f"{path}.{f}")
+                        warnings.warn(
+                            f"elastic reshard: {path}.{f}: per-rank "
+                            "state (error-feedback residual / stale "
+                            "gradient buffer) cannot be re-partitioned "
+                            f"across a {old_world}->{new_world} world "
+                            "resize; dropping to fresh zeros"
+                        )
+                    vals.append(tv)
+                    continue
+                vals.append(walk(ov, tv, f"{path}.{f}"))
+            return type(t)(*vals)
+        if isinstance(t, Mapping):
+            items = {k: walk(child(o, k), v, f"{path}.{k}")
+                     for k, v in t.items()}
+            try:
+                return type(t)(items)
+            except Exception:
+                return items
+        if isinstance(t, (list, tuple)):
+            out = [walk(child(o, i), tv, f"{path}[{i}]")
+                   for i, tv in enumerate(t)]
+            return type(t)(out)
+        return leaf(o, t, path)
+
+    out = walk(old_state, like, label)
+    emit(
+        "elastic_reshard", f"elastic.reshard_state({label})",
+        old_world=old_world, new_world=new_world,
+        resharded=stats["resharded"],
+        dropped=list(stats["dropped"]), reset=list(stats["reset"]),
+    )
+    return out
+
+
+def reshard_iterator_state(state, old_world: int, new_world: int) -> dict:
+    """Re-map a per-rank iterator cursor (``SerialIterator.serialize``
+    shape) onto the new world's shard width.  ``old_world``/``new_world``
+    here are the counts the DATA splits over — process counts for the
+    per-controller iterator tier (what ``restore_trainer`` passes); a
+    single-controller world's global iterator needs no remap at all.
+
+    With equalized shards (``scatter_dataset``'s contract) and
+    synchronized per-rank cursors, the GLOBAL number of consumed samples
+    is ``pos * old_world``; the new world's per-rank cursor is that
+    global count re-split over ``new_world`` ranks.  The per-epoch
+    ``order`` permutation is per-shard-width and cannot survive — it is
+    cleared (``None``) and ``SerialIterator.restore`` redraws it from
+    the restored RNG stream, so the new world's shuffle is still
+    deterministic.  Epoch and RNG state survive verbatim.
+    """
+    if not isinstance(state, Mapping):
+        return state
+    out = dict(state)
+    if "pos" in out and out["pos"] is not None:
+        pos = int(np.asarray(out["pos"]))
+        out["pos"] = (pos * int(old_world)) // max(int(new_world), 1)
+    out["order"] = None
+    emit(
+        "elastic_iterator_reshard", "elastic.reshard_iterator_state",
+        old_world=int(old_world), new_world=int(new_world),
+        pos=out.get("pos"),
+    )
+    return out
+
+
+# ----------------------------------------------------------------------
+# world re-formation + agreement re-establishment
+# ----------------------------------------------------------------------
+def reform_world(communicator_name: str = "tpu", *, devices=None,
+                 previous: Optional[dict] = None, **kwargs):
+    """Rebuild the communicator from the surviving world.
+
+    Re-invokes ``create_communicator`` over the devices the restarted
+    job actually has — every mesh axis re-derives from the new topology
+    (the hierarchical ``mn_inter``/``mn_intra`` pair re-factorizes; a
+    world reduced to one slice degrades to a width-1 inter axis, loudly,
+    exactly as at first formation).  ``previous``: the dead world's
+    manifest, logged against the new descriptor so the resize is an
+    observable event, not an inference.
+    """
+    from ..communicators import create_communicator
+
+    comm = create_communicator(communicator_name, devices=devices, **kwargs)
+    desc = comm.world_descriptor()
+    emit(
+        "world_reformed", "elastic.reform_world",
+        world_size=desc["world_size"],
+        process_count=desc["process_count"],
+        mesh_axes=desc["mesh_axes"],
+        previous_world_size=(previous or {}).get("world_size"),
+    )
+    return comm
+
+
+def reestablish_agreements(comm, *, params=None, optimizer=None,
+                           step=None, opt_state=None, batch=None) -> dict:
+    """Re-run the agreement stack for a re-formed world, in order.
+
+    1. **Wire plan**: the bucket plan is a pure function of gradient
+       shapes, but its *agreement token* belongs to a process set — the
+       hash is re-derived from ``params`` and re-exchanged via
+       ``comm_wire.plan_agreement`` (skipped when the optimizer carries
+       no wire).
+    2. **Collective trace**: ``step.verify_collective_trace`` forces the
+       divergence guard for the new world's program NOW (rather than at
+       first dispatch).  The trace hash is a function of per-shard
+       shapes and axis sizes, so a resized world's hash differs from the
+       old world's — re-agreed, never assumed.
+
+    (``implicit_agreement`` re-arms the same way: it is keyed per
+    compiled program, and a resized world compiles a new program — the
+    shardflow tests pin that path.)  Returns the agreed tokens that
+    could be established from the given inputs.
+    """
+    out = {}
+    wire = getattr(optimizer, "wire", None) if optimizer is not None else None
+    if wire is not None and params is not None:
+        from ..comm_wire import plan_agreement, plan_of_tree
+
+        plan = plan_of_tree(params, wire.bucket_bytes, wire.max_buckets)
+        out["plan_hash"] = plan_agreement(comm, plan)
+    if (
+        step is not None and params is not None
+        and opt_state is not None and batch is not None
+    ):
+        out["trace_hash"] = step.verify_collective_trace(
+            params, opt_state, batch
+        )
+    if out:
+        emit(
+            "agreements_reestablished", "elastic.reestablish_agreements",
+            world_size=int(comm.size),
+            **{k: v[:12] for k, v in out.items()},
+        )
+    return out

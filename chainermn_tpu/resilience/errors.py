@@ -66,6 +66,31 @@ class PayloadCorruptionError(ResilienceError):
     recoverable = True
 
 
+class PreemptionError(ResilienceError):
+    """A worker received a preemption/reclaim notice (spot reclaim, slice
+    maintenance — or the injector's ``preempt`` kind simulating one).
+    Recoverable IN THE SAME WORLD: a soft preemption whose capacity comes
+    back resumes from the newest common checkpoint like any transient.
+    When the world actually shrank, the restart instead surfaces
+    :class:`WorldResizeRequiredError` and recovery moves to the elastic
+    path (``resilience.elastic``: re-form the communicator, reshard the
+    checkpoint)."""
+
+    recoverable = True
+
+
+class WorldResizeRequiredError(ResilienceError):
+    """The world that resumes is not the world that saved (the checkpoint
+    manifest names a different world size) and in-place recovery cannot
+    proceed — e.g. ``resume()`` was called without a template to reshard
+    onto.  NOT recoverable in place: the job must re-form the world
+    (``Trainer.run_elastic`` / ``elastic.reform_world``) and route the
+    restore through the checkpoint resharder
+    (``elastic.reshard_state``)."""
+
+    recoverable = False
+
+
 class StepDivergedError(ResilienceError):
     """Non-finite gradients under the ``abort`` policy.  NOT recoverable:
     restarting from the same state would diverge again — this is a

@@ -32,9 +32,21 @@ Fault kinds
   write / short read; surfaces as :class:`PayloadCorruptionError` at the
   unpickling site).
 * ``die``       — ``os._exit(exit_code)`` (simulated process death /
-  preemption; only meaningful in the multi-process harness).
+  hard preemption; only meaningful in the multi-process harness).
+* ``preempt``   — raise :class:`PreemptionError` (a reclaim *notice*:
+  recoverable in the same world via auto-resume; a world that actually
+  shrinks recovers through ``resilience.elastic`` at restart).
 * ``error``     — raise a plain ``RuntimeError`` (an *unclassified*
   failure, for testing that only recognized faults are retried).
+
+Process targeting (elastic rehearsal): ``FaultSpec(process=k)`` fires
+only on the process whose index is ``k`` — one ``die`` spec targeted at
+one worker is a rank death, several specs covering the workers of one
+slice are a slice loss, which is how the mp tier rehearses spot reclaim
+end to end (``spot_reclaim`` in tests/mp_worker.py).  The index comes
+from ``CHAINERMN_TPU_FAULT_PROCESS_INDEX`` (set by the mp harness) or
+``jax.process_index()``.  The filter runs before the probability draw,
+so probabilistic streams are per-process.
 """
 
 from __future__ import annotations
@@ -47,13 +59,29 @@ from typing import Any, Optional, Sequence
 
 import numpy as np
 
-from .errors import TransientCommError
+from .errors import PreemptionError, TransientCommError
 from .log import ResilienceLog, emit
 
-_KINDS = ("delay", "timeout", "truncate", "die", "error")
+_KINDS = ("delay", "timeout", "truncate", "die", "error", "preempt")
 
 ENV_SPEC = "CHAINERMN_TPU_FAULTS"
 ENV_SEED = "CHAINERMN_TPU_FAULT_SEED"
+ENV_PROCESS = "CHAINERMN_TPU_FAULT_PROCESS_INDEX"
+
+
+def _process_index() -> int:
+    """This process's index, for ``FaultSpec(process=...)`` targeting.
+    The env var wins (the mp harness sets it before jax initializes);
+    outside a distributed world everything is process 0."""
+    raw = os.environ.get(ENV_PROCESS)
+    if raw is not None:
+        return int(raw)
+    try:
+        import jax
+
+        return int(jax.process_index())
+    except Exception:
+        return 0
 
 
 class FaultSpec:
@@ -62,13 +90,16 @@ class FaultSpec:
     ``at`` is a collection of 1-based call counts at ``site``;
     ``probability`` additionally fires on a seeded coin flip per call
     (both may be combined; either alone is fine).  ``max_fires`` bounds
-    the total fires of this spec (default unbounded).
+    the total fires of this spec (default unbounded).  ``process``
+    restricts the spec to one process index (rank-death / slice-loss
+    rehearsal — see the module docstring); ``None`` fires everywhere.
     """
 
     def __init__(self, site: str, kind: str, *, at: Sequence[int] = (),
                  probability: float = 0.0, delay: float = 0.05,
                  truncate_to: int = 8, exit_code: int = 43,
-                 max_fires: Optional[int] = None):
+                 max_fires: Optional[int] = None,
+                 process: Optional[int] = None):
         if kind not in _KINDS:
             raise ValueError(f"unknown fault kind {kind!r}; one of {_KINDS}")
         if not 0.0 <= probability <= 1.0:
@@ -81,6 +112,7 @@ class FaultSpec:
         self.truncate_to = int(truncate_to)
         self.exit_code = int(exit_code)
         self.max_fires = max_fires
+        self.process = None if process is None else int(process)
         self.fires = 0
 
     def should_fire(self, count: int, rng: np.random.RandomState) -> bool:
@@ -95,8 +127,9 @@ class FaultSpec:
         return False
 
     def __repr__(self):
+        proc = "" if self.process is None else f" process={self.process}"
         return (f"<FaultSpec {self.kind}@{self.site} at={sorted(self.at)} "
-                f"p={self.probability}>")
+                f"p={self.probability}{proc}>")
 
 
 class FaultInjector:
@@ -122,7 +155,11 @@ class FaultInjector:
         self._counts[site] += 1
         count = self._counts[site]
         for spec in self.specs:
-            if spec.site != site or not spec.should_fire(count, self._rng):
+            if spec.site != site:
+                continue
+            if spec.process is not None and spec.process != _process_index():
+                continue  # targeted at another process (before the draw)
+            if not spec.should_fire(count, self._rng):
                 continue
             spec.fires += 1
             self.log.record("fault_injected", site, fault=spec.kind,
@@ -146,6 +183,11 @@ class FaultInjector:
                 sys.stdout.flush()
                 sys.stderr.flush()
                 os._exit(spec.exit_code)
+            elif spec.kind == "preempt":
+                raise PreemptionError(
+                    f"injected preemption notice at {site} (call {count})",
+                    site=site, peer=peer,
+                )
             elif spec.kind == "error":
                 raise RuntimeError(
                     f"injected error at {site} (call {count})"

@@ -284,6 +284,49 @@ class Trainer:
         finally:
             _rlog.detach(self.resilience_log)
 
+    # -- elastic restart mode (resilience.elastic) ---------------------
+    @classmethod
+    def run_elastic(cls, build, *, communicator_name: str = "tpu",
+                    devices=None, max_restarts: int = 0,
+                    comm_kwargs: Optional[Dict[str, Any]] = None
+                    ) -> "Trainer":
+        """Elastic restart: re-form the world from the surviving ranks,
+        rebuild the trainer in it, resume THROUGH the checkpoint
+        resharder, and run.
+
+        ``build(comm) -> Trainer`` constructs the new world's trainer
+        (model, optimizer, compiled step, iterators, extensions —
+        including a checkpointer pointed at the shared snapshot root).
+        The newest common checkpoint is restored via
+        ``restore_trainer``: a world-size mismatch in its manifest
+        routes the state through ``resilience.elastic.reshard_state``
+        (ZeRO blocks re-partitioned bit-identically, per-rank residuals
+        dropped, iterator cursors rescaled).  The agreement stack
+        re-arms by construction — the fresh optimizer's ``init``
+        re-exchanges the wire ``plan_hash`` and the fresh compiled
+        step's first multi-process dispatch re-runs ``trace_agreement``
+        for the NEW program (both are keyed per program variant; see
+        ``elastic.reestablish_agreements`` to force them explicitly).
+        Returns the trainer after ``run(max_restarts=...)``.
+        """
+        from ..resilience import elastic as _elastic
+
+        comm = _elastic.reform_world(
+            communicator_name, devices=devices, **(comm_kwargs or {})
+        )
+        trainer = build(comm)
+        ckpt = trainer._find_checkpointer()
+        restored = (
+            ckpt.restore_trainer(trainer) if ckpt is not None else None
+        )
+        trainer.resilience_log.record(
+            "elastic_restart", "trainer.run_elastic",
+            restored_step=restored, world=comm.size,
+            resized=getattr(ckpt, "last_resize", None),
+        )
+        trainer.run(max_restarts=max_restarts)
+        return trainer
+
     # -- state (for checkpointing) -------------------------------------
     def state_dict(self) -> Dict[str, Any]:
         return {

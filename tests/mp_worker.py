@@ -33,6 +33,10 @@ def main():
         sys.argv[5],
     )
 
+    # process-targeted fault specs (FaultSpec(process=...)) resolve the
+    # index from this env var — set before any injector can fire
+    os.environ.setdefault("CHAINERMN_TPU_FAULT_PROCESS_INDEX", str(pid))
+
     import jax
 
     jax.config.update("jax_platforms", "cpu")
@@ -443,7 +447,19 @@ def scenario_kill_mid_checkpoint_phase1(pid, nproc, scratch):
     # graceful exit would hang in jax.distributed shutdown waiting for
     # the dead coordinator client — exactly like a real preemption,
     # where survivors are reaped too and recovery happens at RESTART,
-    # which is phase 2).
+    # which is phase 2).  It waits for rank 1's step-3 snapshot to LAND
+    # first: rank 0 hosts the coordination service, and exiting while
+    # rank 1 is still mid-write would kill rank 1 with the leader — a
+    # harness race, not the preemption under test.
+    import glob as _glob
+
+    deadline = time.monotonic() + 60
+    pattern = os.path.join(scratch, "local_1", "kill", "**",
+                           "step_000000000003")
+    while time.monotonic() < deadline:
+        if _glob.glob(pattern, recursive=True):
+            break
+        time.sleep(0.05)
     print("RESULT " + json.dumps(
         {"w2": float(np.asarray(params["w"])[0])}
     ), flush=True)
@@ -818,6 +834,142 @@ def scenario_mismatched_sharding(pid, nproc, scratch):
         "implicit_collectives agreement did not fire on a world with a "
         "mismatched input sharding"
     )
+
+
+def _spot_reclaim_pieces(comm, scratch, lr=0.1, mom=0.9):
+    """Shared by the spot_reclaim phases: a ZeRO (sgd+momentum) world
+    whose momentum state is BLOCKED over the ranks — the state that must
+    genuinely reshard N→M — plus the shared-FS orbax checkpointer.
+
+    Loss 0.5*||w - batch.mean||^2 with global batch rows {0, 1}: the
+    gradient is elementwise w - 0.5 at EVERY world size that feeds the
+    same global rows, so the single-world trajectory (a numpy simulation
+    of sgd+momentum from w0=0) is the oracle for any resize point."""
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.optimizers import build_train_step
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(lr, momentum=mom), comm, zero_redundancy=True
+    )
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    ckpt = cmn.create_multi_node_checkpointer(
+        "spot", comm, path=os.path.join(scratch, "spot_ckpt")
+    )
+    return opt, step, ckpt
+
+
+def _spot_oracle(n_steps, lr=0.1, mom=0.9, c=0.5, dim=4):
+    """Numpy simulation of the same sgd+momentum math, world-free."""
+    import numpy as np
+
+    w = np.zeros(dim)
+    v = np.zeros(dim)
+    traj = []
+    for _ in range(n_steps):
+        g = w - c
+        v = mom * v + g
+        w = w - lr * v
+        traj.append(w.copy())
+    return traj
+
+
+def scenario_spot_reclaim_phase1(pid, nproc, scratch):
+    """ISSUE 7 satellite, run A (the reclaim): a 2-proc ZeRO world
+    (momentum state blocked (2, k) over the ranks) trains and
+    collectively snapshots steps 1-3 — each save writes the world
+    manifest (world_size=2) beside the orbax dir.  Update 4 then begins
+    and the fault injector preempts worker 1 at the ``trainer.update``
+    site (env-injected ``die`` spec targeted at process 1) BEFORE it
+    dispatches: a spot reclaim mid-step.  Worker 0's slice is gone with
+    it — real preemption reaps the survivors too, and recovery happens
+    at RESTART (phase 2, world size 1)."""
+    import numpy as np
+    import jax.numpy as jnp
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = _comm()
+    opt, step, ckpt = _spot_reclaim_pieces(comm, scratch)
+    p0 = {"w": jnp.zeros((4,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    rows = np.full((1, 4), float(pid), np.float32)  # global rows {0, 1}
+    oracle = _spot_oracle(3)
+    for s in (1, 2, 3):
+        fi.fire("trainer.update")
+        params, opt_state, _m = step(params, opt_state, rows)
+        ckpt.save(s, {
+            "params": params,
+            "opt_state": opt_state,
+            "trainer": {"iteration": s, "iterator": None},
+        })
+        np.testing.assert_allclose(  # sanity: ZeRO matches the oracle
+            np.asarray(params["w"]), oracle[s - 1], rtol=1e-5
+        )
+    # update 4 begins; the injector reclaims worker 1 here (die,
+    # process-targeted) — worker 0 is reaped with the job by design.
+    # Worker 0 (the coordination-service host) lingers briefly so the
+    # reclaim lands before the leader disappears (worker 1's remaining
+    # path after the save barrier is fire -> os._exit, sub-ms).
+    fi.fire("trainer.update")
+    if pid == 0:
+        time.sleep(1.0)
+    print("RESULT " + json.dumps({"steps_saved": 3}), flush=True)
+    os._exit(0)
+
+
+def scenario_spot_reclaim_phase2(pid, nproc, scratch):
+    """Run B (the elastic restart): world size 1 re-forms via
+    ``Trainer.run_elastic``; the elected snapshot's manifest names world
+    2, so ``resume`` routes through the resharder — the momentum blocks
+    re-partition (2, 2) -> (1, 4) bit-identically to a fresh partition
+    of the gathered global state — and training continues steps 4-6.
+    The loss trajectory after resume must land on the single-world
+    oracle (the same sgd+momentum math simulated in numpy over all 6
+    steps with no interruption)."""
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    assert nproc == 1
+    rows = [np.full((4,), 0.0, np.float32),
+            np.full((4,), 1.0, np.float32)]  # the FULL global batch now
+
+    def build(comm):
+        opt, step, ckpt = _spot_reclaim_pieces(comm, scratch)
+        p0 = {"w": jnp.zeros((4,))}
+        params, opt_state = step.place(p0, opt.init(p0))
+        it = SerialIterator(rows, 2, shuffle=False)
+        trainer = Trainer(Updater(it, step, params, opt_state),
+                          stop_trigger=(6, "iteration"))
+        trainer.extend(ckpt, trigger=(1, "iteration"))
+        return trainer
+
+    with warnings.catch_warnings():
+        # the resharder warns (by design) about the reset trainer
+        # template slots the manual phase-1 saves did not carry
+        warnings.simplefilter("ignore")
+        trainer = Trainer.run_elastic(build, communicator_name="tpu")
+
+    ev = trainer.resilience_log.events("elastic_restart")
+    assert ev and ev[0].info["restored_step"] == 3, ev
+    resized = ev[0].info["resized"]
+    assert tuple(resized) == (2, 1), resized
+    assert trainer.iteration == 6, trainer.iteration
+    oracle = _spot_oracle(6)
+    got = np.asarray(trainer.updater.params["w"])
+    ok = bool(np.allclose(got, oracle[5], rtol=1e-5))
+    assert ok, (got, oracle[5])
+    return {"resumed_step": ev[0].info["restored_step"],
+            "resized": list(resized),
+            "oracle_match": ok,
+            "final_w": float(got[0])}
 
 
 def scenario_except_hook(pid, nproc, scratch):

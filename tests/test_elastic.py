@@ -1,0 +1,715 @@
+"""Elastic worlds (ISSUE 7): preemption-tolerant N→M restart with
+checkpoint resharding.
+
+Tier-1 coverage of the three layers on the 8-device virtual CPU mesh:
+world manifests + integrity digests on the snapshot inventory, the
+template-driven N→M resharder (ZeRO block re-partition bit-identical to
+a fresh partition of the gathered global state, per-rank residual
+dropping, iterator cursor remapping), and world re-formation with the
+agreement stack re-established.  The end-to-end spot-reclaim rehearsal
+across real processes lives in tests/test_multiprocess.py
+(``spot_reclaim``).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu.optimizers import (
+    MultiNodeOptimizerState,
+    _to_blocks,
+    build_train_step,
+)
+from chainermn_tpu.resilience import (
+    FaultSpec,
+    PreemptionError,
+    WorldResizeRequiredError,
+    elastic,
+    inject_faults,
+)
+
+from conftest import cpu_devices
+
+
+def _loss_fn(params, batch):
+    return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+
+def _world(n, **kw):
+    return cmn.create_communicator("tpu", devices=cpu_devices(8)[:n], **kw)
+
+
+def _rows(n_world, dim=6):
+    return np.stack([
+        np.full((dim,), float(i), np.float32) for i in range(n_world)
+    ])
+
+
+def _zero_world(n, tx=None, dim=6, steps=2, wire="auto"):
+    """A trained ZeRO world: (comm, opt, step, params, opt_state)."""
+    comm = _world(n)
+    opt = cmn.create_multi_node_optimizer(
+        tx or optax.adam(1e-2), comm, zero_redundancy=True, wire=wire
+    )
+    step = build_train_step(comm, _loss_fn, opt, donate=False)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    rows = _rows(n, dim)
+    for _ in range(steps):
+        params, opt_state, _m = step(params, opt_state, rows)
+    return comm, opt, step, params, opt_state
+
+
+# ----------------------------------------------------------------------
+# world manifests
+# ----------------------------------------------------------------------
+class TestWorldManifest:
+    def test_npz_save_writes_manifest_with_digests(self, tmp_path):
+        comm = _world(2)
+        ckpt = cmn.create_multi_node_checkpointer(
+            "m", comm, path=str(tmp_path), use_orbax=False
+        )
+        ckpt.save(1, {"w": np.arange(4.0)})
+        m = elastic.read_world_manifest(ckpt._step_dir(1))
+        assert m["world_size"] == 2
+        assert m["process_count"] == 1
+        assert m["mesh_axes"] == {"mn": 2}
+        assert "state.npz" in m["files"]
+        assert "treedef.pkl" in m["files"]
+        for info in m["files"].values():
+            assert info["bytes"] > 0 and len(info["sha256"]) == 64
+
+    def test_orbax_save_writes_sibling_manifest_and_gc_removes_it(
+        self, tmp_path
+    ):
+        pytest.importorskip("orbax.checkpoint")
+        comm = _world(2)
+        ckpt = cmn.create_multi_node_checkpointer(
+            "m", comm, path=str(tmp_path), keep=2
+        )
+        for s in (1, 2):
+            ckpt.save(s, {"w": comm.bcast_data(jnp.arange(4.0))})
+        sib = elastic.manifest_sibling(ckpt._step_dir(1))
+        assert os.path.exists(sib)
+        assert elastic.read_world_manifest(
+            ckpt._step_dir(2)
+        )["world_size"] == 2
+        ckpt.save(3, {"w": comm.bcast_data(jnp.arange(4.0))})  # gc step 1
+        assert not os.path.exists(ckpt._step_dir(1))
+        assert not os.path.exists(sib)
+
+    def test_world_descriptor_names_the_axis_factorization(self):
+        comm = cmn.create_communicator(
+            "hierarchical", devices=cpu_devices(8)[:4]
+        )
+        d = comm.world_descriptor()
+        assert d["world_size"] == 4
+        assert set(d["mesh_axes"]) == {"mn_inter", "mn_intra"}
+
+
+# ----------------------------------------------------------------------
+# integrity digests on the inventory (satellite 1)
+# ----------------------------------------------------------------------
+class TestIntegrityDigests:
+    def _ckpt(self, tmp_path, n=2):
+        return cmn.create_multi_node_checkpointer(
+            "dig", _world(n), path=str(tmp_path), use_orbax=False
+        )
+
+    def test_truncated_npz_degrades_to_previous_step(self, tmp_path):
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(1, {"w": np.arange(64.0)})
+        ckpt.save(2, {"w": np.arange(64.0) + 2})
+        npz = os.path.join(ckpt._step_dir(2), "state.npz")
+        with open(npz, "rb") as f:
+            data = f.read()
+        with open(npz, "wb") as f:
+            f.write(data[: len(data) // 2])  # torn write
+        assert ckpt._available_steps() == [1]
+        assert ckpt.newest_common_step() == 1
+        step, state = ckpt.resume()
+        assert step == 1
+        np.testing.assert_array_equal(state["w"], np.arange(64.0))
+
+    def test_flipped_byte_is_excluded(self, tmp_path):
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(1, {"w": np.arange(64.0)})
+        npz = os.path.join(ckpt._step_dir(1), "state.npz")
+        data = bytearray(open(npz, "rb").read())
+        data[len(data) // 2] ^= 0xFF  # same size, corrupt content
+        open(npz, "wb").write(bytes(data))
+        assert ckpt._available_steps() == []
+        assert ckpt.newest_common_step() is None
+
+    def test_missing_file_is_excluded(self, tmp_path):
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(1, {"w": np.arange(4.0)})
+        os.remove(os.path.join(ckpt._step_dir(1), "treedef.pkl"))
+        assert ckpt._available_steps() == []
+
+    def test_torn_manifest_marks_snapshot_corrupt(self, tmp_path):
+        # a PRESENT but unparseable manifest must exclude the snapshot
+        # (degrade to the previous step) — not masquerade as a
+        # pre-elastic snapshot, which would silently disable both the
+        # integrity check and resize detection
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(1, {"w": np.arange(4.0)})
+        ckpt.save(2, {"w": np.arange(4.0) + 2})
+        with open(os.path.join(
+            ckpt._step_dir(2), elastic.MANIFEST_NAME
+        ), "w") as f:
+            f.write('{"world_size": 2, "files": {')  # torn write
+        assert ckpt._available_steps() == [1]
+        assert ckpt.newest_common_step() == 1
+
+    def test_manifestless_snapshot_still_counts(self, tmp_path):
+        # backward compat: pre-elastic snapshots (and the agreement
+        # tests' bare step dirs) verify by presence
+        ckpt = self._ckpt(tmp_path)
+        os.makedirs(ckpt._step_dir(5))
+        assert ckpt._available_steps() == [5]
+
+    def test_verification_is_cached_by_signature(self, tmp_path):
+        ckpt = self._ckpt(tmp_path)
+        ckpt.save(1, {"w": np.arange(4.0)})
+        assert ckpt._available_steps() == [1]
+        target = ckpt._step_dir(1)
+        sig, ok = ckpt._verified[target]
+        assert ok
+        assert ckpt._available_steps() == [1]
+        assert ckpt._verified[target] == (sig, ok)  # memo hit, same entry
+
+
+# ----------------------------------------------------------------------
+# the resharder (tentpole layer 1)
+# ----------------------------------------------------------------------
+class TestReshardBlockedLeaf:
+    @pytest.mark.parametrize("old_n,new_n", [
+        (4, 2), (2, 4), (4, 8), (8, 4), (4, 3), (3, 5),
+    ])
+    def test_bit_identical_to_fresh_partition(self, old_n, new_n):
+        # 10 elements: pads under most block counts, so the zero tail
+        # and the truncate/pad equivalence are genuinely exercised
+        x = jnp.arange(10.0) + 1.0
+        old = np.asarray(_to_blocks(x, old_n))
+        fresh = np.asarray(_to_blocks(x, new_n))
+        out = elastic.reshard_blocked_leaf(old, fresh.shape)
+        np.testing.assert_array_equal(out, fresh)
+
+
+class TestReshardState:
+    def test_zero_state_4_to_2_and_8_bit_identical(self):
+        dim = 10
+        _c4, opt4, _s4, params, opt_state = _zero_world(
+            4, optax.adam(1e-2), dim=dim
+        )
+        saved = jax.device_get(opt_state)
+        glob_mu = np.asarray(
+            saved.inner_state[0].mu["w"]
+        ).reshape(-1)[:dim]
+        glob_nu = np.asarray(
+            saved.inner_state[0].nu["w"]
+        ).reshape(-1)[:dim]
+        p_host = jax.device_get(params)
+        for new_n in (2, 8):  # M | N and N | M
+            comm = _world(new_n)
+            opt = cmn.create_multi_node_optimizer(
+                optax.adam(1e-2), comm, zero_redundancy=True
+            )
+            out = opt.reshard_state(saved, 4, p_host)
+            np.testing.assert_array_equal(
+                np.asarray(out.inner_state[0].mu["w"]),
+                np.asarray(_to_blocks(jnp.asarray(glob_mu), new_n)),
+            )
+            np.testing.assert_array_equal(
+                np.asarray(out.inner_state[0].nu["w"]),
+                np.asarray(_to_blocks(jnp.asarray(glob_nu), new_n)),
+            )
+            # world-size-independent leaves survive verbatim
+            assert int(np.asarray(out.step)) == int(np.asarray(saved.step))
+            assert int(np.asarray(out.inner_state[0].count)) == int(
+                np.asarray(saved.inner_state[0].count)
+            )
+
+    def test_error_feedback_residual_dropped_with_warning(self):
+        # plain (non-ZeRO) optimizer with a lossy wire + EF: the
+        # residual is per-rank compression error — it cannot be
+        # re-partitioned and must drop to fresh zeros, loudly
+        from chainermn_tpu.comm_wire import WireConfig
+
+        comm4 = _world(4)
+        wire = WireConfig(codec="int8", error_feedback=True)
+        opt4 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm4, wire=wire
+        )
+        p = {"w": jnp.zeros((6,))}
+        state4 = opt4.init(p)
+        assert state4.wire_residual  # EF buckets exist
+        dirty = state4._replace(wire_residual=tuple(
+            b + 1.0 for b in state4.wire_residual
+        ))
+        comm2 = _world(2)
+        opt2 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm2, wire=wire
+        )
+        template = opt2.init(p)
+        with pytest.warns(UserWarning, match="residual"):
+            out = elastic.reshard_state(
+                jax.device_get(dirty), template, 4, 2
+            )
+        for b, zb in zip(out.wire_residual, template.wire_residual):
+            np.testing.assert_array_equal(
+                np.asarray(b), np.asarray(zb)
+            )
+        # the empty-residual case stays silent (nothing dropped)
+        clean = jax.device_get(
+            MultiNodeOptimizerState(
+                inner_state=jax.device_get(state4.inner_state),
+                step=jnp.zeros((), jnp.int32),
+                wire_residual=(),
+            )
+        )
+        plain_template = MultiNodeOptimizerState(
+            inner_state=jax.device_get(template.inner_state),
+            step=jnp.zeros((), jnp.int32),
+            wire_residual=(),
+        )
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            elastic.reshard_state(clean, plain_template, 4, 2)
+
+    def test_double_buffering_stale_grads_dropped(self):
+        comm4 = _world(4)
+        opt4 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm4, double_buffering=True
+        )
+        p = {"w": jnp.ones((6,))}
+        state4 = opt4.init(p)
+        dirty = state4._replace(prev_grads=tuple(
+            b + 3.0 for b in state4.prev_grads
+        ))
+        comm2 = _world(2)
+        opt2 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), comm2, double_buffering=True
+        )
+        template = opt2.init(p)
+        with pytest.warns(UserWarning, match="stale gradient"):
+            out = elastic.reshard_state(
+                jax.device_get(dirty), jax.device_get(template), 4, 2
+            )
+        for b, zb in zip(out.prev_grads, template.prev_grads):
+            np.testing.assert_array_equal(np.asarray(b), np.asarray(zb))
+
+    def test_missing_slot_resets_to_template_with_warning(self):
+        old = {"params": np.arange(4.0)}
+        like = {"params": np.arange(4.0), "extra": np.ones((3,))}
+        with pytest.warns(UserWarning, match="missing"):
+            out = elastic.reshard_state(old, like, 4, 2)
+        np.testing.assert_array_equal(out["params"], np.arange(4.0))
+        np.testing.assert_array_equal(out["extra"], np.ones((3,)))
+
+    def test_unreshardale_shape_resets_with_warning(self):
+        # shape changed in a non-block way: reset, never crash
+        old = {"buf": np.arange(5.0)}
+        like = {"buf": np.zeros((7,))}
+        with pytest.warns(UserWarning, match="cannot be re-partitioned"):
+            out = elastic.reshard_state(old, like, 4, 2)
+        np.testing.assert_array_equal(out["buf"], np.zeros((7,)))
+
+    def test_orbax_raw_spelling_adapter(self):
+        # the raw orbax restore loses NamedTuples (field-keyed dicts)
+        # and tuple structure (str(index) keys); the walk must still
+        # pair slots and reshard
+        dim = 10
+        _c4, _o4, _s4, params, opt_state = _zero_world(
+            4, optax.sgd(0.1, momentum=0.9), dim=dim
+        )
+        saved = jax.device_get(opt_state)
+        trace = saved.inner_state[0]
+        raw = {
+            "inner_state": {
+                "0": {"trace": {"w": np.asarray(trace.trace["w"])}},
+                "1": {},
+            },
+            "step": np.asarray(saved.step),
+            "wire_residual": {},
+        }
+        comm2 = _world(2)
+        opt2 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1, momentum=0.9), comm2, zero_redundancy=True
+        )
+        p_host = jax.device_get(params)
+        template = opt2.init(p_host)
+        out = elastic.reshard_state(raw, jax.device_get(template), 4, 2)
+        glob = np.asarray(trace.trace["w"]).reshape(-1)[:dim]
+        np.testing.assert_array_equal(
+            np.asarray(out.inner_state[0].trace["w"]),
+            np.asarray(_to_blocks(jnp.asarray(glob), 2)),
+        )
+        assert int(np.asarray(out.step)) == int(np.asarray(saved.step))
+
+    def test_zero_reshard_state_spec_crosscheck(self):
+        # the method's layout cross-check: resharded state must declare
+        # the SAME partitioning as a fresh init of the new world
+        _c4, _o4, _s4, params, opt_state = _zero_world(4, optax.adam(1e-2))
+        comm2 = _world(2)
+        opt2 = cmn.create_multi_node_optimizer(
+            optax.adam(1e-2), comm2, zero_redundancy=True
+        )
+        p_host = jax.device_get(params)
+        out = opt2.reshard_state(jax.device_get(opt_state), 4, p_host)
+        assert opt2.state_partition_spec(out) == opt2.state_partition_spec(
+            opt2.init(p_host)
+        )
+
+
+class TestIteratorCursor:
+    def test_pos_rescales_both_directions(self):
+        st = {"epoch": 3, "pos": 6, "order": np.arange(12)}
+        down = elastic.reshard_iterator_state(st, 2, 1)
+        assert down["pos"] == 12 and down["order"] is None
+        assert down["epoch"] == 3
+        up = elastic.reshard_iterator_state(st, 2, 4)
+        assert up["pos"] == 3
+
+    def test_restore_with_cleared_order_redraws_from_rng(self):
+        from chainermn_tpu.iterators import SerialIterator
+
+        it = SerialIterator(list(range(12)), 4, shuffle=True, seed=7)
+        it.next()
+        state = it.serialize()
+        resharded = elastic.reshard_iterator_state(state, 2, 2)
+        a = SerialIterator(list(range(12)), 4, shuffle=True, seed=0)
+        b = SerialIterator(list(range(12)), 4, shuffle=True, seed=1)
+        a.restore(dict(resharded))
+        b.restore(dict(resharded))
+        # both worlds redraw the SAME permutation from the restored
+        # stream — deterministic reshuffle, regardless of local seeds
+        np.testing.assert_array_equal(a._order, b._order)
+        assert a._pos == state["pos"]
+
+
+# ----------------------------------------------------------------------
+# resume routing through the resharder (tentpole layer 1+2 E2E)
+# ----------------------------------------------------------------------
+class TestElasticResume:
+    def _trainer(self, comm, rows, stop, tmp_path, lr=0.1, mom=0.9):
+        from chainermn_tpu.iterators import SerialIterator
+        from chainermn_tpu.training.trainer import Trainer, Updater
+
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(lr, momentum=mom), comm, zero_redundancy=True
+        )
+        step = build_train_step(comm, _loss_fn, opt, donate=False)
+        p0 = {"w": jnp.zeros((rows.shape[1],))}
+        params, opt_state = step.place(p0, opt.init(p0))
+        it = SerialIterator(
+            [rows[i] for i in range(rows.shape[0])], rows.shape[0],
+            shuffle=False,
+        )
+        trainer = Trainer(Updater(it, step, params, opt_state),
+                          stop_trigger=(stop, "iteration"))
+        trainer.extend(
+            cmn.create_multi_node_checkpointer(
+                "el", comm, path=str(tmp_path), use_orbax=False
+            ),
+            trigger=(1, "iteration"),
+        )
+        return trainer
+
+    def _oracle(self, n_steps, c, dim, lr=0.1, mom=0.9):
+        w, v = np.zeros(dim), np.zeros(dim)
+        traj = []
+        for _ in range(n_steps):
+            g = w - c
+            v = mom * v + g
+            w = w - lr * v
+            traj.append(w.copy())
+        return traj
+
+    def test_restore_trainer_reshards_and_continues_on_oracle(
+        self, tmp_path
+    ):
+        rows = _rows(4)
+        c = float(np.mean(np.arange(4)))
+        t4 = self._trainer(_world(4), rows, 3, tmp_path)
+        t4.run()
+        assert t4.iteration == 3
+        oracle = self._oracle(6, c, rows.shape[1])
+        np.testing.assert_allclose(
+            np.asarray(t4.updater.params["w"]), oracle[2], rtol=1e-5
+        )
+        # the restart: world 2, same snapshot root, same global rows
+        t2 = self._trainer(_world(2), rows, 6, tmp_path)
+        ckpt2 = t2.get_extension("checkpointer")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            restored = ckpt2.restore_trainer(t2)
+        assert restored == 3
+        assert ckpt2.last_resize == (4, 2)
+        assert t2.iteration == 3
+        # momentum came through the resharder as (2, k) blocks
+        tr = t2.updater.opt_state.inner_state[0].trace["w"]
+        assert tuple(tr.shape)[0] == 2
+        t2.run()
+        assert t2.iteration == 6
+        np.testing.assert_allclose(
+            np.asarray(t2.updater.params["w"]), oracle[5], rtol=1e-5
+        )
+
+    def test_unchanged_process_count_keeps_iterator_cursor(
+        self, tmp_path
+    ):
+        # chips-per-process resize (here: single controller 4 -> 2
+        # devices): the per-process shard width is unchanged, so the
+        # saved cursor AND the in-flight permutation stay exactly valid
+        # — clearing them would repeat/skip samples mid-epoch
+        rows = _rows(4)
+        t4 = self._trainer(_world(4), rows, 2, tmp_path)
+        t4.run()
+        saved_it = t4.updater.iterator.serialize()
+        t2 = self._trainer(_world(2), rows, 4, tmp_path)
+        ckpt2 = t2.get_extension("checkpointer")
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            assert ckpt2.restore_trainer(t2) == 2
+        assert ckpt2.last_resize == (4, 2)
+        it2 = t2.updater.iterator
+        assert it2._pos == saved_it["pos"]
+        np.testing.assert_array_equal(it2._order, saved_it["order"])
+
+    def test_resume_without_template_raises_world_resize_required(
+        self, tmp_path
+    ):
+        comm4 = _world(4)
+        ckpt4 = cmn.create_multi_node_checkpointer(
+            "el", comm4, path=str(tmp_path), use_orbax=False
+        )
+        ckpt4.save(1, {"w": np.arange(4.0)})
+        ckpt2 = cmn.create_multi_node_checkpointer(
+            "el", _world(2), path=str(tmp_path), use_orbax=False
+        )
+        with pytest.raises(WorldResizeRequiredError) as ei:
+            ckpt2.resume()
+        assert ei.value.recoverable is False
+        assert "world size 4" in str(ei.value)
+
+    def test_matching_world_never_routes_through_resharder(self, tmp_path):
+        comm = _world(4)
+        ckpt = cmn.create_multi_node_checkpointer(
+            "el", comm, path=str(tmp_path), use_orbax=False
+        )
+        ckpt.save(1, {"w": np.arange(4.0)})
+        step, state = ckpt.resume()
+        assert step == 1 and ckpt.last_resize is None
+        np.testing.assert_array_equal(state["w"], np.arange(4.0))
+
+    def test_orbax_world_mismatch_resume(self, tmp_path):
+        # pins the raw-host orbax loader + dict-spelling adapter the mp
+        # spot_reclaim scenario rides, in tier-1
+        pytest.importorskip("orbax.checkpoint")
+        _c4, opt4, _s4, params, opt_state = _zero_world(
+            4, optax.sgd(0.1, momentum=0.9), dim=10
+        )
+        ckpt4 = cmn.create_multi_node_checkpointer(
+            "ox", _c4, path=str(tmp_path)
+        )
+        ckpt4.save(2, {"params": params, "opt_state": opt_state})
+        comm2 = _world(2)
+        opt2 = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1, momentum=0.9), comm2, zero_redundancy=True
+        )
+        ckpt2 = cmn.create_multi_node_checkpointer(
+            "ox", comm2, path=str(tmp_path)
+        )
+        p_host = jax.device_get(params)
+        like = {"params": p_host, "opt_state": opt2.init(p_host)}
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            step, state = ckpt2.resume(like=like)
+        assert step == 2 and ckpt2.last_resize == (4, 2)
+        np.testing.assert_allclose(
+            np.asarray(state["params"]["w"]),
+            np.asarray(params["w"]), rtol=0,
+        )
+        glob = np.asarray(
+            jax.device_get(opt_state).inner_state[0].trace["w"]
+        ).reshape(-1)[:10]
+        np.testing.assert_array_equal(
+            np.asarray(state["opt_state"].inner_state[0].trace["w"]),
+            np.asarray(_to_blocks(jnp.asarray(glob), 2)),
+        )
+
+
+# ----------------------------------------------------------------------
+# world re-formation + agreement re-establishment (tentpole layer 2)
+# ----------------------------------------------------------------------
+class TestWorldReformation:
+    def test_run_elastic_restores_and_runs(self, tmp_path):
+        rows = _rows(4)
+        c = float(np.mean(np.arange(4)))
+        helper = TestElasticResume()
+        t4 = helper._trainer(_world(4), rows, 3, tmp_path)
+        t4.run()
+
+        def build(comm):
+            return helper._trainer(comm, rows, 6, tmp_path)
+
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            t2 = cmn.training.trainer.Trainer.run_elastic(
+                build, communicator_name="tpu",
+                devices=cpu_devices(8)[:2],
+            )
+        assert t2.iteration == 6
+        ev = t2.resilience_log.events("elastic_restart")
+        assert ev[0].info["restored_step"] == 3
+        assert ev[0].info["resized"] == (4, 2)
+        oracle = helper._oracle(6, c, rows.shape[1])
+        np.testing.assert_allclose(
+            np.asarray(t2.updater.params["w"]), oracle[5], rtol=1e-5
+        )
+
+    def test_reform_world_rederives_hierarchical_axes(self):
+        log = cmn.resilience.ResilienceLog()
+        cmn.resilience.attach(log)
+        try:
+            comm = elastic.reform_world(
+                "hierarchical", devices=cpu_devices(8)[:2],
+                previous={"world_size": 4},
+            )
+        finally:
+            cmn.resilience.detach(log)
+        assert comm.size == 2
+        assert set(comm.mesh.axis_names) == {"mn_inter", "mn_intra"}
+        ev = log.events("world_reformed")
+        assert ev and ev[0].info["previous_world_size"] == 4
+        assert ev[0].info["world_size"] == 2
+
+    def test_reestablish_agreements_reagrees_plan_and_trace(self):
+        def agreements(n):
+            comm = _world(n)
+            opt = cmn.create_multi_node_optimizer(
+                optax.sgd(0.1), comm, zero_redundancy=True, wire="bf16"
+            )
+            step = build_train_step(comm, _loss_fn, opt, donate=False)
+            p0 = {"w": jnp.zeros((6,))}
+            params, opt_state = step.place(p0, opt.init(p0))
+            # the GLOBAL batch is what survives a resize
+            return elastic.reestablish_agreements(
+                comm, params=params, optimizer=opt, step=step,
+                opt_state=opt_state, batch=_rows(8),
+            )
+
+        a4 = agreements(4)
+        a2 = agreements(2)
+        from chainermn_tpu.comm_wire import plan_of_tree
+
+        # the plan hash is a pure function of gradient shapes — same
+        # token, but RE-AGREED by the new process set
+        wire_plan = plan_of_tree({"w": jnp.zeros((6,))})
+        assert a4["plan_hash"] == wire_plan.plan_hash()
+        assert a2["plan_hash"] == wire_plan.plan_hash()
+        # ZeRO's blocked collectives carry world-dependent per-shard
+        # shapes (k = ceil(size/n)): the resized world traces a
+        # DIFFERENT program and its hash is re-agreed, never assumed
+        assert a4["trace_hash"] != a2["trace_hash"]
+
+
+# ----------------------------------------------------------------------
+# failure detection (tentpole layer 3)
+# ----------------------------------------------------------------------
+class TestFailureDetection:
+    def test_taxonomy_flags(self):
+        assert PreemptionError("x").recoverable is True
+        assert WorldResizeRequiredError("x").recoverable is False
+        line = PreemptionError("x", site="trainer.update").describe()
+        assert "kind=PreemptionError" in line and "recoverable=True" in line
+
+    def test_injected_preemption_raises_preemption_error(self):
+        with inject_faults([
+            FaultSpec("trainer.update", "preempt", at=[1])
+        ]):
+            from chainermn_tpu.resilience import fault_injection as fi
+
+            with pytest.raises(PreemptionError) as ei:
+                fi.fire("trainer.update")
+        assert ei.value.recoverable is True
+
+    def test_trainer_auto_resumes_injected_preemption(self, tmp_path):
+        rows = _rows(2)
+        helper = TestElasticResume()
+        trainer = helper._trainer(_world(2), rows, 4, tmp_path)
+        with inject_faults([
+            FaultSpec("trainer.update", "preempt", at=[3])
+        ]):
+            trainer.run(max_restarts=1)
+        assert trainer.iteration == 4
+        assert trainer.restarts == 1
+        restarts = trainer.resilience_log.events("restart")
+        assert restarts and "PreemptionError" in restarts[0].info["error"]
+
+    def test_process_targeted_spec_fires_only_on_its_process(
+        self, monkeypatch
+    ):
+        from chainermn_tpu.resilience import fault_injection as fi
+
+        monkeypatch.setenv(fi.ENV_PROCESS, "0")
+        with inject_faults([
+            FaultSpec("trainer.update", "preempt", at=[1], process=1)
+        ]):
+            fi.fire("trainer.update")  # targeted elsewhere: no fire
+        monkeypatch.setenv(fi.ENV_PROCESS, "1")
+        with inject_faults([
+            FaultSpec("trainer.update", "preempt", at=[1], process=1)
+        ]):
+            with pytest.raises(PreemptionError):
+                fi.fire("trainer.update")
+
+    def test_checkpoint_save_is_an_injector_site(self, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "site", _world(2), path=str(tmp_path), use_orbax=False
+        )
+        with inject_faults([
+            FaultSpec("checkpoint.save", "preempt", at=[1])
+        ]) as inj:
+            with pytest.raises(PreemptionError):
+                ckpt.save(1, {"w": np.arange(2.0)})
+        assert inj.log.counts["fault_injected"] == 1
+
+
+# ----------------------------------------------------------------------
+# inventory exchange rides the lockstep retry (satellite 2)
+# ----------------------------------------------------------------------
+class TestInventoryLockstepRetry:
+    def test_torn_inventory_payload_is_retried(self, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "inv", _world(2), path=str(tmp_path), use_orbax=False
+        )
+        ckpt.save(3, {"w": np.arange(2.0)})
+        # the FIRST obj-store exchange ships a truncated payload ->
+        # PayloadCorruptionError -> the same lockstep retry as
+        # plan_agreement re-exchanges -> the agreement completes
+        with inject_faults([
+            FaultSpec("obj_store.exchange", "truncate", at=[1],
+                      truncate_to=4)
+        ]) as inj:
+            assert ckpt.newest_common_step() == 3
+        assert inj.log.counts["fault_injected"] >= 1
+
+    def test_transient_timeout_is_retried(self, tmp_path):
+        ckpt = cmn.create_multi_node_checkpointer(
+            "inv", _world(2), path=str(tmp_path), use_orbax=False
+        )
+        ckpt.save(4, {"w": np.arange(2.0)})
+        with inject_faults([
+            FaultSpec("obj_store.exchange", "timeout", at=[1])
+        ]):
+            assert ckpt.newest_common_step() == 4

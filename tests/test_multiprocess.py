@@ -290,6 +290,41 @@ class TestMismatchedSharding:
         assert all(p["cited_dot"] for p in payloads)
 
 
+class TestSpotReclaim:
+    def test_reclaim_reshard_restart_world_2_to_1(self, tmp_path):
+        """ISSUE 7 acceptance: a 2-proc ZeRO run saves steps 1-3 (each
+        snapshot carrying its world manifest), worker 1 is reclaimed
+        mid-step by a process-targeted ``die`` at the injector's
+        ``trainer.update`` site, and the restart at world size 1 routes
+        the restore through the checkpoint resharder and continues on
+        the single-world oracle trajectory."""
+        faults = json.dumps([
+            {"site": "trainer.update", "kind": "die", "at": [4],
+             "process": 1, "exit_code": 43},
+        ])
+        res = run_world(
+            "spot_reclaim_phase1", n_procs=2, tmpdir=tmp_path,
+            timeout=420, extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        rc0, out0 = res[0]
+        rc1, out1 = res[1]
+        assert rc0 == 0 and "RESULT" in out0, (
+            f"worker 0 should be reaped cleanly after the save\n"
+            f"{out0[-3000:]}"
+        )
+        assert rc1 == 43, (
+            f"worker 1 should be reclaimed (exit 43) at update 4\n"
+            f"{out1[-3000:]}"
+        )
+        # run B: the world re-forms at size 1, reshards, and continues
+        res = run_world("spot_reclaim_phase2", n_procs=1,
+                        tmpdir=tmp_path, timeout=420)
+        payloads = _assert_ok(res, "spot_reclaim_phase2")
+        assert payloads[0]["resumed_step"] == 3
+        assert payloads[0]["resized"] == [2, 1]
+        assert payloads[0]["oracle_match"] is True
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

@@ -34,6 +34,16 @@ bandwidth to hide anything):
     mesh_resnet_{sync,dummy,db_on,db_off}
                                     ResNet-18 32^2 b128-global (conv mix)
 
+overlap_* rungs (ISSUE 8): the bucket-granularity overlap A/B —
+``overlap_off``/``overlap_on`` (MLP), ``overlap_resnet_off/on``
+(ResNet-18 conv mix), ``overlap_int8_on`` (compressed wire under the
+schedule).  Both legs run the bit-identical program; only the issue
+order of the bucket psums moves, so the ratio isolates pure
+scheduling.  On the CPU mesh the collectives share the host's cores
+with compute, so the A/B here bounds machinery cost — the ICI win
+needs the TPU capture.  The ``wire_db_on`` rung retired with the
+double-buffering decision rule (docs/performance.md).
+
 Usage:
     python benchmarks/comm_overlap_bench.py                  # real chip
     python benchmarks/comm_overlap_bench.py --cpu-mesh       # 8 virt dev
@@ -97,11 +107,14 @@ def _emit(name, dt, dts, batch, **extra):
 
 def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
               double_buffering=False, comm_name="tpu", wire="auto",
-              **extra):
+              overlap="none", **extra):
     """Multi-node tier: build_train_step over the communicator's mesh —
     grad psum + update in one program (k of them in one fori_loop).
     ``wire`` selects the gradient wire (per_leaf / auto-bucketed /
-    codec name / WireConfig) — the wire_* rung axis."""
+    codec name / WireConfig) — the wire_* rung axis.  ``overlap``
+    selects the bucket-granularity overlap engine — the overlap_*
+    rung axis (bit-identical program, reordered so each bucket's psum
+    issues under the remaining backward)."""
     import chainermn_tpu as cmn
 
     comm = cmn.create_communicator(comm_name)
@@ -109,7 +122,8 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
     x, y, init_arg = batch_fn(comm)
     params = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
     opt = cmn.create_multi_node_optimizer(
-        tx, comm, double_buffering=double_buffering, wire=wire
+        tx, comm, double_buffering=double_buffering, wire=wire,
+        overlap=overlap,
     )
     step = cmn.build_train_step(
         comm, lambda p, b: loss_of(model, p, b), opt, donate=False
@@ -129,6 +143,7 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
         return lax.fori_loop(0, n, body, (p, o, jnp.float32(0)))
 
     extra = dict(extra)
+    extra.setdefault("overlap", getattr(opt, "overlap", "none"))
     if getattr(opt, "wire", None) is not None:
         from chainermn_tpu import comm_wire as _cw
 
@@ -361,13 +376,29 @@ def _variants():
         "wire_bucketed_dummy": dict(wire="auto", comm_name="dummy"),
         "wire_int8_sync": dict(wire=int8_ef),
         "wire_int8_dummy": dict(wire=int8_ef, comm_name="dummy"),
-        # the db-off leg IS wire_bucketed_sync (identical config) — no
-        # separate rung, or the sweep times the same program twice
-        "wire_db_on": dict(wire="auto", double_buffering=True),
+        # overlap_* rungs (ISSUE 8): the bucket-granularity overlap
+        # A/B.  overlap_off IS wire_bucketed_sync's program (identical
+        # config) but keeps its own rung name so the off/on pair reads
+        # as one A/B and survives rung-list edits together.
+        "overlap_off": dict(wire="auto", overlap="none"),
+        "overlap_on": dict(wire="auto", overlap="bucket"),
+        "overlap_int8_on": dict(wire=int8_ef, overlap="bucket"),
     }.items():
         variants[rung] = (
             lambda rung=rung, kw=kw: _run_sync(
                 rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw
+            )
+        )
+    # the conv-mix overlap A/B (ResNet-18 on the virtual mesh): multi-
+    # bucket plan over a real backward chain — the shape the decision
+    # rule (docs/performance.md) judges alongside bench.py's VGG pair
+    for rung, kw in {
+        "overlap_resnet_off": dict(wire="auto", overlap="none"),
+        "overlap_resnet_on": dict(wire="auto", overlap="bucket"),
+    }.items():
+        variants[rung] = (
+            lambda rung=rung, kw=kw: _run_sync(
+                rung, r18_ctor, r18_batch, _image_loss, r18_tx, **kw
             )
         )
     return variants
@@ -381,7 +412,8 @@ def main():
          "mesh_resnet_db_on",
          "wire_perleaf_sync", "wire_perleaf_dummy", "wire_bucketed_sync",
          "wire_bucketed_dummy", "wire_int8_sync", "wire_int8_dummy",
-         "wire_db_on"]
+         "overlap_off", "overlap_on", "overlap_int8_on",
+         "overlap_resnet_off", "overlap_resnet_on"]
         if CPU_MESH else
         ["resnet_sync", "resnet_dummy", "resnet_bare", "lm_sync",
          "lm_dummy", "lm_bare"]

@@ -10,8 +10,10 @@ inference comm studies).  This package makes both first-class:
   :class:`CollectiveTrace`;
 * :mod:`.checks` — the check catalog: cross-process divergence guard
   (:func:`trace_agreement`), deadlock lint on data-dependent ``cond``
-  branches, mesh-axis audit, narrowing-cast wire audit, and budget
-  enforcement;
+  branches, mesh-axis audit, narrowing-cast wire audit, budget
+  enforcement, and the ordering-aware overlap check
+  (:func:`check_overlap` — every wire bucket psum issued at its
+  dependency frontier, the ``comm_wire.overlap`` contract);
 * :mod:`.hlo` — the lowered-text census the trace cross-checks against,
   plus per-op extraction with XLA metadata (the attribution citations);
 * :mod:`.shardflow` — the sharding-flow pass: propagate PartitionSpecs
@@ -60,6 +62,7 @@ from .checks import (  # noqa: F401
     check_axes,
     check_deadlocks,
     check_implicit_collectives,
+    check_overlap,
     check_wire,
     implicit_agreement,
     run_all,

@@ -447,6 +447,38 @@ def trace_agreement(comm, trace: CollectiveTrace, *,
     return mine
 
 
+# ----------------------------------------------------------------------
+# ordering-aware overlap check (ISSUE 8)
+# ----------------------------------------------------------------------
+def check_overlap(jaxpr_like, plan) -> list:
+    """Ordering-aware check for the bucket-overlap program shape: every
+    wire bucket psum must be *issued* at its dependency frontier —
+    dispatched the moment its bucket's leaves are produced, before the
+    remaining backward segments complete — rather than queued at the
+    program tail the way the synchronous wire lowers.
+
+    Unlike the census pins (which are ordering-blind by design — the
+    overlap engine's contract is that the census does NOT move), this
+    check reads equation *positions*, so it takes a jaxpr (e.g.
+    ``step.get_jitted(p, o).scheduled_jaxpr(p, o, batch)``) and the
+    wire's :class:`~chainermn_tpu.comm_wire.BucketPlan`, and returns
+    :class:`Finding`\\ s — one ``error`` per late-issued bucket psum
+    (``delay`` = foreign equations between operand readiness and
+    dispatch), plus an ``error`` when the program carries fewer bucket
+    psums than the plan has buckets.  A multi-bucket synchronous step
+    always fails; an overlap-scheduled one returns ``[]``.
+    """
+    from ..comm_wire.overlap import order_violations
+
+    # ONE source of truth: comm_wire.overlap.order_violations computes
+    # the contract; this spelling only wraps each violation as a
+    # Finding (the assert-style spelling is assert_overlap_order).
+    return [
+        Finding(check="overlap", severity="error", message=msg)
+        for msg in order_violations(jaxpr_like, plan)
+    ]
+
+
 def run_all(trace: CollectiveTrace, *, axis_names=None,
             exempt_paths: Sequence[str] = ("comm_wire",)) -> list:
     """Every local check in one call (the divergence guard needs a

@@ -12,6 +12,12 @@ Two pieces:
   ``f32`` / ``bf16`` / ``f16`` / ``int8`` with per-bucket absmax
   scale) and the optional error-feedback residual that re-injects
   compressed rounding error into the next step.
+* :mod:`.overlap` — the bucket-granularity comm/compute overlap engine
+  (ISSUE 8): a jaxpr scheduling pass that re-emits the compiled step so
+  each bucket's fused psum is dispatched the moment its bucket's leaves
+  are produced, hiding sync under the remaining backward segments.
+  Bit-identical to the synchronous wire (pure reordering); selected via
+  ``create_multi_node_optimizer(..., overlap="bucket")``.
 
 Threaded through ``optimizers._sync_grads`` (compiled tier), the
 double-buffering and ZeRO optimizers, and the eager
@@ -41,6 +47,17 @@ from .codecs import (  # noqa: F401
     resolve_wire,
     storage_dtype,
     zero_residuals,
+)
+from .overlap import (  # noqa: F401
+    OVERLAP_MODES,
+    IssueRecord,
+    OverlappedStep,
+    assert_overlap_order,
+    bucket_issue_report,
+    issue_report,
+    order_violations,
+    resolve_overlap,
+    schedule_jaxpr,
 )
 
 

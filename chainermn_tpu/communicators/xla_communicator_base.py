@@ -386,7 +386,16 @@ class XlaCommunicatorBase(CommunicatorBase):
 
         def run():
             packed = _cw.pack_stacked(plan, leaves, self.size)
-            red = [fn(self._put(cat)) for cat in packed]
+            # pipelined bucket round-trips (ISSUE 8 satellite): stage
+            # EVERY bucket's device placement before dispatching the
+            # first reduction, so bucket k+1's send is in flight while
+            # bucket k reduces (jax dispatch is async — interleaving
+            # put/reduce per bucket serialized the transfers behind
+            # each reduction's dispatch).  Reduction order and
+            # arithmetic are unchanged: bit-identical to the serial
+            # schedule.
+            staged = [self._put(cat) for cat in packed]
+            red = [fn(s) for s in staged]
             out = _cw.unpack_stacked(
                 plan, red, [jnp.shape(l) for l in leaves]
             )

@@ -194,12 +194,13 @@ class _MultiNodeOptimizer:
     """
 
     def __init__(self, actual_optimizer: optax.GradientTransformation,
-                 comm, wire="auto"):
-        from .comm_wire import resolve_wire
+                 comm, wire="auto", overlap="none"):
+        from .comm_wire import resolve_overlap, resolve_wire
 
         self._opt = actual_optimizer
         self._comm = comm
         self._wire = resolve_wire(wire, comm)  # None => per-leaf legacy
+        self._overlap = resolve_overlap(overlap)
 
     @property
     def communicator(self):
@@ -209,6 +210,14 @@ class _MultiNodeOptimizer:
     def wire(self):
         """Resolved ``comm_wire.WireConfig`` (None on the legacy path)."""
         return self._wire
+
+    @property
+    def overlap(self) -> str:
+        """Overlap mode: "none" (synchronous sync at the program tail)
+        or "bucket" (``comm_wire.overlap`` reschedules the compiled
+        step so each bucket's psum issues as soon as its leaves are
+        produced).  ``build_train_step`` reads this."""
+        return self._overlap
 
     @property
     def actual_optimizer(self):
@@ -608,6 +617,7 @@ def create_multi_node_optimizer(
     double_buffering: bool = False,
     zero_redundancy: bool = False,
     wire="auto",
+    overlap="none",
 ) -> _MultiNodeOptimizer:
     """Wrap an optax optimizer for multi-chip training.
 
@@ -634,6 +644,20 @@ def create_multi_node_optimizer(
       carried into the next step (fp32-equivalent convergence, pinned
       by the MLP convergence test).
 
+    ``overlap`` (``"none"``/``"bucket"``): the bucket-granularity
+    comm/compute overlap engine (``comm_wire.overlap``).  With
+    ``"bucket"``, ``build_train_step`` reschedules the compiled step so
+    each wire bucket's fused psum is dispatched the moment its bucket's
+    leaves are produced by backward — communication hides under the
+    remaining backward segments instead of queueing at the program
+    tail.  Bit-identical to ``"none"`` (same buckets, codec, and
+    reduction order — the pass only reorders equations) and the
+    collective census is unchanged, so every analysis budget pin holds
+    either way.  Works with every wire (incl. ``"per_leaf"``) and the
+    ZeRO path; not combinable with ``double_buffering`` (staleness and
+    in-step overlap are competing answers to the same latency — see
+    below).
+
     ``double_buffering`` (stale-by-one gradients, reference parity):
     LEAVE IT OFF unless you have measured a win on your topology.  On a
     single chip and on the virtual mesh the A/B shows no benefit — on
@@ -641,8 +665,19 @@ def create_multi_node_optimizer(
     the virtual-mesh measurement was 16 % SLOWER with it on
     (docs/performance.md "Double-buffering, measured"); its design
     target (DCN-crossing topologies where gradient sync rides a slow
-    link) is the one place it can pay.
+    link) is the one place it can pay.  ``overlap="bucket"`` hides the
+    same sync without applying stale gradients — prefer it.
     """
+    from .comm_wire import resolve_overlap
+
+    if resolve_overlap(overlap) == "bucket" and double_buffering:
+        raise ValueError(
+            "overlap='bucket' cannot be combined with double_buffering: "
+            "double buffering hides sync by applying one-step-stale "
+            "gradients, the overlap engine hides it inside the same "
+            "step with exact gradients — combining would pay staleness "
+            "for nothing"
+        )
     if zero_redundancy and double_buffering:
         raise ValueError(
             "zero_redundancy and double_buffering cannot be combined: "
@@ -655,7 +690,7 @@ def create_multi_node_optimizer(
         cls = _DoubleBufferingOptimizer
     else:
         cls = _MultiNodeOptimizer
-    opt = cls(actual_optimizer, communicator, wire=wire)
+    opt = cls(actual_optimizer, communicator, wire=wire, overlap=overlap)
     cfg = opt.wire  # resolved + validated ONCE, by the constructor
     if cfg is not None and cfg.error_feedback:
         if double_buffering:
@@ -793,8 +828,33 @@ def build_train_step(
     rep = NamedSharding(mesh, P())
     batch_sharding = NamedSharding(mesh, batch_spec)
 
+    from .comm_wire import resolve_overlap as _resolve_overlap
+    from .comm_wire.overlap import OverlappedStep
+
     is_mn = isinstance(optimizer, _MultiNodeOptimizer)
     hybrid = param_specs is not None
+    overlap_mode = _resolve_overlap(getattr(optimizer, "overlap", "none"))
+    if overlap_mode == "bucket" and not use_shard_map:
+        raise ValueError(
+            "overlap='bucket' requires use_shard_map=True: on the GSPMD "
+            "path the gradient collectives are inserted by the "
+            "partitioner after lowering, so there is no authored psum "
+            "for the overlap scheduler to move"
+        )
+
+    def _finish_build(sharded):
+        """jit (or overlap-schedule) one built shard_map step."""
+        if overlap_mode == "bucket":
+            # comm_wire.overlap: trace -> reorder eqns so each bucket
+            # psum issues at its dependency frontier -> jit.  Bit-
+            # identical (pure reordering); donation maps to the flat
+            # params/opt_state leaves.
+            return OverlappedStep(
+                sharded,
+                donate_subtrees=2 if donate else 0,
+                label="train_step",
+            )
+        return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
     if hybrid and isinstance(optimizer, _ZeroRedundancyOptimizer):
         raise ValueError(
             "param_specs (hybrid DP x TP) cannot be combined with a "
@@ -1051,7 +1111,7 @@ def build_train_step(
                 # vma checking ON: it is what makes the autodiff insert
                 # the replication-correct psums
             )
-            return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+            return _finish_build(sharded)
     elif use_shard_map:
         def _step(params, opt_state, batch):
             loss, grads = _value_and_grad(loss_fn, params, batch)
@@ -1083,7 +1143,7 @@ def build_train_step(
                 out_specs=(P(), state_specs, P()),
                 check_vma=False,
             )
-            return jax.jit(sharded, donate_argnums=(0, 1) if donate else ())
+            return _finish_build(sharded)
     else:
         def _step(params, opt_state, batch):
             loss, grads = _value_and_grad(loss_fn, params, batch)

@@ -732,6 +732,122 @@ def scenario_wire_int8(pid, nproc, scratch):
             "faults": inj.log.counts.get("fault_injected", 0)}
 
 
+def scenario_overlap_fault(pid, nproc, scratch):
+    """ISSUE 8 satellite: the overlap-scheduled compiled step in a real
+    2-process world, under the fault injector.
+
+    The spawning test truncates the plan-agreement AND trace-guard
+    exchanges (``obj_store.exchange`` calls #1/#3) on every process:
+    each transient is observed by every rank in lockstep, retried, and
+    — the point of this scenario — the retry must not reorder or drop
+    any of the overlapped program's buckets.  Pinned three ways:
+
+    * the overlap step's collective trace hash, re-derived AFTER the
+      faulted run, equals the pre-run hash and agrees across ranks
+      (nothing reordered);
+    * every bucket psum still issues at its dependency frontier
+      (``analysis.check_overlap`` returns no findings);
+    * the loss trajectory and final params are BIT-IDENTICAL to the
+      synchronous (overlap="none") run of the same world with no fault
+      in flight (the injected faults are call-count-addressed to the
+      overlap run's exchanges only).
+    """
+    import hashlib
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.analysis import check_overlap
+    from chainermn_tpu.comm_wire import WireConfig, plan_of_tree
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = _comm()
+    rng = np.random.RandomState(0)
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+        "w3": jnp.asarray(rng.randn(4, 4) * 0.3, jnp.float32),
+    }
+    # tiny buckets -> one per leaf: a genuinely multi-bucket program
+    wire = WireConfig(codec="none", bucket_bytes=64, max_buckets=0)
+    w_true = rng.randn(8, 4).astype(np.float32)
+    x_all = rng.randn(16, 8).astype(np.float32)
+    y_all = x_all @ w_true
+
+    def loss_fn(p, b):
+        bx, by = b
+        h = jnp.tanh(bx @ p["w1"])
+        return jnp.mean(((h @ p["w2"]) @ p["w3"] - by) ** 2)
+
+    lo = pid * (16 // nproc)
+    hi = lo + 16 // nproc
+    batch = (x_all[lo:hi], y_all[lo:hi])
+
+    def run(overlap):
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.05), comm, wire=wire, overlap=overlap
+        )
+        step = build_train_step(comm, loss_fn, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        pre_hash = step.collective_trace(p, o, batch).trace_hash()
+        losses = []
+        for _ in range(10):
+            p, o, m = step(p, o, batch)
+            losses.append(float(m["loss"]))
+        post_hash = step.collective_trace(p, o, batch).trace_hash()
+        return step, p, o, pre_hash, post_hash, losses
+
+    # overlap run first: its exchanges (plan agreement = exchange #1,
+    # trace guard = #3) absorb the injected truncations
+    step_b, p_b, o_b, pre_b, post_b, losses_b = run("bucket")
+    inj = fi.active()
+    assert inj is not None, "fault injector must be env-activated"
+    assert inj.log.counts.get("fault_injected", 0) >= 2, (
+        "both injected truncations must have fired",
+        dict(inj.log.counts),
+    )
+    # retried transients did not reorder the program
+    assert pre_b == post_b
+    hashes = comm.allgather_obj(post_b)
+    assert all(h == hashes[0] for h in hashes), hashes
+    # ...and did not drop a bucket: every bucket psum still issues at
+    # its dependency frontier
+    plan = plan_of_tree(params, wire.bucket_bytes, wire.max_buckets)
+    assert plan.n_buckets >= 3
+    # inspect the variant the faulted run actually EXECUTED: the step
+    # places the per-process local rows into the global batch before
+    # dispatch, and OverlappedStep caches per aval signature — handing
+    # it the raw local batch would trace (and validate) a different,
+    # never-run variant
+    placed_batch = step_b.place_batch(batch)
+    jb = step_b.get_jitted(p_b, o_b).scheduled_jaxpr(
+        p_b, o_b, placed_batch
+    )
+    findings = check_overlap(jb, plan)
+    assert not findings, [str(f) for f in findings]
+
+    # no-fault synchronous reference: bit-identical losses and params
+    step_s, p_s, o_s, pre_s, post_s, losses_s = run("none")
+    assert losses_b == losses_s, (losses_b, losses_s)
+    assert pre_b != pre_s  # ordering genuinely moved vs sync
+    for k in sorted(params):
+        np.testing.assert_array_equal(
+            np.asarray(p_b[k]), np.asarray(p_s[k])
+        )
+    digests = comm.allgather_obj(hashlib.sha256(
+        b"".join(np.asarray(p_b[k]).tobytes() for k in sorted(p_b))
+    ).hexdigest())
+    assert all(d == digests[0] for d in digests), digests
+    return {
+        "faults": inj.log.counts.get("fault_injected", 0),
+        "final_loss": losses_b[-1],
+        "buckets": plan.n_buckets,
+    }
+
+
 def scenario_trace_divergence(pid, nproc, scratch):
     """ISSUE 5 satellite: two processes build INTENTIONALLY divergent
     train steps (the rank named by CHAINERMN_TPU_DIVERGE_RANK adds one

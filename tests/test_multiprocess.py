@@ -249,6 +249,29 @@ class TestWireInt8:
         assert all(p["faults"] >= 1 for p in payloads)
         assert all(p["final_loss"] < p["first_loss"] for p in payloads)
 
+    def test_overlap_step_under_fault_injector(self, tmp_path):
+        """ISSUE 8 satellite: a 2-proc compiled OVERLAPPED step under
+        the fault injector — retried transients on the plan-agreement
+        and trace-guard exchanges must not reorder or drop any bucket:
+        the trace hash is stable across the faulted run (and across
+        ranks), every bucket psum still issues at its dependency
+        frontier, and loss/params are bit-identical to the no-fault
+        synchronous run (asserted inside the scenario)."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "truncate",
+             "at": [1, 3], "truncate_to": 4},
+        ])
+        res = run_world(
+            "overlap_fault", n_procs=2, local_devices=2, tmpdir=tmp_path,
+            timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "overlap_fault")
+        assert all(p["faults"] >= 2 for p in payloads)
+        assert all(p["buckets"] >= 3 for p in payloads)
+
 
 class TestTraceDivergence:
     def test_divergent_steps_fail_fast_on_both_ranks(self, tmp_path):

@@ -194,6 +194,101 @@ class TestDiffRules:
         assert diff_rows(load_rows(old), load_rows(new)) == []
         assert DEFAULT_TOLERANCE == 1.10
 
+    def test_overlap_variant_rows_synthesize_value_and_direction(
+            self, tmp_path):
+        """ISSUE 8 satellite: variant-shaped ``overlap_*`` rows (no
+        "value", only step_time_ms) are regression-gated — value
+        synthesized from step_time_ms, unit ms => lower-is-better, so
+        a SLOWER overlap_on capture is flagged."""
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"variant": "overlap_on", "step_time_ms": 100.0,
+             "n_measurements": 2, "spread_max_over_min": 1.02},
+            {"metric": "x", "value": 1.0},
+        ])
+        new = _capture(tmp_path, "BENCH_r91.json", [
+            {"variant": "overlap_on", "step_time_ms": 130.0,
+             "n_measurements": 2, "spread_max_over_min": 1.02},
+            {"metric": "x", "value": 1.0},
+        ])
+        ro, rn = load_rows(old), load_rows(new)
+        assert ro["overlap_on"]["value"] == 100.0
+        assert lower_is_better("overlap_on", rn["overlap_on"])
+        regs = diff_rows(ro, rn)
+        assert [r.metric for r in regs] == ["overlap_on"]
+        assert regs[0].direction == "lower-better"
+
+    def test_overlap_variant_rows_spread_gated(self, tmp_path):
+        """A move inside the rung's own recorded spread passes."""
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"variant": "overlap_resnet_on", "step_time_ms": 100.0,
+             "n_measurements": 2, "spread_max_over_min": 1.20},
+        ])
+        new = _capture(tmp_path, "BENCH_r91.json", [
+            {"variant": "overlap_resnet_on", "step_time_ms": 115.0,
+             "n_measurements": 2, "spread_max_over_min": 1.02},
+        ])
+        assert diff_rows(load_rows(old), load_rows(new)) == []
+
+    def test_overlap_speedup_row_is_higher_better(self, tmp_path):
+        """bench.py's vgg16_overlap_speedup ratio: dropping from 1.08x
+        to 0.99x is a regression (higher-better via 'speedup')."""
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"metric": "vgg16_overlap_speedup", "value": 1.08,
+             "unit": "x (bucket overlap ON / OFF)",
+             "n_measurements": 4, "spread_max_over_min": 1.03},
+        ])
+        new = _capture(tmp_path, "BENCH_r91.json", [
+            {"metric": "vgg16_overlap_speedup", "value": 0.99,
+             "unit": "x (bucket overlap ON / OFF)",
+             "n_measurements": 4, "spread_max_over_min": 1.03},
+        ])
+        ro, rn = load_rows(old), load_rows(new)
+        assert not lower_is_better(
+            "vgg16_overlap_speedup", rn["vgg16_overlap_speedup"]
+        )
+        regs = diff_rows(ro, rn)
+        assert [r.metric for r in regs] == ["vgg16_overlap_speedup"]
+        assert regs[0].direction == "higher-better"
+
+    def test_metric_rows_with_step_time_keep_their_value(self,
+                                                         tmp_path):
+        """The synthesis only fills the gap: a metric row carrying both
+        a value and a step_time_ms keeps its value (and direction)."""
+        cap = _capture(tmp_path, "BENCH_r90.json", [
+            {"metric": "resnet50_train_images_per_sec_per_chip",
+             "value": 2900.0, "step_time_ms": 44.0,
+             "unit": "images/sec/chip"},
+        ])
+        rows = load_rows(cap)
+        row = rows["resnet50_train_images_per_sec_per_chip"]
+        assert row["value"] == 2900.0
+        assert not lower_is_better(
+            "resnet50_train_images_per_sec_per_chip", row
+        )
+
+    def test_failed_metric_row_with_step_time_stays_skipped(
+            self, tmp_path):
+        """A FAILED metric capture (value: null) must stay skipped even
+        when a step_time_ms sits beside it — synthesizing would compare
+        a time against a throughput baseline (a 44-vs-2900 'regression'
+        in one direction, a silent pass in the other)."""
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"metric": "resnet50_train_images_per_sec_per_chip",
+             "value": 2900.0, "step_time_ms": 44.0,
+             "unit": "images/sec/chip"},
+        ])
+        new = _capture(tmp_path, "BENCH_r91.json", [
+            {"metric": "resnet50_train_images_per_sec_per_chip",
+             "value": None, "step_time_ms": 44.0,
+             "unit": "images/sec/chip", "error": "relay down"},
+        ])
+        ro, rn = load_rows(old), load_rows(new)
+        assert rn[
+            "resnet50_train_images_per_sec_per_chip"
+        ]["value"] is None
+        assert diff_rows(ro, rn) == []
+        assert diff_rows(rn, ro) == []  # reverse direction too
+
     def test_explicit_pair_with_unreadable_capture_fails(
         self, tmp_path, capsys
     ):

@@ -118,8 +118,10 @@ from chainermn_tpu.utils.benchmarking import (  # noqa: E402
 _BURN_S = float(os.environ.get("BENCH_BURN_S", "0" if SMOKE else "12"))
 
 
-def _time_steps(run_fn, steps, warmup=1):
-    return _time_steps_raw(run_fn, steps, warmup, burn_seconds=_BURN_S)
+# (no _time_steps burn-in wrapper anymore: every live call site invokes
+# _time_steps_raw directly with its own burn policy — the native-input
+# row burns only its first pass, the seq2seq eager illustration
+# deliberately never burns)
 
 
 def _burned_kloop(run_k, k, repeats=2):
@@ -511,9 +513,10 @@ def config_resnet50_native_input():
     dts = []
     try:
         for i in range(n_meas):
-            dts.append(_time_steps_raw(
+            dt_i, _ = _time_steps_raw(
                 run, steps, warmup=1, burn_seconds=_BURN_S if i == 0 else 0,
-            ))
+            )
+            dts.append(dt_i)
     finally:
         it.close()  # retire the generator's held slot before the loader
         loader.close()
@@ -1062,7 +1065,7 @@ def config_seq2seq_mp():
         params, state = opt.update(grads, state, params)
         return loss
 
-    eager_dt = _time_steps_raw(eager_run, 2 if SMOKE else 3, warmup=1)
+    eager_dt, _ = _time_steps_raw(eager_run, 2 if SMOKE else 3, warmup=1)
 
     # 3. the REAL pipeline: enc|dec through build_pipeline_train_step
     # on a CPU virtual mesh in a subprocess (it must never touch the

@@ -38,7 +38,8 @@ from chainermn_tpu.utils.benchmarking import (
 
 
 def _time(fn, *args, steps=20):
-    return time_steps(lambda: fn(*args), steps, warmup=1)
+    dt, _samples = time_steps(lambda: fn(*args), steps, warmup=1)
+    return dt
 
 
 def _classify(e):

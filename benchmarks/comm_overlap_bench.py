@@ -44,6 +44,11 @@ with compute, so the A/B here bounds machinery cost — the ICI win
 needs the TPU capture.  The ``wire_db_on`` rung retired with the
 double-buffering decision rule (docs/performance.md).
 
+telemetry_overhead (ISSUE 10): the observability layer's enabled-vs-
+disabled A/B on the host-driven Updater path (span sites live on the
+host; the fori_loop harness would measure nothing), min-of-N fields
+sourced from the shared ``observability.metrics.Histogram``.
+
 Usage:
     python benchmarks/comm_overlap_bench.py                  # real chip
     python benchmarks/comm_overlap_bench.py --cpu-mesh       # 8 virt dev
@@ -161,6 +166,85 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
         )
     dt, dts = _time_kloop(ksteps, params, opt_state)
     _emit(name, dt, dts, int(x.shape[0]), **extra)
+
+
+def _run_telemetry_overhead(model_ctor, batch_fn, loss_of, tx):
+    """ISSUE 10 rung: the telemetry overhead A/B on the HOST-DRIVEN
+    step path (Updater.update's span sites — a compiled k-in-fori_loop
+    harness would measure nothing: the instrumentation is host-side).
+    Emits ``telemetry_overhead_off`` / ``_on`` rows timed by the SAME
+    ``time_steps`` min-of-N protocol as every other rung — the raw
+    samples it now returns land in an ``observability.metrics.Histogram``
+    whose ``protocol_fields()`` produce the row's disclosure (one
+    source for the reported number, the spread, and the telemetry
+    histogram).  Plus a ``telemetry_overhead`` ratio row (on/off;
+    ~1.0 = the contract's enabled-path cost is in the noise — the
+    DISABLED-path ≤1 % contract is pinned separately by
+    tests/test_observability.py)."""
+    import itertools
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.training.trainer import Updater
+    from chainermn_tpu.utils.benchmarking import time_steps
+
+    comm = cmn.create_communicator("tpu")
+    model = model_ctor()
+    x, y, init_arg = batch_fn(comm)
+    params = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
+    opt = cmn.create_multi_node_optimizer(tx, comm)
+    step = cmn.build_train_step(
+        comm, lambda p, b: loss_of(model, p, b), opt, donate=False
+    )
+    p0, o0 = step.place(params, opt.init(params))
+    batch = (
+        jax.device_put(x, step.batch_sharding),
+        jax.device_put(y, step.batch_sharding),
+    )
+    steps_per = max(K // 2, 2)
+    results = {}
+    for mode in ("off", "on"):
+        upd = Updater(itertools.cycle([batch]), step, p0, o0)
+
+        def run():
+            upd.update()
+            return upd.last_metrics["loss"]
+
+        # the "off" leg must actually be off (a CHAINERMN_TPU_TELEMETRY
+        # env activation would otherwise record through it, collapsing
+        # the A/B to ~1.0), and teardown restores whatever was active
+        # before instead of clobbering it for later rungs
+        prev = obs.active()
+        tel = obs.Telemetry(label="bench") if mode == "on" else None
+        obs.install(tel)
+        try:
+            dt, dts = time_steps(run, steps_per, warmup=1,
+                                 repeats=REPEATS)
+        finally:
+            obs.install(prev)
+        hist = obs.Histogram(f"telemetry_overhead_{mode}")
+        hist.extend(dts)
+        results[mode] = dt
+        rec = {
+            "variant": f"telemetry_overhead_{mode}",
+            "step_time_ms": round(dt * 1e3, 3),
+            "samples_ms": [round(d * 1e3, 3) for d in dts],
+            "k": steps_per,
+            "global_batch": int(x.shape[0]),
+            "telemetry": mode,
+            # min-of-N disclosure from the telemetry Histogram — the
+            # one shared protocol source (ISSUE 10 satellite)
+            **hist.protocol_fields(),
+        }
+        if tel is not None:
+            rec["spans_recorded"] = len(tel.timeline)
+        print(json.dumps(rec), flush=True)
+    if results["off"] > 0:
+        print(json.dumps({
+            "variant": "telemetry_overhead",
+            "overhead_ratio": round(results["on"] / results["off"], 4),
+            "n_measurements": 2 * REPEATS,
+        }), flush=True)
 
 
 def _run_bare(name, model_ctor, batch_fn, loss_of, tx):
@@ -389,6 +473,11 @@ def _variants():
                 rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw
             )
         )
+    # telemetry overhead A/B (ISSUE 10): host-driven step path,
+    # enabled vs disabled, min-of-N fields from the shared Histogram
+    variants["telemetry_overhead"] = lambda: _run_telemetry_overhead(
+        ml_ctor, ml_batch, ml_loss_of, ml_tx
+    )
     # the conv-mix overlap A/B (ResNet-18 on the virtual mesh): multi-
     # bucket plan over a real backward chain — the shape the decision
     # rule (docs/performance.md) judges alongside bench.py's VGG pair
@@ -413,7 +502,8 @@ def main():
          "wire_perleaf_sync", "wire_perleaf_dummy", "wire_bucketed_sync",
          "wire_bucketed_dummy", "wire_int8_sync", "wire_int8_dummy",
          "overlap_off", "overlap_on", "overlap_int8_on",
-         "overlap_resnet_off", "overlap_resnet_on"]
+         "overlap_resnet_off", "overlap_resnet_on",
+         "telemetry_overhead"]
         if CPU_MESH else
         ["resnet_sync", "resnet_dummy", "resnet_bare", "lm_sync",
          "lm_dummy", "lm_bare"]

@@ -54,7 +54,6 @@ import jax.numpy as jnp
 import numpy as np
 
 from chainermn_tpu.utils.benchmarking import (
-    min_positive,
     protocol_fields,
     time_steps,
 )
@@ -107,10 +106,11 @@ def _time_generate(name, model, params, *, use_cache, comm=None,
         )
 
     # min-of-N protocol: two paired-k/2k measurements (the second needs
-    # no extra warmup/burn — the first already warmed the path)
-    dts = [time_steps(run, STEPS, warmup=1, burn_seconds=BURN),
-           time_steps(run, STEPS, warmup=1)]
-    dt = min_positive(dts)
+    # no extra warmup/burn — the first already warmed the path); the
+    # helper now returns its raw samples, so the reported number and
+    # the spread disclosure come from one measurement pass
+    dt, dts = time_steps(run, STEPS, warmup=1, burn_seconds=BURN,
+                         repeats=2)
     print(json.dumps({
         "variant": name,
         "new_tokens_per_sec": round(B * NEW / dt, 1),

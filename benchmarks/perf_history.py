@@ -32,6 +32,14 @@ trajectory is regression-gated exactly like the metric rows, spread-
 gated by the row's own ``spread_max_over_min``.  Speedup-ratio rows
 (``vgg16_overlap_speedup``) are higher-is-better via the ``speedup``
 spelling.
+
+Phase-summary rows (ISSUE 10): ``MetricsReport`` appends
+``{"phase": "step", "p50_ms": ..., "p99_ms": ...}`` rows to its JSONL;
+each ``*_ms`` statistic loads as its own ``phase.<name>.<stat>``
+pseudo-metric (unit ms, lower-is-better, DEFAULT tolerance — the phase
+row's recorded spread is cross-rank imbalance, not repeat noise), so a
+committed per-phase trajectory — data-wait creep, a step-time p99
+regression — fails the gate like any bench row.
 """
 
 from __future__ import annotations
@@ -104,6 +112,30 @@ def load_rows(path: str) -> Dict[str, dict]:
     def add(row: dict) -> None:
         name = row.get("metric") or row.get("variant")
         if not isinstance(name, str):
+            # MetricsReport phase-summary rows (ISSUE 10): shaped
+            # {"phase": "step", "p50_ms": ..., "p99_ms": ...} with no
+            # metric/variant name.  Each *_ms summary statistic becomes
+            # its own pseudo-metric ("phase.step.p50_ms", unit ms —
+            # lower-is-better by the existing direction inference), so
+            # a captured per-phase trajectory is regression-gated
+            # direction-aware like every other row.  The phase row's
+            # own spread_max_over_min is deliberately NOT inherited:
+            # MetricsReport computes it as max/min of per-PROCESS
+            # means (cross-rank imbalance, potentially huge on a
+            # straggler capture), which is not repeat noise of the
+            # statistic being diffed — the pseudo-metric uses the
+            # default tolerance instead.  Repeated reports of the same
+            # phase keep the LAST row (end-of-run summary), matching
+            # the variant-row convention.
+            phase = row.get("phase")
+            if isinstance(phase, str):
+                for key in ("p50_ms", "p99_ms", "mean_ms", "max_ms"):
+                    if isinstance(row.get(key), (int, float)):
+                        rows[f"phase.{phase}.{key}"] = {
+                            "metric": f"phase.{phase}.{key}",
+                            "value": row[key],
+                            "unit": "ms",
+                        }
             return
         if (
             "variant" in row

@@ -39,7 +39,8 @@ __version__ = "0.2.0"
 def __getattr__(name):
     # Heavier subsystems load lazily to keep import light.
     if name in ("functions", "links", "iterators", "training", "parallel",
-                "models", "ops", "utils", "resilience", "comm_wire"):
+                "models", "ops", "utils", "resilience", "comm_wire",
+                "observability"):
         import importlib
 
         return importlib.import_module(f"chainermn_tpu.{name}")

@@ -36,10 +36,24 @@ Rules
     ``.update``) are skipped — the rule targets literal rows that
     silently present one-shot timings as measurements.
 
+``raw-timing``
+    ``time.perf_counter()`` / ``time.time()`` calls are forbidden inside
+    ``chainermn_tpu/`` outside the two sanctioned timing modules —
+    ``observability/`` (the span timeline IS the timing layer) and
+    ``utils/benchmarking.py`` (the min-of-N measurement protocol) — in
+    every spelling: ``time.time``, module aliases (``import time as
+    t``), and ``from time import perf_counter`` smuggling.  Ad-hoc
+    timing in the package is how measurements drift from the protocol
+    and escape the telemetry stream; route through
+    ``observability.span``/``Timeline`` (or ``time.monotonic`` for
+    plain interval arithmetic, which the rule deliberately permits —
+    it is the clock both sanctioned layers run on).
+
 Per-line escape hatch (same line or the line above)::
 
     # mnlint: allow(raw-collective)
     # mnlint: allow(untimed-row)
+    # mnlint: allow(raw-timing)
 """
 
 from __future__ import annotations
@@ -70,6 +84,14 @@ SANCTIONED = (
 )
 
 SKIP_DIRS = {"__pycache__", ".git", "csrc", "_build", ".claude"}
+
+# raw-timing: the forbidden wall/benchmark clocks, and where raw use of
+# them IS the job (the timing layer itself)
+TIMING_CALLS = frozenset({"time", "perf_counter"})
+TIMING_SANCTIONED = (
+    "chainermn_tpu/observability/",
+    "chainermn_tpu/utils/benchmarking.py",
+)
 
 TIMING_KEY_RE = re.compile(
     r"(^|_)ms($|_)|_ms$"            # iter_ms, step_time_ms, rtt_ms, ms_*
@@ -113,35 +135,46 @@ def _is_lax_base(node: ast.expr, aliases=frozenset()) -> bool:
     return False
 
 
-def _lax_aliases(tree: ast.AST) -> frozenset:
-    """Names the file binds to the lax module — the satellite gap:
-    ``import jax.lax as jl`` / ``from jax import lax as L`` /
-    ``mylax = jax.lax`` all put raw collectives one attribute access
-    away without the ``lax`` spelling the base check keys on."""
+def _module_aliases(tree: ast.AST, leaf: str,
+                    seeds: tuple = ()) -> frozenset:
+    """Names the file binds to a module whose dotted path ends in
+    ``leaf`` — ``import jax.lax as jl`` / ``from jax import lax as L``
+    / ``mylax = jax.lax`` respellings.  ONE walker shared by the
+    raw-collective (``lax``) and raw-timing (``time``) rules, so an
+    alias-tracking fix cannot land in one and silently miss the
+    other.  ``seeds`` are extra bare names already known to denote
+    the module (re-assigning them aliases it too)."""
     out = set()
     for node in ast.walk(tree):
         if isinstance(node, ast.Import):
             for a in node.names:
-                if a.name.endswith(".lax") and a.asname:
+                if a.asname and (
+                    a.name == leaf or a.name.endswith("." + leaf)
+                ):
                     out.add(a.asname)
         elif isinstance(node, ast.ImportFrom):
             for a in node.names:
-                if a.name == "lax" and a.asname:
+                if a.name == leaf and a.asname:
                     out.add(a.asname)
         elif isinstance(node, ast.Assign):
-            if isinstance(node.value, ast.Attribute) and (
-                node.value.attr == "lax"
-            ):
-                for t in node.targets:
-                    if isinstance(t, ast.Name):
-                        out.add(t.id)
-            elif isinstance(node.value, ast.Name) and node.value.id in (
-                "lax", "plax"
+            v = node.value
+            if (isinstance(v, ast.Attribute) and v.attr == leaf) or (
+                isinstance(v, ast.Name) and (
+                    v.id == leaf or v.id in seeds
+                )
             ):
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         out.add(t.id)
     return frozenset(out)
+
+
+def _lax_aliases(tree: ast.AST) -> frozenset:
+    """Names the file binds to the lax module — the satellite gap:
+    ``import jax.lax as jl`` / ``from jax import lax as L`` /
+    ``mylax = jax.lax`` all put raw collectives one attribute access
+    away without the ``lax`` spelling the base check keys on."""
+    return _module_aliases(tree, "lax", seeds=("plax",))
 
 
 def _lint_raw_collectives(tree: ast.AST, lines, rel: str) -> List[Violation]:
@@ -173,6 +206,39 @@ def _lint_raw_collectives(tree: ast.AST, lines, rel: str) -> List[Violation]:
                         "smuggles raw collectives past the lint; call "
                         "through functions.collectives",
                     ))
+    return out
+
+
+def _lint_raw_timing(tree: ast.AST, lines, rel: str) -> List[Violation]:
+    out = []
+    aliases = _module_aliases(tree, "time")
+    # names from-imported out of the time module (perf_counter smuggling)
+    smuggled = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module == "time":
+            for a in node.names:
+                if a.name in TIMING_CALLS:
+                    smuggled.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = None
+        if isinstance(node.func, ast.Attribute):
+            base = node.func.value
+            if node.func.attr in TIMING_CALLS and isinstance(
+                base, ast.Name
+            ) and (base.id == "time" or base.id in aliases):
+                hit = f"time.{node.func.attr}"
+        elif isinstance(node.func, ast.Name) and node.func.id in smuggled:
+            hit = node.func.id
+        if hit and not _allowed(lines, node.lineno, "raw-timing"):
+            out.append(Violation(
+                rel, node.lineno, "raw-timing",
+                f"raw {hit}() timing outside observability//"
+                "utils/benchmarking.py; record through "
+                "observability.span / the timeline (time.monotonic is "
+                "fine for plain interval arithmetic)",
+            ))
     return out
 
 
@@ -280,6 +346,10 @@ def lint_file(path: str, repo_root: str) -> List[Violation]:
         out += _lint_raw_collectives(tree, lines, rel)
     if _is_bench_file(rel):
         out += _lint_untimed_rows(tree, lines, rel)
+    if rel.startswith("chainermn_tpu/") and not any(
+        rel.startswith(p) for p in TIMING_SANCTIONED
+    ):
+        out += _lint_raw_timing(tree, lines, rel)
     return sorted(out, key=lambda v: (v.path, v.line))
 
 

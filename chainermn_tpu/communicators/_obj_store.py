@@ -24,6 +24,7 @@ import jax
 import numpy as np
 
 from .communicator_base import dumps, loads
+from ..observability import timeline as _obs
 from ..resilience import fault_injection as _fi
 from ..resilience.errors import PayloadCorruptionError
 from ..resilience.retry import RetryPolicy, call_with_retry
@@ -96,9 +97,11 @@ class LocalObjStore:
 
     def send(self, obj: Any, dest: int, tag: int = 0) -> None:
         _check_rank(dest, self._size, "dest")
-        payload = _maybe_fault("obj_store.send", peer=dest,
-                               payload=dumps(obj))
-        self._mail[(dest, tag)].append(payload)
+        with _obs.span("obj_store.send", peer=dest) as sp:
+            payload = _maybe_fault("obj_store.send", peer=dest,
+                                   payload=dumps(obj))
+            sp.set(bytes=len(payload))
+            self._mail[(dest, tag)].append(payload)
 
     def recv(self, source: int, tag: int = 0, dest: int = 0) -> Any:
         """Drain the mailbox of rank ``dest``.
@@ -111,14 +114,18 @@ class LocalObjStore:
         """
         del source
         _check_rank(dest, self._size, "dest")
-        _maybe_fault("obj_store.recv", peer=dest)
-        box = self._mail[(dest, tag)]
-        if not box:
-            raise RuntimeError(
-                f"recv_obj: no message pending for rank {dest}/tag {tag} "
-                "(single-controller recv must follow the matching send)"
-            )
-        return _loads_checked(box.popleft(), "obj_store.recv", dest)
+        with _obs.span("obj_store.recv", peer=dest) as sp:
+            _maybe_fault("obj_store.recv", peer=dest)
+            box = self._mail[(dest, tag)]
+            if not box:
+                raise RuntimeError(
+                    f"recv_obj: no message pending for rank {dest}/tag "
+                    f"{tag} (single-controller recv must follow the "
+                    "matching send)"
+                )
+            payload = box.popleft()
+            sp.set(bytes=len(payload))
+            return _loads_checked(payload, "obj_store.recv", dest)
 
     def recv_for(self, dest: int, tag: int = 0) -> Any:
         return self.recv(source=-1, tag=tag, dest=dest)
@@ -127,21 +134,28 @@ class LocalObjStore:
         # single controller: every rank's payload is this caller's payload,
         # so any in-range root broadcasts the same object
         _check_rank(root, self._size, "root")
-        payload = _maybe_fault("obj_store.exchange", peer=root,
-                               payload=dumps(obj))
-        return _loads_checked(payload, "obj_store.exchange", root)
+        with _obs.span("obj_store.exchange", peer=root) as sp:
+            payload = _maybe_fault("obj_store.exchange", peer=root,
+                                   payload=dumps(obj))
+            sp.set(bytes=len(payload))
+            return _loads_checked(payload, "obj_store.exchange", root)
 
     def gather(self, obj: Any, root: int = 0) -> list:
         _check_rank(root, self._size, "root")
-        payload = _maybe_fault("obj_store.exchange", peer=root,
-                               payload=dumps(obj))
-        return [_loads_checked(payload, "obj_store.exchange", root)
-                for _ in range(self._size)]
+        with _obs.span("obj_store.exchange", peer=root) as sp:
+            payload = _maybe_fault("obj_store.exchange", peer=root,
+                                   payload=dumps(obj))
+            sp.set(bytes=len(payload))
+            return [_loads_checked(payload, "obj_store.exchange", root)
+                    for _ in range(self._size)]
 
     def allgather(self, obj: Any) -> list:
-        payload = _maybe_fault("obj_store.exchange", payload=dumps(obj))
-        return [_loads_checked(payload, "obj_store.exchange")
-                for _ in range(self._size)]
+        with _obs.span("obj_store.exchange") as sp:
+            payload = _maybe_fault("obj_store.exchange",
+                                   payload=dumps(obj))
+            sp.set(bytes=len(payload))
+            return [_loads_checked(payload, "obj_store.exchange")
+                    for _ in range(self._size)]
 
 
 class MultiprocessObjStore:
@@ -195,19 +209,20 @@ class MultiprocessObjStore:
         """
         from jax.experimental import multihost_utils
 
-        p = _maybe_fault("obj_store.exchange", payload=payload)
-        nproc = jax.process_count()
-        length = np.array([len(p)], np.int64)
-        lengths = multihost_utils.process_allgather(length).reshape(-1)
-        maxlen = int(lengths.max())
-        buf = np.zeros((maxlen,), np.uint8)
-        arr = np.frombuffer(p, np.uint8)
-        buf[: arr.size] = arr
-        gathered = multihost_utils.process_allgather(buf)
-        return [
-            gathered[q, : int(lengths[q])].tobytes()
-            for q in range(nproc)
-        ]
+        with _obs.span("obj_store.exchange", bytes=len(payload)):
+            p = _maybe_fault("obj_store.exchange", payload=payload)
+            nproc = jax.process_count()
+            length = np.array([len(p)], np.int64)
+            lengths = multihost_utils.process_allgather(length).reshape(-1)
+            maxlen = int(lengths.max())
+            buf = np.zeros((maxlen,), np.uint8)
+            arr = np.frombuffer(p, np.uint8)
+            buf[: arr.size] = arr
+            gathered = multihost_utils.process_allgather(buf)
+            return [
+                gathered[q, : int(lengths[q])].tobytes()
+                for q in range(nproc)
+            ]
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
         """Every process returns the payload contributed by the process
@@ -247,8 +262,13 @@ class MultiprocessObjStore:
         return client
 
     def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+        with _obs.span("obj_store.send", peer=dest) as sp:
+            self._send(obj, dest, tag, sp)
+
+    def _send(self, obj: Any, dest: int, tag: int, sp) -> None:
         payload = _maybe_fault("obj_store.send", peer=dest,
                                payload=dumps(obj))
+        sp.set(bytes=len(payload))
         key = f"cmn_obj/{jax.process_index()}->{dest}/{tag}/{self._seq[(dest, tag)]}"
         self._seq[(dest, tag)] += 1
         client = self._kv()
@@ -308,8 +328,10 @@ class MultiprocessObjStore:
             )
             return payload[:total]
 
-        data = call_with_retry(attempt, site="obj_store.recv",
-                               peer=source, policy=policy)
+        with _obs.span("obj_store.recv", peer=source) as sp:
+            data = call_with_retry(attempt, site="obj_store.recv",
+                                   peer=source, policy=policy)
+            sp.set(bytes=len(data))
         return _loads_checked(data, "obj_store.recv", source)
 
 

@@ -46,6 +46,7 @@ from .communicator_base import CommunicatorBase
 from ._obj_store import create_obj_store
 from ._topology import Topology
 from .xla_communicator_base import XlaCommunicatorBase
+from ..observability import timeline as _obs
 
 
 class TpuCommunicator(XlaCommunicatorBase):
@@ -404,15 +405,22 @@ class NonCudaAwareCommunicator(XlaCommunicatorBase):
         size = self.size
         plan = _cw.make_plan([h[0] for h in hosts])
 
-        def reduce_one(cat):
-            if dt is None:
-                red = cat.mean(axis=0) if mean else cat.sum(axis=0)
-            else:
-                red = np.sum(cat.astype(dt), axis=0, dtype=dt)
-                red = red.astype(cat.dtype)
-                if mean:
-                    red = red / size
-            return np.broadcast_to(red, cat.shape).copy()
+        def reduce_one(k, cat):
+            # telemetry: host-reduce span for bucket k, recorded from
+            # the worker thread (the timeline is thread-safe and tags
+            # thread ids, so the exported trace SHOWS the pipelining:
+            # wire.reduce[k+1] on the worker overlapping wire.ship[k]
+            # on the main thread)
+            with _obs.span("wire.reduce", bucket=k,
+                           bytes=cat.nbytes // size):
+                if dt is None:
+                    red = cat.mean(axis=0) if mean else cat.sum(axis=0)
+                else:
+                    red = np.sum(cat.astype(dt), axis=0, dtype=dt)
+                    red = red.astype(cat.dtype)
+                    if mean:
+                        red = red / size
+                return np.broadcast_to(red, cat.shape).copy()
 
         packed = _cw.pack_stacked(plan, hosts, size, xp=np)
         placed = []
@@ -423,14 +431,17 @@ class NonCudaAwareCommunicator(XlaCommunicatorBase):
             # peak host memory bounded at two reduced buckets instead
             # of n_buckets, with the same k+1-reduces-while-k-ships
             # pipelining.
-            pending = pool.submit(reduce_one, packed[0]) if packed \
+            pending = pool.submit(reduce_one, 0, packed[0]) if packed \
                 else None
             for k in range(len(packed)):
                 nxt = (
-                    pool.submit(reduce_one, packed[k + 1])
+                    pool.submit(reduce_one, k + 1, packed[k + 1])
                     if k + 1 < len(packed) else None
                 )
-                placed.append(self._put(jnp.asarray(pending.result())))
+                with _obs.span("wire.ship", bucket=k):
+                    placed.append(
+                        self._put(jnp.asarray(pending.result()))
+                    )
                 pending = nxt
         out = _cw.unpack_stacked(plan, placed, [h.shape for h in hosts])
         return jax.tree_util.tree_unflatten(treedef, out)

@@ -30,6 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from .communicator_base import CommunicatorBase
 from ._obj_store import create_obj_store
 from ._topology import Topology
+from ..observability import timeline as _obs
 from ..resilience.retry import resilient_call
 
 _REDUCERS = {
@@ -145,10 +146,22 @@ class XlaCommunicatorBase(CommunicatorBase):
             # XLA has no pprod; exp/sum/log would lose sign — use allgather.
             g = self.allgather(x)
             return self._put(jnp.broadcast_to(jnp.prod(g, axis=0), jnp.shape(x)))
-        return resilient_call(
-            "collective.allreduce",
-            lambda: self._allreduce_fns[op](self._put(x)),
-        )
+        # telemetry span with per-rank payload bytes (the stacked array
+        # carries every rank's row; one row is what each rank reduces);
+        # measured mode forces completion so the span is a latency, not
+        # an async dispatch — disabled path dispatches exactly as before
+        nbytes = getattr(x, "nbytes", None)
+        with _obs.span(
+            "collective.allreduce", op=op,
+            bytes=(int(nbytes) // self.size) if nbytes else None,
+        ):
+            out = resilient_call(
+                "collective.allreduce",
+                lambda: self._allreduce_fns[op](self._put(x)),
+            )
+            if _obs.active() is not None:
+                jax.block_until_ready(out)
+        return out
 
     @functools.cached_property
     def _bcast_fn(self):
@@ -385,17 +398,46 @@ class XlaCommunicatorBase(CommunicatorBase):
         plan = _cw.make_plan(per_rank)
 
         def run():
-            packed = _cw.pack_stacked(plan, leaves, self.size)
-            # pipelined bucket round-trips (ISSUE 8 satellite): stage
-            # EVERY bucket's device placement before dispatching the
-            # first reduction, so bucket k+1's send is in flight while
-            # bucket k reduces (jax dispatch is async — interleaving
-            # put/reduce per bucket serialized the transfers behind
-            # each reduction's dispatch).  Reduction order and
-            # arithmetic are unchanged: bit-identical to the serial
-            # schedule.
-            staged = [self._put(cat) for cat in packed]
-            red = [fn(s) for s in staged]
+            # telemetry: per-bucket wire.ship / collective.psum spans
+            # with per-rank bucket bytes — the measured half of
+            # ``observability.attribute``'s join against the static
+            # trace's bucket psum records.  Observer effect, disclosed:
+            # with telemetry active each bucket's reduction is forced
+            # to completion inside its span (a latency, not an async
+            # dispatch), serializing what the unobserved run pipelines;
+            # the DISABLED path below is byte-identical to before.
+            tel = _obs.active()
+            if tel is None:
+                packed = _cw.pack_stacked(plan, leaves, self.size)
+                # pipelined bucket round-trips (ISSUE 8 satellite):
+                # stage EVERY bucket's device placement before
+                # dispatching the first reduction, so bucket k+1's send
+                # is in flight while bucket k reduces (jax dispatch is
+                # async — interleaving put/reduce per bucket serialized
+                # the transfers behind each reduction's dispatch).
+                # Reduction order and arithmetic are unchanged:
+                # bit-identical to the serial schedule.
+                staged = [self._put(cat) for cat in packed]
+                red = [fn(s) for s in staged]
+            else:
+                with _obs.span("collective.allreduce_grad",
+                               buckets=plan.n_buckets):
+                    with _obs.span("wire.pack", buckets=plan.n_buckets):
+                        packed = _cw.pack_stacked(plan, leaves, self.size)
+                    staged = []
+                    for k, cat in enumerate(packed):
+                        with _obs.span("wire.ship", bucket=k):
+                            staged.append(self._put(cat))
+                    red = []
+                    for k, s in enumerate(staged):
+                        b = plan.buckets[k]
+                        with _obs.span(
+                            "collective.psum", bucket=k,
+                            bytes=b.size * np.dtype(b.dtype).itemsize,
+                        ):
+                            r = fn(s)
+                            jax.block_until_ready(r)
+                        red.append(r)
             out = _cw.unpack_stacked(
                 plan, red, [jnp.shape(l) for l in leaves]
             )

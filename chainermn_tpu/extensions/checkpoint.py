@@ -33,6 +33,7 @@ from typing import Any, Dict, Optional
 import jax
 import numpy as np
 
+from ..observability import timeline as _obs
 from ..resilience import elastic as _elastic
 from ..resilience import fault_injection as _fi
 from ..resilience.log import emit as _emit
@@ -160,6 +161,10 @@ class _MultiNodeCheckpointer:
         it (orbax writes each process's addressable shards); filesystem
         mutations of shared directories are chief-only with barriers.
         """
+        with _obs.span("checkpoint.save", step=int(step)):
+            self._save(step, state)
+
+    def _save(self, step: int, state: Dict[str, Any]) -> None:
         # resilience site: rank-death / slice-loss rehearsal point for
         # the elastic mp tier (a `die` spec targeted at one process is a
         # spot reclaim mid-snapshot); no-op when no injector is active
@@ -306,18 +311,19 @@ class _MultiNodeCheckpointer:
             is_transient,
         )
 
-        local = self._available_steps()
-        inventories = call_with_retry(
-            lambda: self._comm.allgather_obj(local),
-            site="checkpoint.newest_common_step",
-            policy=RetryPolicy(max_attempts=4),
-            retryable=lambda e: is_transient(e)
-            or isinstance(e, PayloadCorruptionError),
-        )
-        common = set(inventories[0])
-        for inv in inventories[1:]:
-            common &= set(inv)
-        return max(common) if common else None
+        with _obs.span("checkpoint.agreement"):
+            local = self._available_steps()
+            inventories = call_with_retry(
+                lambda: self._comm.allgather_obj(local),
+                site="checkpoint.newest_common_step",
+                policy=RetryPolicy(max_attempts=4),
+                retryable=lambda e: is_transient(e)
+                or isinstance(e, PayloadCorruptionError),
+            )
+            common = set(inventories[0])
+            for inv in inventories[1:]:
+                common &= set(inv)
+            return max(common) if common else None
 
     def resume(self, like: Optional[Dict[str, Any]] = None):
         """Load the newest common snapshot; returns (step, state) or
@@ -335,6 +341,10 @@ class _MultiNodeCheckpointer:
         ``self.last_resize`` records ``(old_world, new_world)`` when the
         route was taken.
         """
+        with _obs.span("checkpoint.resume"):
+            return self._resume(like)
+
+    def _resume(self, like: Optional[Dict[str, Any]] = None):
         self.wait_until_finished()  # async: the in-flight save counts
         self.last_resize = None
         self.last_manifest = None
@@ -451,9 +461,11 @@ class _MultiNodeCheckpointer:
                 "own), or restart via Trainer.run_elastic",
                 site="checkpoint.resume",
             )
-        state = _elastic.reshard_state(
-            state, like, old_world, new_world, label=f"step_{step}"
-        )
+        with _obs.span("checkpoint.reshard", step=int(step),
+                       old_world=old_world, new_world=new_world):
+            state = _elastic.reshard_state(
+                state, like, old_world, new_world, label=f"step_{step}"
+            )
         self.last_resize = (old_world, new_world)
         _emit(
             "elastic_resume", "checkpoint.resume",

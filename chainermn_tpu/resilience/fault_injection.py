@@ -61,27 +61,16 @@ import numpy as np
 
 from .errors import PreemptionError, TransientCommError
 from .log import ResilienceLog, emit
+from .log import process_index as _process_index
 
 _KINDS = ("delay", "timeout", "truncate", "die", "error", "preempt")
 
 ENV_SPEC = "CHAINERMN_TPU_FAULTS"
 ENV_SEED = "CHAINERMN_TPU_FAULT_SEED"
+# targeting index resolution lives in log.process_index (shared with
+# event stamping, so fault targeting and event attribution can never
+# disagree about which process this is)
 ENV_PROCESS = "CHAINERMN_TPU_FAULT_PROCESS_INDEX"
-
-
-def _process_index() -> int:
-    """This process's index, for ``FaultSpec(process=...)`` targeting.
-    The env var wins (the mp harness sets it before jax initializes);
-    outside a distributed world everything is process 0."""
-    raw = os.environ.get(ENV_PROCESS)
-    if raw is not None:
-        return int(raw)
-    try:
-        import jax
-
-        return int(jax.process_index())
-    except Exception:
-        return 0
 
 
 class FaultSpec:

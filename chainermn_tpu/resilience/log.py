@@ -10,23 +10,61 @@ registry: ``emit()`` fans an event out to every attached log, and
 ``Trainer.run`` attaches its log for the duration of the run.  Logs also
 work standalone (``ResilienceLog.record``) for unit tests that have no
 trainer.
+
+Timeline merging (ISSUE 10): every event carries BOTH clocks —
+``monotonic`` (``time.monotonic()``, the clock the observability span
+timeline runs on, so events merge deterministically into the unified
+stream at their true positions) and ``time`` (wall clock, the
+human-readable anchor) — plus the recording ``process`` index, so a
+multi-process export says *which rank's* fault it was.  ``emit()``
+constructs ONE event object and appends it to every attached sink,
+which is what lets ``Timeline.merge_resilience`` deduplicate by object
+identity when several sinks of the same run are merged.
 """
 
 from __future__ import annotations
 
+import os
 import time
 from typing import Dict, List, Optional
+
+_ENV_PROCESS = "CHAINERMN_TPU_FAULT_PROCESS_INDEX"
+
+
+def process_index() -> int:
+    """This process's index, shared by event stamping and fault
+    targeting.  The env var wins and is re-read every call (the mp
+    harness sets it before jax initializes; tests monkeypatch it); the
+    fallback reads jax's *distributed client state* — NOT
+    ``jax.process_index()``, which would initialize the device backend
+    as a side effect of stamping an event (this helper runs on every
+    :class:`ResilienceEvent`, including in processes that never touch a
+    device).  Outside a distributed world everything is process 0."""
+    raw = os.environ.get(_ENV_PROCESS)
+    if raw is not None:
+        return int(raw)
+    try:
+        from jax._src import distributed
+
+        pid = distributed.global_state.process_id
+        return int(pid) if pid is not None else 0
+    except Exception:
+        return 0
 
 
 class ResilienceEvent:
     """One observed/injected fault or recovery action."""
 
-    __slots__ = ("kind", "site", "time", "info")
+    __slots__ = ("kind", "site", "time", "monotonic", "process", "info")
 
     def __init__(self, kind: str, site: Optional[str] = None, **info):
         self.kind = kind
         self.site = site
-        self.time = time.time()
+        # wall clock for humans, monotonic for deterministic ordering
+        # against the observability span timeline (same clock)
+        self.time = time.time()  # mnlint: allow(raw-timing)
+        self.monotonic = time.monotonic()
+        self.process = process_index()
         self.info = info
 
     def __repr__(self):
@@ -40,11 +78,15 @@ class ResilienceLog:
     def __init__(self):
         self._events: List[ResilienceEvent] = []
 
-    def record(self, kind: str, site: Optional[str] = None,
-               **info) -> ResilienceEvent:
-        ev = ResilienceEvent(kind, site, **info)
+    def append(self, ev: ResilienceEvent) -> ResilienceEvent:
+        """Append an already-constructed event (how ``emit`` shares ONE
+        event object across every attached sink)."""
         self._events.append(ev)
         return ev
+
+    def record(self, kind: str, site: Optional[str] = None,
+               **info) -> ResilienceEvent:
+        return self.append(ResilienceEvent(kind, site, **info))
 
     def events(self, kind: Optional[str] = None,
                site: Optional[str] = None) -> List[ResilienceEvent]:
@@ -88,6 +130,11 @@ def detach(log: ResilienceLog) -> None:
 
 def emit(kind: str, site: Optional[str] = None, **info) -> None:
     """Record an event on every attached sink (no-op with none attached —
-    the hot-path cost of an un-observed event is one empty-list check)."""
+    the hot-path cost of an un-observed event is one empty-list check).
+    One event object is shared by all sinks: identical timestamps, and
+    identity-deduplicable when several sinks merge into one timeline."""
+    if not _sinks:
+        return
+    ev = ResilienceEvent(kind, site, **info)
     for sink in _sinks:
-        sink.record(kind, site, **info)
+        sink.append(ev)

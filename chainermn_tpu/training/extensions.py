@@ -221,12 +221,12 @@ class Throughput:
     def __call__(self, trainer):
         self._count += 1
         if self._count == self._warmup:
-            self._t0 = time.time()
+            self._t0 = time.monotonic()
             self._n0 = self._count
             return
         if self._t0 is None:
             return
-        dt = time.time() - self._t0
+        dt = time.monotonic() - self._t0
         n = self._count - self._n0
         if dt <= 0 or n <= 0:
             return

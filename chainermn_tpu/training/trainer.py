@@ -22,6 +22,7 @@ from typing import Any, Callable, Dict, Optional
 import jax
 
 from .triggers import get_trigger
+from ..observability import timeline as _obs
 from ..resilience import fault_injection as _fi
 from ..resilience import log as _rlog
 from ..resilience.errors import (
@@ -55,27 +56,55 @@ class Updater:
         return getattr(self.iterator, "epoch_detail", 0.0)
 
     def update(self) -> None:
-        # resilience site: a deterministic mid-run failure point for
-        # exercising auto-resume (no-op — one None check — when no
-        # injector is active)
-        _fi.fire("trainer.update")
-        batch = next(self.iterator)
-        place_batch = getattr(self.step_fn, "place_batch", None)
-        # build_train_step exposes its own placement predicate; a batch
-        # already laid out per the step's sharding (prefetch_to_device
-        # output) must NOT be re-placed — in multi-process runs
-        # make_array_from_process_local_data on a non-fully-addressable
-        # global array crashes.  An explicit batch_sharding always goes
-        # through device_put (a no-op when already right).
-        is_placed = getattr(self.step_fn, "is_placed", None)
-        if place_batch is not None and not self._explicit_sharding:
-            if not (is_placed is not None and is_placed(batch)):
-                batch = place_batch(batch)
-        elif self.batch_sharding is not None:
-            batch = jax.device_put(batch, self.batch_sharding)
-        self.params, self.opt_state, self.last_metrics = self.step_fn(
-            self.params, self.opt_state, batch
-        )
+        # telemetry spans ("update" > "data.wait"/"compute.dispatch"):
+        # the data-wait-vs-compute split of the step taxonomy; disabled
+        # path is one `is None` check per span (docs/observability.md)
+        with _obs.span("update"):
+            # resilience site: a deterministic mid-run failure point for
+            # exercising auto-resume (no-op — one None check — when no
+            # injector is active)
+            _fi.fire("trainer.update")
+            with _obs.span("data.wait"):
+                batch = next(self.iterator)
+            with _obs.span("compute.dispatch"):
+                place_batch = getattr(self.step_fn, "place_batch", None)
+                # build_train_step exposes its own placement predicate;
+                # a batch already laid out per the step's sharding
+                # (prefetch_to_device output) must NOT be re-placed —
+                # in multi-process runs
+                # make_array_from_process_local_data on a
+                # non-fully-addressable global array crashes.  An
+                # explicit batch_sharding always goes through
+                # device_put (a no-op when already right).
+                is_placed = getattr(self.step_fn, "is_placed", None)
+                if place_batch is not None and not self._explicit_sharding:
+                    if not (is_placed is not None and is_placed(batch)):
+                        batch = place_batch(batch)
+                elif self.batch_sharding is not None:
+                    batch = jax.device_put(batch, self.batch_sharding)
+                self.params, self.opt_state, self.last_metrics = \
+                    self.step_fn(self.params, self.opt_state, batch)
+        self._observe_host_time()
+
+    @staticmethod
+    def _observe_host_time() -> None:
+        """Derived rank-LOCAL metric: ``update.host`` = update minus
+        its data.wait/compute.dispatch children — host time this rank
+        spent NEITHER waiting for data NOR dispatching (injected
+        faults, GC, host contention).  The straggler detector keys on
+        it because lockstep SPMD *equalizes* wall-clock step time
+        across ranks (healthy ranks block in the collective waiting
+        for the slow one), so only rank-local phases can convict."""
+        tel = _obs.active()
+        if tel is None:
+            return
+        reg = tel.registry
+        u = reg.histogram("update").last
+        d = reg.histogram("data.wait").last
+        c = reg.histogram("compute.dispatch").last
+        if u is None or d is None or c is None:
+            return
+        reg.histogram("update.host").observe(max(u - d - c, 0.0))
 
 
 class _ExtensionEntry:
@@ -139,7 +168,8 @@ class Trainer:
 
     @property
     def elapsed_time(self) -> float:
-        return time.time() - (self._start_time or time.time())
+        now = time.monotonic()
+        return now - (self._start_time or now)
 
     def _stop(self) -> bool:
         if self.stop_unit == "iteration":
@@ -229,7 +259,7 @@ class Trainer:
         :class:`RestartBudgetExceededError` with the last failure
         chained; non-recoverable errors propagate immediately.
         """
-        self._start_time = time.time()
+        self._start_time = time.monotonic()
         _rlog.attach(self.resilience_log)
         try:
             for e in self._extensions:
@@ -240,20 +270,26 @@ class Trainer:
             self.restarts = 0
             while not self._stop():
                 try:
-                    self.updater.update()
-                    self.iteration += 1
-                    self.observation = {
-                        k: v
-                        for k, v in (self.updater.last_metrics or {}).items()
-                    }
-                    self._check_step_guard()
-                    # extensions run INSIDE the recovery scope: a
-                    # transient failure during e.g. the checkpointer's
-                    # collective save is as recoverable as one during
-                    # the update itself
-                    for e in exts:
-                        if e.trigger(self):
-                            e.ext(self)
+                    # "step" span: one trainer iteration — update AND
+                    # its extensions (a checkpoint stall is step time
+                    # the operator pays; the sub-spans split it)
+                    with _obs.span("step", iteration=self.iteration):
+                        self.updater.update()
+                        self.iteration += 1
+                        self.observation = {
+                            k: v
+                            for k, v in (
+                                self.updater.last_metrics or {}
+                            ).items()
+                        }
+                        self._check_step_guard()
+                        # extensions run INSIDE the recovery scope: a
+                        # transient failure during e.g. the
+                        # checkpointer's collective save is as
+                        # recoverable as one during the update itself
+                        for e in exts:
+                            if e.trigger(self):
+                                e.ext(self)
                 except ResilienceError as err:
                     if not err.recoverable:
                         raise
@@ -277,12 +313,49 @@ class Trainer:
                     self._pending_guard = None
                     self._auto_resume(err)
             self._flush_step_guard()
-            for e in self._extensions:
-                fin = getattr(e.ext, "finalize", None)
-                if fin:
-                    fin(self)
         finally:
-            _rlog.detach(self.resilience_log)
+            try:
+                # finalize runs on error exits too: the async
+                # checkpointer must drain its in-flight save (a
+                # truncated snapshot outlives the exception) and a
+                # MetricsReport that installed its own process-global
+                # telemetry must uninstall it (leaking it would keep
+                # recording — and serializing the observed wire — for
+                # every later run in the process).  Each finalize is
+                # isolated: one raising must neither mask the run's
+                # own exception nor skip the remaining extensions'
+                # cleanup.
+                errs = []
+                for e in self._extensions:
+                    fin = getattr(e.ext, "finalize", None)
+                    if fin:
+                        try:
+                            fin(self)
+                        except Exception as fe:  # noqa: BLE001
+                            errs.append((e.name, fe))
+                            self.resilience_log.record(
+                                "finalize_error", "trainer.run",
+                                extension=e.name,
+                                error=f"{type(fe).__name__}: {fe}",
+                            )
+                import sys as _sys
+
+                if errs and _sys.exc_info()[0] is None:
+                    # clean run: a finalize failure must not vanish
+                    raise errs[0][1]
+                # erroring run: the run's own exception wins; the
+                # finalize failures are on the resilience log (and,
+                # merged, in the timeline)
+            finally:
+                # one merged stream: the run's faults/retries/restarts
+                # land in the active timeline at their recorded
+                # monotonic positions (idempotent — emit shares event
+                # objects, so an additional explicit merge cannot
+                # duplicate)
+                tel = _obs.active()
+                if tel is not None:
+                    tel.timeline.merge_resilience(self.resilience_log)
+                _rlog.detach(self.resilience_log)
 
     # -- elastic restart mode (resilience.elastic) ---------------------
     @classmethod

@@ -22,8 +22,17 @@ def force_completion(x) -> float:
 
 
 def time_steps(run_fn, steps: int, warmup: int = 1,
-               burn_seconds: float = 0.0) -> float:
+               burn_seconds: float = 0.0, repeats: int = 1):
     """Seconds per step of ``run_fn`` via paired k / 2k timed runs.
+
+    Returns ``(dt, samples)``: the reported seconds-per-step under the
+    min-of-N protocol (smallest positive paired difference; the long
+    run's average as the noise-floor fallback) AND the raw per-repeat
+    paired-difference samples — callers attach the samples to an
+    ``observability.metrics.Histogram`` / ``protocol_fields`` so the
+    reported number and its spread disclosure come from one source
+    (ISSUE 10 satellite: the helper used to discard them, leaving each
+    bench rung to re-measure for its spread).
 
     ``run_fn()`` must return an array whose value depends on the step's
     full computation (chain steps through a carried state so the final
@@ -37,7 +46,8 @@ def time_steps(run_fn, steps: int, warmup: int = 1,
     20-50 % (a decaying per-dispatch cost that the paired difference
     does not cancel; observed across every round-3 harness run —
     measurements stabilize after a few seconds of device activity), so
-    benchmark entry points pass ~10 s here.
+    benchmark entry points pass ~10 s here.  The burn runs once, before
+    the first repeat.
     """
     steps = max(int(steps), 1)
     out = None
@@ -57,12 +67,17 @@ def time_steps(run_fn, steps: int, warmup: int = 1,
         force_completion(out)
         return time.perf_counter() - t0
 
-    t1 = timed(steps)
-    t2 = timed(2 * steps)
-    dt = (t2 - t1) / steps
+    dts = []
+    t2_last = None
+    for _ in range(max(int(repeats), 1)):
+        t1 = timed(steps)
+        t2 = timed(2 * steps)
+        dts.append((t2 - t1) / steps)
+        t2_last = t2
+    dt = min_positive(dts)
     if dt <= 0:  # noise floor: fall back to the long run's average
-        dt = t2 / (2 * steps)
-    return dt
+        dt = t2_last / (2 * steps)
+    return dt, dts
 
 
 def protocol_fields(samples) -> dict:

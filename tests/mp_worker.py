@@ -1088,6 +1088,144 @@ def scenario_spot_reclaim_phase2(pid, nproc, scratch):
             "final_w": float(got[0])}
 
 
+def scenario_telemetry(pid, nproc, scratch):
+    """ISSUE 10 satellite: runtime telemetry in a REAL 2-process world
+    (faults via CHAINERMN_TPU_FAULTS set by the spawning test):
+
+    (a) an injected obj-store timeout on the FIRST exchange is absorbed
+        by the lockstep retry — and both the fault and its retry land
+        in the exported timeline, in order;
+    (b) a delay fault at ``trainer.update`` TARGETED at process 1 makes
+        it the straggler: the cross-rank ``MetricsReport`` (allgathered
+        phase summaries) flags process 1 on BOTH ranks;
+    (c) a process-local eager bucketed allreduce_grad contributes
+        per-bucket ``collective.psum`` spans to the same stream;
+    (d) the merged Chrome-trace/JSONL export validates: step spans,
+        bucket collective spans, and resilience events in one
+        time-ordered timeline.
+    """
+    import json as _json
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.training.trainer import Trainer, Updater
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.resilience.log import (
+        ResilienceLog, attach, detach,
+    )
+
+    tel = obs.Telemetry(label=f"proc{pid}")
+    obs.install(tel)
+    slog = ResilienceLog()  # catches emits outside trainer.run
+    attach(slog)
+    try:
+        comm = _comm()
+
+        # (a) the env spec fires a timeout on the FIRST
+        # obj_store.exchange of every process; the lockstep retry
+        # absorbs it and records fault_injected + retry on the sink
+        got = comm.allgather_obj(pid)
+        assert got == list(range(nproc)), got
+        assert slog.counts.get("fault_injected", 0) >= 1, slog.counts
+        assert slog.counts.get("retry", 0) >= 1, slog.counts
+
+        # (b) trainer with a targeted slow rank.  The delay fault at
+        # trainer.update fires only on process 1 (FaultSpec(process=1)),
+        # so its per-step host time dominates; MetricsReport allgathers
+        # the window summaries and every rank computes the same flags.
+        lr = 0.1
+
+        def loss_fn(params, batch):
+            return 0.5 * jnp.sum(
+                (params["w"] - batch.mean(axis=0)) ** 2
+            )
+
+        opt = cmn.create_multi_node_optimizer(optax.sgd(lr), comm)
+        step = build_train_step(comm, loss_fn, opt, donate=False)
+        params, opt_state = step.place(
+            {"w": jnp.zeros((4,))}, opt.init({"w": jnp.zeros((4,))})
+        )
+        n_local = comm.size // comm.process_count
+        rows = np.stack([
+            np.full((4,), float(pid * n_local + i), np.float32)
+            for i in range(n_local)
+        ])
+        it = SerialIterator([rows[i] for i in range(n_local)], n_local,
+                            shuffle=False)
+        trainer = Trainer(Updater(it, step, params, opt_state),
+                          stop_trigger=(6, "iteration"))
+        rep = obs.MetricsReport(comm, trigger=(3, "iteration"),
+                                filename=None)
+        trainer.extend(rep)
+        trainer.run()
+        assert trainer.iteration == 6
+        # the LAST window (iterations 4-6) is past both ranks' compile
+        # cost: the targeted delay dominates process 1's step mean
+        assert rep.straggler_processes == [1], (
+            rep.straggler_processes, rep.last_report,
+        )
+
+        # (c) process-local eager wire: real multi-device bucket psums
+        # within this process's 2 local CPU devices
+        local_comm = cmn.create_communicator(
+            "tpu", devices=jax.local_devices()
+        )
+        # two 3 MB leaves: each exceeds what the 4 MiB open bucket
+        # could absorb alongside the other -> a 2-bucket plan
+        grads = {
+            "a": jnp.ones((local_comm.size, 750_000), jnp.float32),
+            "b": jnp.ones((local_comm.size, 750_000), jnp.float32),
+        }
+        local_comm.allreduce_grad(grads)
+        psums = tel.timeline.spans("collective.psum")
+        assert len(psums) >= 2, len(psums)
+
+        # (d) merge + export + validate
+        tel.timeline.merge_resilience(slog)
+        tel.timeline.merge_resilience(trainer.resilience_log)  # dedup
+        chrome = os.path.join(scratch, f"trace_p{pid}.json")
+        jsonl = os.path.join(scratch, f"trace_p{pid}.jsonl")
+        tel.timeline.to_chrome_trace(chrome)
+        tel.timeline.to_jsonl(jsonl)
+
+        doc = _json.load(open(chrome))
+        assert isinstance(doc["traceEvents"], list)
+        for e in doc["traceEvents"]:
+            assert e["ph"] in ("M", "X", "i"), e
+            assert "name" in e and "pid" in e
+            if e["ph"] == "X":
+                assert e["dur"] >= 0
+        rows_out = [_json.loads(l) for l in open(jsonl)]
+        ts = [r["t"] for r in rows_out]
+        assert ts == sorted(ts), "jsonl not time-ordered"
+        names = [r["name"] for r in rows_out]
+        assert "step" in names
+        assert "collective.psum" in names
+        fault_i = names.index("resilience.fault_injected")
+        retry_i = names.index("resilience.retry")
+        straggler_i = names.index("resilience.straggler")
+        assert fault_i < retry_i < straggler_i, (
+            fault_i, retry_i, straggler_i,
+        )
+        # the straggler event names the slow process on every rank
+        strag = rows_out[straggler_i]
+        assert strag["args"]["process"] == 1, strag
+        return {
+            "stragglers": rep.straggler_processes,
+            "n_events": len(rows_out),
+            "n_bucket_psums": len(psums),
+            "faults": slog.counts.get("fault_injected", 0),
+        }
+    finally:
+        detach(slog)
+        obs.install(None)
+
+
 def scenario_except_hook(pid, nproc, scratch):
     """Failure containment: process 1 raises; its global except hook
     shuts the distributed client down; process 0, blocked in a KV recv,

@@ -295,3 +295,81 @@ class TestUntimedRowRule:
     def test_violation_formatting(self):
         v = Violation("b.py", 3, "untimed-row", "msg")
         assert str(v) == "b.py:3: [untimed-row] msg"
+
+
+# ----------------------------------------------------------------------
+# rule: raw-timing (ISSUE 10 satellite)
+# ----------------------------------------------------------------------
+class TestRawTimingRule:
+    def _lint_pkg(self, tmp_path, src, rel="chainermn_tpu/mod.py"):
+        p = tmp_path / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        return lint_file(str(p), str(tmp_path))
+
+    def test_time_time_and_perf_counter_flagged(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            import time
+            def f():
+                return time.time() + time.perf_counter()
+        """)
+        assert [v.rule for v in vs] == ["raw-timing"] * 2
+
+    def test_monotonic_is_permitted(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            import time
+            def f():
+                return time.monotonic(), time.sleep(0)
+        """)
+        assert vs == []
+
+    def test_module_alias_tracked(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            import time as t
+            def f():
+                return t.perf_counter()
+        """)
+        assert [v.rule for v in vs] == ["raw-timing"]
+
+    def test_from_import_smuggling_flagged(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            from time import perf_counter as pc
+            def f():
+                return pc()
+        """)
+        assert [v.rule for v in vs] == ["raw-timing"]
+
+    def test_sanctioned_timing_modules_exempt(self, tmp_path):
+        src = """
+            import time
+            def f():
+                return time.perf_counter()
+        """
+        assert self._lint_pkg(
+            tmp_path, src, rel="chainermn_tpu/observability/timeline.py"
+        ) == []
+        assert self._lint_pkg(
+            tmp_path, src, rel="chainermn_tpu/utils/benchmarking.py"
+        ) == []
+        # the rule is scoped to the package: bench scripts measure
+        # with raw clocks by design
+        assert self._lint_pkg(
+            tmp_path, src, rel="benchmarks/some_bench.py"
+        ) == []
+
+    def test_pragma_escape(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            import time
+            WALL = time.time()  # mnlint: allow(raw-timing)
+        """)
+        assert vs == []
+
+    def test_unrelated_attributes_not_flagged(self, tmp_path):
+        vs = self._lint_pkg(tmp_path, """
+            class Clock:
+                def time(self):
+                    return 0
+            def f(c):
+                return c.time()
+        """)
+        assert vs == []

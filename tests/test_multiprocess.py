@@ -273,6 +273,40 @@ class TestWireInt8:
         assert all(p["buckets"] >= 3 for p in payloads)
 
 
+class TestTelemetry:
+    def test_straggler_flagged_and_timeline_exported_both_ranks(
+        self, tmp_path
+    ):
+        """ISSUE 10 satellite: a 2-proc run with an injected slow rank
+        (delay fault at trainer.update TARGETED at process 1) must
+        produce a cross-rank MetricsReport that flags the straggler on
+        both ranks, and a fault-injected obj-store retry whose events
+        appear in the exported merged timeline in order (validated
+        inside the scenario: fault -> retry -> straggler, time-sorted
+        JSONL + Chrome-trace JSON shape, per-bucket collective spans
+        in the same stream)."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "timeout", "at": [1]},
+            {"site": "trainer.update", "kind": "delay", "delay": 0.25,
+             "probability": 1.0, "process": 1},
+        ])
+        res = run_world(
+            "telemetry", n_procs=2, local_devices=2, tmpdir=tmp_path,
+            timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "telemetry")
+        assert all(p["stragglers"] == [1] for p in payloads)
+        assert all(p["faults"] >= 1 for p in payloads)
+        assert all(p["n_bucket_psums"] >= 2 for p in payloads)
+        # both ranks exported their timeline files into the shared dir
+        for pid in (0, 1):
+            assert (tmp_path / f"trace_p{pid}.json").exists()
+            assert (tmp_path / f"trace_p{pid}.jsonl").exists()
+
+
 class TestTraceDivergence:
     def test_divergent_steps_fail_fast_on_both_ranks(self, tmp_path):
         """ISSUE 5 acceptance: rank 1 builds a step with one extra psum

@@ -313,3 +313,88 @@ class TestDiffRules:
         out = capsys.readouterr().out
         assert "REGRESSION" in out
         assert main([old, old]) == 0
+
+
+# ----------------------------------------------------------------------
+# MetricsReport phase-summary rows (ISSUE 10 satellite)
+# ----------------------------------------------------------------------
+class TestPhaseSummaryRows:
+    def test_phase_rows_load_as_ms_pseudo_metrics(self, tmp_path):
+        cap = _capture(tmp_path, "BENCH_r01.json", [
+            {"phase": "step", "iteration": 6, "p50_ms": 12.5,
+             "p99_ms": 30.0, "mean_ms": 14.0, "max_ms": 31.0,
+             "n_measurements": 6, "spread_max_over_min": 1.08},
+            {"phase": "data.wait", "iteration": 6, "p50_ms": 0.4,
+             "p99_ms": 1.1, "mean_ms": 0.5, "max_ms": 1.2,
+             "n_measurements": 6},
+        ])
+        rows = load_rows(cap)
+        assert rows["phase.step.p50_ms"]["value"] == 12.5
+        assert rows["phase.step.p99_ms"]["value"] == 30.0
+        assert rows["phase.data.wait.p50_ms"]["value"] == 0.4
+        for name in ("phase.step.p50_ms", "phase.data.wait.p99_ms"):
+            assert lower_is_better(name, rows[name])
+
+    def test_phase_regression_direction_aware(self, tmp_path):
+        old = _capture(tmp_path, "BENCH_r01.json", [
+            {"phase": "step", "p50_ms": 10.0, "p99_ms": 12.0,
+             "n_measurements": 6, "spread_max_over_min": 1.05},
+        ])
+        # p50 WORSENED (10 -> 15 ms): must flag beyond tolerance
+        worse = _capture(tmp_path, "BENCH_r02.json", [
+            {"phase": "step", "p50_ms": 15.0, "p99_ms": 12.0,
+             "n_measurements": 6, "spread_max_over_min": 1.05},
+        ])
+        regs = diff_rows(load_rows(old), load_rows(worse))
+        assert [r.metric for r in regs] == ["phase.step.p50_ms"]
+        assert regs[0].direction == "lower-better"
+        # p50 IMPROVED (10 -> 7 ms): lower-is-better, no flag
+        better = _capture(tmp_path, "BENCH_r03.json", [
+            {"phase": "step", "p50_ms": 7.0, "p99_ms": 12.0,
+             "n_measurements": 6, "spread_max_over_min": 1.05},
+        ])
+        assert diff_rows(load_rows(old), load_rows(better)) == []
+
+    def test_phase_rows_use_default_tolerance_not_rank_spread(
+        self, tmp_path
+    ):
+        """Review regression: the phase row's spread_max_over_min is
+        CROSS-RANK imbalance (a straggler capture records 1.5+), not
+        repeat noise — inheriting it would let genuine regressions
+        hide behind one slow rank.  The pseudo-metric must use the
+        default tolerance instead."""
+        old = _capture(tmp_path, "BENCH_r01.json", [
+            {"phase": "step", "p50_ms": 10.0, "n_measurements": 6,
+             "spread_max_over_min": 1.5},
+        ])
+        new = _capture(tmp_path, "BENCH_r02.json", [
+            {"phase": "step", "p50_ms": 14.0, "n_measurements": 6,
+             "spread_max_over_min": 1.5},
+        ])
+        rows_new = load_rows(new)
+        assert "spread_max_over_min" not in rows_new[
+            "phase.step.p50_ms"
+        ]
+        regs = diff_rows(load_rows(old), rows_new)
+        assert [r.metric for r in regs] == ["phase.step.p50_ms"]
+        assert regs[0].allowed == DEFAULT_TOLERANCE
+        # inside the default tolerance: not a regression
+        near = _capture(tmp_path, "BENCH_r03.json", [
+            {"phase": "step", "p50_ms": 10.8, "n_measurements": 6,
+             "spread_max_over_min": 1.5},
+        ])
+        assert diff_rows(load_rows(old), load_rows(near)) == []
+
+    def test_last_report_of_a_phase_wins(self, tmp_path):
+        cap = _capture(tmp_path, "BENCH_r01.json", [
+            {"phase": "step", "p50_ms": 50.0, "n_measurements": 3},
+            {"phase": "step", "p50_ms": 12.0, "n_measurements": 3},
+        ])
+        assert load_rows(cap)["phase.step.p50_ms"]["value"] == 12.0
+
+    def test_rows_without_numbers_skipped(self, tmp_path):
+        cap = _capture(tmp_path, "BENCH_r01.json", [
+            {"phase": "step", "p50_ms": None, "n_measurements": 0},
+            {"phase": 7, "p50_ms": 1.0},
+        ])
+        assert load_rows(cap) == {}

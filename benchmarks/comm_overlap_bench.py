@@ -44,6 +44,15 @@ with compute, so the A/B here bounds machinery cost — the ICI win
 needs the TPU capture.  The ``wire_db_on`` rung retired with the
 double-buffering decision rule (docs/performance.md).
 
+wire_flat / wire_hier / wire_hier_int8 rungs (ISSUE 11): the multi-hop
+schedule A/B on ONE hierarchical mesh (CPU tier: 2 synthetic slices of
+4 via CHAINERMN_TPU_FAKE_SLICE_SIZE).  wire_flat is the single-psum
+baseline, wire_hier the full-precision rs→ar→ag triple, wire_hier_int8
+the int8+EF inter hop.  Every row carries the schedule/codec
+fingerprint (``wire_schedules`` census + ``wire_plan_hash``) so a
+capture pins WHICH program it measured; perf_history gates the rows
+direction-aware like every variant row.
+
 telemetry_overhead (ISSUE 10): the observability layer's enabled-vs-
 disabled A/B on the host-driven Updater path (span sites live on the
 host; the fori_loop harness would measure nothing), min-of-N fields
@@ -152,12 +161,18 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
     if getattr(opt, "wire", None) is not None:
         from chainermn_tpu import comm_wire as _cw
 
-        plan = _cw.plan_of_tree(
-            params, opt.wire.bucket_bytes, opt.wire.max_buckets
-        )
+        # schedule-aware fingerprint (ISSUE 11): the per-bucket
+        # schedule census + agreed plan hash identify WHAT program a
+        # wire_* row measured, so a capture where the planner silently
+        # collapsed hier to flat reads as a config change, not noise
+        wplan = _cw.plan_wire(params, opt.wire, comm.mesh)
+        plan = wplan.plan
         extra.setdefault("wire_codec", opt.wire.codec)
         extra.setdefault("wire_buckets", plan.n_buckets)
         extra.setdefault("wire_n_leaves", plan.n_leaves)
+        extra.setdefault("wire_schedules", wplan.schedule_census())
+        extra.setdefault("wire_plan_hash", wplan.plan_hash()[:12])
+        extra.setdefault("mesh_shape", dict(comm.mesh.shape))
     else:
         extra.setdefault("wire_codec", "per_leaf")
         extra.setdefault(
@@ -473,6 +488,43 @@ def _variants():
                 rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw
             )
         )
+    # wire_flat / wire_hier / wire_hier_int8 rungs (ISSUE 11): the
+    # multi-hop schedule A/B on the SAME hierarchical mesh.  On the CPU
+    # mesh the 8 virtual devices are grouped into 2 synthetic slices of
+    # 4 (CHAINERMN_TPU_FAKE_SLICE_SIZE — devices with a real
+    # slice_index are never regrouped) so the ('mn_inter', 'mn_intra')
+    # pair genuinely factorizes; on chip the rungs run on the real
+    # slice topology.  Schedules are EXPLICIT per rung (not "auto") so
+    # each row's fingerprint pins what program was measured; the CPU
+    # A/B bounds scheduling machinery cost — the DCN-byte win needs the
+    # TPU capture (docs/performance.md "Multi-hop schedules").
+    hier_wire = WireConfig(schedule="hier_rs_ag")
+    hier_int8 = WireConfig(codec="int8", error_feedback=True,
+                           schedule="hier_rs_ag")
+
+    def _run_hier_rung(rung, kw):
+        prev = os.environ.get("CHAINERMN_TPU_FAKE_SLICE_SIZE")
+        if CPU_MESH:
+            os.environ["CHAINERMN_TPU_FAKE_SLICE_SIZE"] = "4"
+        try:
+            _run_sync(rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw)
+        finally:
+            if CPU_MESH:
+                if prev is None:
+                    os.environ.pop("CHAINERMN_TPU_FAKE_SLICE_SIZE", None)
+                else:
+                    os.environ["CHAINERMN_TPU_FAKE_SLICE_SIZE"] = prev
+
+    for rung, kw in {
+        "wire_flat": dict(wire=WireConfig(schedule="flat"),
+                          comm_name="hierarchical"),
+        "wire_hier": dict(wire=hier_wire, comm_name="hierarchical"),
+        "wire_hier_int8": dict(wire=hier_int8,
+                               comm_name="hierarchical"),
+    }.items():
+        variants[rung] = (
+            lambda rung=rung, kw=kw: _run_hier_rung(rung, kw)
+        )
     # telemetry overhead A/B (ISSUE 10): host-driven step path,
     # enabled vs disabled, min-of-N fields from the shared Histogram
     variants["telemetry_overhead"] = lambda: _run_telemetry_overhead(
@@ -501,6 +553,7 @@ def main():
          "mesh_resnet_db_on",
          "wire_perleaf_sync", "wire_perleaf_dummy", "wire_bucketed_sync",
          "wire_bucketed_dummy", "wire_int8_sync", "wire_int8_dummy",
+         "wire_flat", "wire_hier", "wire_hier_int8",
          "overlap_off", "overlap_on", "overlap_int8_on",
          "overlap_resnet_off", "overlap_resnet_on",
          "telemetry_overhead"]

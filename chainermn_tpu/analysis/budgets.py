@@ -57,6 +57,36 @@ BUDGETS = {
     # schedule regression that unrolls the reverse ring (one permute
     # per microbatch) trips this pin.
     "pipeline_train_step": {"collective_permute": 2, "all_reduce": 2},
+    # ISSUE 11: per-schedule collective counts for the multi-hop wire.
+    # hier_rs_ag costs exactly 1 reduce_scatter + 1 all_reduce + 1
+    # all_gather per bucket (vs flat's 1 all_reduce/bucket): <= 6
+    # buckets -> rs <= 6, ag <= 6, ar <= 6 bucket inter-hops + 1 loss
+    # pmean = 7.
+    "hier_train_step": {
+        "all_reduce": 7,
+        "reduce_scatter": 6,
+        "all_gather": 6,
+    },
+    # int8 inter hop adds exactly ONE batched scale pmax over the hier
+    # buckets (the flat tier's one-extra-collective contract, applied
+    # per schedule class): ar ceiling 8.
+    "hier_int8_train_step": {
+        "all_reduce": 8,
+        "reduce_scatter": 6,
+        "all_gather": 6,
+    },
+    # ZeRO's staged blocked path: the single full-mesh rs/ag pair per
+    # bucket becomes 2 rs down (intra full-precision + inter on the
+    # wire) and 2 ag up; the loss pmean stays the only all_reduce.
+    "zero_hier_train_step": {
+        "reduce_scatter": 12,
+        "all_gather": 12,
+        "all_reduce": 1,
+    },
+    # the eager bcast_tree multicast: exactly 2 masked psums (inter
+    # root->leaders, intra leaders->slices) — vs 1 for the flat
+    # spelling; a regression to per-stage-per-rank storms trips this.
+    "bcast_tree": {"all_reduce": 2},
 }
 
 # ----------------------------------------------------------------------

@@ -461,7 +461,11 @@ def check_overlap(jaxpr_like, plan) -> list:
     overlap engine's contract is that the census does NOT move), this
     check reads equation *positions*, so it takes a jaxpr (e.g.
     ``step.get_jitted(p, o).scheduled_jaxpr(p, o, batch)``) and the
-    wire's :class:`~chainermn_tpu.comm_wire.BucketPlan`, and returns
+    wire's :class:`~chainermn_tpu.comm_wire.BucketPlan` (or a
+    schedule-carrying :class:`~chainermn_tpu.comm_wire.WirePlan`, whose
+    ``hier_rs_ag`` buckets are checked as ONE readiness unit headed by
+    the intra reduce-scatter, with the rs→ar→ag triple's completeness
+    verified alongside), and returns
     :class:`Finding`\\ s — one ``error`` per late-issued bucket psum
     (``delay`` = foreign equations between operand readiness and
     dispatch), plus an ``error`` when the program carries fewer bucket

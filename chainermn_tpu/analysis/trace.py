@@ -324,15 +324,24 @@ class CollectiveTrace:
     def count(self, cls: str) -> int:
         return self.census().get(cls, 0)
 
-    def wire_census(self) -> dict:
+    def wire_census(self, by_class: bool = False) -> dict:
         """``{hop_class: total bytes_on_wire}`` over records whose axis
         sizes were known at trace time (zero totals omitted) — the
         aggregate the comm_wire planner's hop-aware bucket sizing
-        consumes."""
+        consumes.
+
+        ``by_class=True`` keys the totals ``"{hop}/{op_class}"`` (e.g.
+        ``"intra/reduce_scatter"``, ``"inter/all_reduce"``) — the
+        per-hop attribution of a multi-hop schedule's rs→ar→ag triple,
+        which is how a hier-scheduled step SHOWS its inter-hop byte
+        saving: the flat step's bytes sit under ``mixed/all_reduce``,
+        the staged step's under intra rs/ag plus a small
+        ``inter/all_reduce``."""
         out: dict = {}
         for r in self.records:
             if r.bytes_on_wire:
-                out[r.hop] = out.get(r.hop, 0) + r.bytes_on_wire
+                key = f"{r.hop}/{r.cls}" if by_class else r.hop
+                out[key] = out.get(key, 0) + r.bytes_on_wire
         return out
 
     def axis_names(self) -> Tuple[str, ...]:

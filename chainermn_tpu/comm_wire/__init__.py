@@ -18,6 +18,14 @@ Two pieces:
   are produced, hiding sync under the remaining backward segments.
   Bit-identical to the synchronous wire (pure reordering); selected via
   ``create_multi_node_optimizer(..., overlap="bucket")``.
+* :mod:`.schedules` — topology-aware multi-hop collective schedules
+  (ISSUE 11): a cost-model-driven per-bucket choice between the flat
+  psum and the DynamiQ-style ``hier_rs_ag`` triple (full-precision
+  intra-slice reduce-scatter → codec-compressed inter-slice all-reduce
+  → intra all-gather), plus the ``bcast_tree`` multicast spelling of
+  the eager bcast.  The chosen schedule lands in the :class:`WirePlan`
+  whose hash ``plan_agreement`` exchanges, so ranks cannot schedule
+  apart.
 
 Threaded through ``optimizers._sync_grads`` (compiled tier), the
 double-buffering and ZeRO optimizers, and the eager
@@ -47,6 +55,21 @@ from .codecs import (  # noqa: F401
     resolve_wire,
     storage_dtype,
     zero_residuals,
+)
+from .schedules import (  # noqa: F401
+    GRAD_SCHEDULES,
+    MIN_HIER_INTER_SAVINGS,
+    SCHEDULES,
+    AxisSplit,
+    WirePlan,
+    axis_split,
+    bcast_tree_stages,
+    hier_inter_savings,
+    mesh_axis_sizes,
+    plan_wire,
+    reduce_wire,
+    schedule_for_bucket,
+    zero_residuals_wire,
 )
 from .overlap import (  # noqa: F401
     OVERLAP_MODES,

@@ -68,12 +68,25 @@ _INT8_MAX = 127.0
 
 
 class WireConfig(NamedTuple):
-    """Full wire spec: codec + bucket plan knobs + error feedback."""
+    """Full wire spec: codec + bucket plan knobs + error feedback +
+    collective schedule.
+
+    ``schedule`` selects the per-bucket collective schedule
+    (:mod:`.schedules`): ``"auto"`` lets the cost model pick per bucket
+    (ring-formula wire bytes per hop class), ``"flat"`` pins today's
+    single psum (the bit-compat baseline), ``"hier_rs_ag"`` requests
+    the DynamiQ-style multi-hop schedule — full-precision intra-slice
+    reduce-scatter, codec-compressed inter-slice all-reduce, intra
+    all-gather — collapsing loudly to ``flat`` on meshes without a
+    genuine ('mn_inter', 'mn_intra') pair (the ragged/width-1 inter
+    degradation path).
+    """
 
     codec: str = "none"
     bucket_bytes: int = DEFAULT_BUCKET_BYTES
     max_buckets: int = DEFAULT_MAX_BUCKETS
     error_feedback: bool = False
+    schedule: str = "auto"
 
     def validate(self) -> "WireConfig":
         if self.codec not in CODECS:
@@ -84,6 +97,13 @@ class WireConfig(NamedTuple):
             raise ValueError(
                 f"error_feedback is meaningless for the lossless-or-"
                 f"widening {self.codec!r} codec; use bf16/f16/int8"
+            )
+        from .schedules import GRAD_SCHEDULES
+
+        if self.schedule not in ("auto",) + GRAD_SCHEDULES:
+            raise ValueError(
+                f"unknown wire schedule {self.schedule!r}; one of "
+                f"{('auto',) + GRAD_SCHEDULES}"
             )
         return self
 

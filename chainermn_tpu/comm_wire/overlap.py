@@ -266,6 +266,11 @@ class IssueRecord:
     operand_shapes: Tuple[Tuple[int, ...], ...]
     operand_dtypes: Tuple[str, ...]
     context: Tuple[str, ...]  # enclosing sub-jaxpr path
+    # mesh axes the collective runs over — what disambiguates a hier
+    # bucket's inter-hop psum (over the inter axis only) from a flat
+    # bucket's fused psum (over every sync axis) when their operand
+    # sizes collide
+    axes: Tuple[str, ...] = ()
 
     @property
     def delay(self) -> int:
@@ -311,6 +316,13 @@ def issue_report(jaxpr_like, context: Tuple[str, ...] = ()
                     continue
                 shapes.append(tuple(int(d) for d in aval.shape))
                 dtypes.append(str(aval.dtype))
+            ax = eqn.params.get("axes", eqn.params.get("axis_name", ()))
+            if ax is None:
+                ax = ()
+            elif isinstance(ax, (str, int)):
+                ax = (str(ax),)
+            else:
+                ax = tuple(str(a) for a in ax)
             out.append(IssueRecord(
                 primitive=name,
                 index=i,
@@ -318,6 +330,7 @@ def issue_report(jaxpr_like, context: Tuple[str, ...] = ()
                 operand_shapes=tuple(shapes),
                 operand_dtypes=tuple(dtypes),
                 context=context,
+                axes=ax,
             ))
         if name in _DESCEND_PRIMS:
             sub = eqn.params.get("jaxpr")
@@ -326,45 +339,146 @@ def issue_report(jaxpr_like, context: Tuple[str, ...] = ()
     return out
 
 
+def _plan_units(plan):
+    """Normalize a ``BucketPlan`` or a schedule-carrying ``WirePlan``
+    into per-bucket issue units: ``(schedule, head_prims, head_size,
+    head_axes, shard_size)``.  A flat bucket's readiness unit is its
+    fused psum (over every sync axis); a ``hier_rs_ag`` bucket's unit
+    is HEADED by the intra ``psum_scatter`` (operand = the zero-padded
+    bucket) with the inter psum and intra all-gather chained behind it
+    — ONE readiness unit, because the tail collectives are
+    data-dependent on the head (they cannot issue earlier than the rs
+    completes, so only the head's issue position is an overlap property
+    of the program).  ``head_axes`` is ``None`` for a bare BucketPlan
+    (sync axes unknown — size-only matching, the pre-schedule
+    contract); a WirePlan pins them, which is what keeps a flat
+    bucket's psum from masquerading as a hier bucket's inter hop (or
+    vice versa) when their operand sizes collide."""
+    schedules = tuple(getattr(plan, "schedules", ()))
+    buckets = plan.buckets
+    if not schedules:
+        return [("flat", ("psum",), b.size, None, None) for b in buckets]
+    split = plan.split()
+    units = []
+    for i, (b, s) in enumerate(zip(buckets, schedules)):
+        if s == "hier_rs_ag":
+            # jax's lax.psum_scatter binds the reduce_scatter primitive
+            # (older tiers may spell it psum_scatter) — match either
+            units.append((s, ("reduce_scatter", "psum_scatter"),
+                          plan.padded_size(i), (split.intra,),
+                          plan.shard_size(i)))
+        else:
+            units.append((s, ("psum",), b.size, tuple(plan.axes), None))
+    return units
+
+
+def _is_unit_head(rec: IssueRecord, units) -> bool:
+    if len(rec.operand_shapes) != 1:
+        return False
+    shape = rec.operand_shapes[0]
+    if len(shape) != 1:
+        return False
+    return any(
+        rec.primitive in prims
+        and int(shape[0]) == int(size)
+        and (axes is None or tuple(rec.axes) == tuple(axes))
+        for _, prims, size, axes, _ in units
+    )
+
+
 def bucket_issue_report(jaxpr_like, plan) -> List[IssueRecord]:
-    """The :class:`IssueRecord`\\ s of ``plan``'s bucket psums, in
-    program order — the raw material of the ordering-aware check
-    (:func:`chainermn_tpu.analysis.checks.check_overlap`)."""
-    sizes = [b.size for b in plan.buckets]
+    """The :class:`IssueRecord`\\ s of ``plan``'s bucket HEAD
+    collectives (the fused psum of a flat bucket, the intra
+    ``psum_scatter`` of a ``hier_rs_ag`` bucket), in program order —
+    the raw material of the ordering-aware check
+    (:func:`chainermn_tpu.analysis.checks.check_overlap`).  Accepts a
+    bare ``BucketPlan`` (every bucket flat, the pre-schedule contract)
+    or a ``WirePlan``."""
+    units = _plan_units(plan)
     return [
-        r for r in issue_report(jaxpr_like) if r.is_bucket_psum(sizes)
+        r for r in issue_report(jaxpr_like) if _is_unit_head(r, units)
     ]
 
 
 def order_violations(jaxpr_like, plan) -> List[str]:
-    """The ordering contract, in one place: every bucket psum issued
-    the moment its operands are ready (``delay == 0`` — dispatched
-    before the remaining backward segments complete), and the program
-    carrying one fused reduction per plan bucket.  Returns one message
-    per violation (empty = contract holds).  Both spellings of the
-    check — :func:`assert_overlap_order` here and the ``Finding``-style
-    :func:`chainermn_tpu.analysis.checks.check_overlap` — consume THIS
-    list, so the contract cannot drift between them.  The synchronous
-    wire fails for any multi-bucket plan (buckets pack first, then
-    every psum queues at the tail)."""
-    recs = bucket_issue_report(jaxpr_like, plan)
+    """The ordering contract, in one place: every bucket's HEAD
+    collective issued the moment its operands are ready (``delay == 0``
+    — dispatched before the remaining backward segments complete), the
+    program carrying one readiness unit per plan bucket, and — for
+    ``hier_rs_ag`` buckets — the full rs→ar→ag triple present (an
+    inter psum and an intra all_gather at the bucket's shard size).
+    Returns one message per violation (empty = contract holds).  Both
+    spellings of the check — :func:`assert_overlap_order` here and the
+    ``Finding``-style :func:`chainermn_tpu.analysis.checks.
+    check_overlap` — consume THIS list, so the contract cannot drift
+    between them.  The synchronous wire fails for any multi-bucket
+    plan (buckets pack first, then every head collective queues at the
+    tail).
+
+    Only the head's issue position is checked: a hier bucket's inter
+    psum and all-gather are data-dependent on the head (they cannot
+    issue before it completes), so the scheduler treating the triple
+    as one readiness unit is exactly what lets ``assert_overlap_order``
+    hold on the overlapped multi-hop program — and an equation from
+    ANOTHER bucket's segment legally interleaving between a bucket's
+    rs and its ar is overlap working, not a violation.
+    """
+    units = _plan_units(plan)
+    # ONE dependency-frontier walk serves both the head-delay check and
+    # the triple-completeness counts (the walk is linear in the jaxpr,
+    # which runs to thousands of eqns on real train steps)
+    all_recs = issue_report(jaxpr_like)
+    recs = [r for r in all_recs if _is_unit_head(r, units)]
     out: List[str] = []
     if len(recs) < plan.n_buckets:
         out.append(
-            f"found {len(recs)} bucket psum(s) for a "
+            f"found {len(recs)} bucket head collective(s) for a "
             f"{plan.n_buckets}-bucket plan — the program does not carry "
             "the wire's fused reductions"
         )
     for r in recs:
         if r.delay > 0:
             out.append(
-                f"bucket psum at eqn {r.index} "
+                f"bucket {r.primitive} at eqn {r.index} "
                 f"(shape {r.operand_shapes}) issued late — {r.delay} "
                 f"foreign eqn(s) after its operands were ready (eqn "
                 f"{r.ready_index}): communication is serialized behind "
                 "compute instead of overlapping the remaining backward "
                 "segments"
             )
+    # hier buckets: the rs→ar→ag triple must be complete — a psum over
+    # the INTER axis and an all_gather over the INTRA axis at shard
+    # size per hier bucket (the inter psum's operand is the encoded
+    # shard: 1-D, shard length, any dtype; the axes requirement is what
+    # keeps a same-sized flat bucket's fused psum from masking a
+    # genuinely lost inter hop)
+    hier_shards = [s for sch, _, _, _, s in units if sch == "hier_rs_ag"]
+    if hier_shards:
+        split = plan.split()
+
+        def count(prim, size, axes):
+            return sum(
+                1 for r in all_recs
+                if r.primitive == prim
+                and len(r.operand_shapes) == 1
+                and len(r.operand_shapes[0]) == 1
+                and int(r.operand_shapes[0][0]) == int(size)
+                and tuple(r.axes) == tuple(axes)
+            )
+
+        for size in sorted(set(hier_shards)):
+            want = hier_shards.count(size)
+            for prim, axes, label in (
+                ("psum", (split.inter,), "inter all-reduce"),
+                ("all_gather", (split.intra,), "intra all-gather"),
+            ):
+                got = count(prim, size, axes)
+                if got < want:
+                    out.append(
+                        f"hier_rs_ag triple incomplete: {got} {label}"
+                        f"(s) at shard size {size} for {want} hier "
+                        "bucket(s) — the multi-hop schedule lost a hop"
+                    )
     return out
 
 

@@ -242,14 +242,35 @@ def plan_for_trace(
     tree,
     base_bytes: int = DEFAULT_BUCKET_BYTES,
     max_buckets: int = DEFAULT_MAX_BUCKETS,
-) -> BucketPlan:
+    mesh=None,
+    schedule: str = "auto",
+):
     """Plan buckets for ``tree`` with the byte target / slot budget
     tuned by a :class:`CollectiveTrace`'s cost records (typically the
-    trace of the step that will ship these gradients)."""
+    trace of the step that will ship these gradients).
+
+    With ``mesh`` given, the plan additionally carries the
+    cost-model-chosen per-bucket collective schedule
+    (:func:`~chainermn_tpu.comm_wire.schedules.schedule_for_bucket` —
+    flat psum vs the hier rs→ar→ag triple) and returns a
+    :class:`~chainermn_tpu.comm_wire.schedules.WirePlan` whose hash
+    covers layout AND schedule; without it the bare
+    :class:`BucketPlan` is returned as before.
+    """
     bucket_bytes, slots = tune_wire_for_trace(
         trace.records, base_bytes, max_buckets
     )
-    return plan_of_tree(tree, bucket_bytes, slots)
+    if mesh is None:
+        return plan_of_tree(tree, bucket_bytes, slots)
+    from .codecs import WireConfig
+    from .schedules import plan_wire
+
+    return plan_wire(
+        tree,
+        WireConfig(bucket_bytes=bucket_bytes, max_buckets=slots,
+                   schedule=schedule),
+        mesh,
+    )
 
 
 def flatten_to_buckets(plan: BucketPlan, tree) -> List[jnp.ndarray]:

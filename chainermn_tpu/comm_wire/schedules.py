@@ -1,0 +1,510 @@
+"""Topology-aware multi-hop collective schedules for the gradient wire.
+
+Why
+---
+The wire has issued ONE flat ``psum`` per bucket since PR 3, regardless
+of topology — even though every
+:class:`~chainermn_tpu.analysis.trace.CollectiveRecord` carries the
+ring cost model (``bytes_on_wire``, ``hop``, ``axis_sizes``) and the
+hierarchical communicator exposes the ``('mn_inter', 'mn_intra')`` axis
+pair.  On a multi-slice topology the flat ring drags the FULL bucket
+payload across the slow inter-slice (DCN-class) links: ring all-reduce
+ships ``2p(n-1)/n`` per rank with every hop potentially crossing a
+slice boundary.  DynamiQ (PAPERS.md) shows the winning shape is a
+*multi-hop* schedule — full-precision reduce-scatter inside the fast
+island, a compressed exchange across the slow links on the
+already-reduced shard, then an intra all-gather — and "Optimizing
+Allreduce Operations for Modern Heterogeneous Architectures"
+(PAPERS.md) shows the best schedule is topology- AND payload-size-
+dependent, i.e. a per-bucket planning decision.
+
+The schedules
+-------------
+==========  ===========================================================
+schedule    collectives per bucket
+==========  ===========================================================
+flat        1 ``psum`` over every sync axis — today's wire, the default
+            and bit-compat baseline (arithmetic byte-identical to the
+            pre-schedule layer).
+hier_rs_ag  ``psum_scatter`` over ``mn_intra`` at FULL precision →
+            codec-encoded ``psum`` over ``mn_inter`` on the 1/K-sized
+            shard (the codec — bf16/f16/int8(+scale) — applies ONLY to
+            this hop, DynamiQ-style; the error-feedback residual is
+            carried per-hop at shard shape) → ``all_gather`` over
+            ``mn_intra``.  Inter-hop wire bytes drop from
+            ``2p(n-1)/n`` to ``2(p/K)(I-1)/I`` — a ~K× DCN saving —
+            for two extra intra-slice (ICI) launches.
+bcast_tree  one-to-many multicast tree for ``bcast``: masked ``psum``
+            over ``mn_inter`` (root → one leader per slice, payload
+            crosses DCN once per slice) then masked ``psum`` over
+            ``mn_intra`` (leader → slice, ICI) — replacing the single
+            flat masked psum the eager tier lowered before.  Exact
+            (the summands are the payload plus zeros), so it is
+            bit-identical to the flat spelling.
+==========  ===========================================================
+
+Selection is cost-model-driven and PURE: :func:`schedule_for_bucket` is
+a function of (payload bytes, axis names, axis sizes, requested
+schedule) only — never of values, rank, or iteration — and the chosen
+schedule lands in the :class:`WirePlan`, whose :meth:`~WirePlan.
+plan_hash` covers bucket layout AND schedule AND mesh signature, so
+``plan_agreement`` keeps every rank's schedule in lockstep exactly as
+it keeps the bucket layout.
+
+Numerics, honestly
+------------------
+``hier_rs_ag`` at full precision computes the SAME summands with the
+same mean-divide placement as ``flat``, but the reduction tree is
+reassociated (per-slice partial sums, then across slices), so on
+arbitrary float data the two differ by summation rounding order — the
+inherent cost of ANY staged all-reduce, including XLA's own internal
+decompositions.  On exactly-representable data (integer/dyadic grads —
+every partial sum exact) the schedules are bit-identical, which is what
+``tests/test_schedules.py`` pins at 0 tolerance; random-data agreement
+is pinned at float-roundoff tolerance.
+
+Degradation
+-----------
+A mesh without a genuine hierarchical split — flat axis names, a
+width-1 ``mn_inter`` (the PR 2 ragged-topology fallback), or a width-1
+intra axis — cannot stage: ``auto`` quietly plans ``flat``; an
+*explicit* ``schedule="hier_rs_ag"`` collapses to ``flat`` with a
+logged warning rather than emitting degenerate inter-hop collectives.
+"""
+
+from __future__ import annotations
+
+import warnings
+from typing import List, NamedTuple, Optional, Sequence, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+
+from .planner import Bucket, BucketPlan, plan_of_tree
+from .codecs import _CAST_WIRE, _INT8_MAX, WireConfig, _f32
+
+#: every schedule the layer knows (bcast_tree is a broadcast schedule,
+#: not selectable for the gradient wire)
+SCHEDULES = ("flat", "hier_rs_ag", "bcast_tree")
+
+#: schedules selectable per gradient bucket (WireConfig.schedule)
+GRAD_SCHEDULES = ("flat", "hier_rs_ag")
+
+# Decision threshold: inter-hop (DCN-class) wire bytes the hier
+# schedule must save for its two extra intra-slice launches to pay.
+# 64 KiB ≈ the payload at which an extra ICI collective launch
+# amortizes (the same latency-class accounting as the planner's
+# _HOP_LATENCY_SCALE: inter launches cost ~4x an intra launch, so two
+# intra launches trade against ~half an inter launch's setup).
+MIN_HIER_INTER_SAVINGS = 64 * 1024
+
+
+class AxisSplit(NamedTuple):
+    """The hierarchical factorization of a sync-axis tuple: exactly one
+    inter-named axis and one intra-named axis, both wider than 1."""
+
+    inter: str
+    intra: str
+    inter_size: int
+    intra_size: int
+
+    @property
+    def world(self) -> int:
+        return self.inter_size * self.intra_size
+
+
+def _axis_kind(name: str) -> str:
+    # mirrors analysis.trace.hop_class's per-axis naming rule
+    name = str(name)
+    if "inter" in name:
+        return "inter"
+    if "intra" in name:
+        return "intra"
+    return "flat"
+
+
+def axis_split(axes: Sequence[str],
+               axis_sizes: Sequence[int]) -> Optional[AxisSplit]:
+    """Split ``axes`` into the (inter, intra) pair a multi-hop schedule
+    stages over, or ``None`` when no genuine split exists (flat axis
+    names, missing half, or either axis of width <= 1 — the width-1
+    ``mn_inter`` ragged fallback lands here, which is what collapses
+    ``hier_rs_ag`` to ``flat``)."""
+    inter = intra = None
+    for a, s in zip(axes, axis_sizes):
+        kind = _axis_kind(a)
+        if kind == "inter":
+            if inter is not None:
+                return None  # two inter axes: no canonical split
+            inter = (str(a), int(s))
+        elif kind == "intra":
+            if intra is not None:
+                return None
+            intra = (str(a), int(s))
+        else:
+            return None  # a flat axis in the sync set: cannot stage
+    if inter is None or intra is None:
+        return None
+    if inter[1] <= 1 or intra[1] <= 1:
+        return None
+    return AxisSplit(inter[0], intra[0], inter[1], intra[1])
+
+
+def mesh_axis_sizes(mesh, axes: Sequence[str]) -> Tuple[int, ...]:
+    """Size per axis name from a ``jax.sharding.Mesh`` (or any mapping
+    with a ``shape`` dict); unknown axes size 0."""
+    shape = getattr(mesh, "shape", mesh)
+    shape = dict(shape)
+    return tuple(int(shape.get(a, 0)) for a in axes)
+
+
+def _payload_bytes_of(record) -> int:
+    """Payload bytes of a decision subject: a planner :class:`Bucket`
+    (size × dtype), an analyzer ``CollectiveRecord`` (payload_bytes),
+    or a plain int."""
+    if isinstance(record, Bucket):
+        return int(record.size) * np.dtype(record.dtype).itemsize
+    pb = getattr(record, "payload_bytes", None)
+    if pb is not None:
+        return int(pb)
+    return int(record)
+
+
+def hier_inter_savings(payload_bytes: int, split: AxisSplit) -> int:
+    """Inter-hop (slow-link) wire bytes the hier schedule saves vs the
+    flat ring, per rank — the ring formulas the cost model already
+    prices collectives with (``analysis.trace.wire_bytes``):
+
+    * flat ring all-reduce over ``n = I*K`` ranks: ``2p(n-1)/n``, every
+      hop potentially crossing a slice boundary (priced as inter);
+    * hier inter all-reduce on the scattered ``p/K`` shard over ``I``
+      slices: ``2(p/K)(I-1)/I``.
+    """
+    p = int(payload_bytes)
+    n = split.world
+    flat_inter = 2 * p * (n - 1) // n
+    shard = -(-p // split.intra_size)
+    hier_inter = 2 * shard * (split.inter_size - 1) // split.inter_size
+    return flat_inter - hier_inter
+
+
+def schedule_for_bucket(record, mesh, axes: Optional[Sequence[str]] = None,
+                        requested: str = "auto") -> str:
+    """Pick the collective schedule for one bucket — the planner-side
+    decision the ISSUE's cost-model fields exist to drive.
+
+    ``record``: a planner :class:`Bucket`, an analyzer
+    ``CollectiveRecord``, or payload bytes.  ``mesh``: the communicator
+    mesh (or an axis→size mapping).  ``axes``: the sync axes (defaults
+    to the record's own axes, else every mesh axis).  ``requested``:
+    the ``WireConfig.schedule`` knob — ``"flat"`` pins flat,
+    ``"hier_rs_ag"`` forces the multi-hop schedule wherever the mesh
+    supports it, ``"auto"`` applies the decision rule: stage when the
+    ring-formula inter-hop savings clear
+    :data:`MIN_HIER_INTER_SAVINGS` (small payloads are launch-latency-
+    bound — three collectives lose to one).
+
+    Pure function of (payload bytes, axis names, axis sizes,
+    ``requested``): every rank computes the identical schedule from its
+    local view, which is what lets the choice live in the agreed
+    :class:`WirePlan` hash.
+    """
+    if requested not in ("auto",) + GRAD_SCHEDULES:
+        raise ValueError(
+            f"unknown schedule {requested!r}; one of "
+            f"{('auto',) + GRAD_SCHEDULES}"
+        )
+    if axes is None:
+        axes = getattr(record, "axes", None) or tuple(
+            getattr(mesh, "axis_names", ()) or dict(mesh).keys()
+        )
+    axes = tuple(str(a) for a in axes)
+    split = axis_split(axes, mesh_axis_sizes(mesh, axes))
+    if split is None or requested == "flat":
+        return "flat"
+    if requested == "hier_rs_ag":
+        return "hier_rs_ag"
+    payload = _payload_bytes_of(record)
+    if hier_inter_savings(payload, split) >= MIN_HIER_INTER_SAVINGS:
+        return "hier_rs_ag"
+    return "flat"
+
+
+# ----------------------------------------------------------------------
+# the scheduled plan
+# ----------------------------------------------------------------------
+class WirePlan(NamedTuple):
+    """A :class:`~chainermn_tpu.comm_wire.planner.BucketPlan` plus the
+    planner-chosen collective schedule per bucket and the mesh-axis
+    signature the decision was made against.  ``plan_hash()`` covers
+    all three, so ``plan_agreement`` locks ranks into the same bucket
+    layout AND the same schedule — a schedule divergence would mis-pair
+    collectives exactly like a layout divergence."""
+
+    plan: BucketPlan
+    schedules: Tuple[str, ...]  # one of GRAD_SCHEDULES per bucket
+    axes: Tuple[str, ...]       # sync axes the schedules stage over
+    axis_sizes: Tuple[int, ...]
+
+    @property
+    def buckets(self):
+        return self.plan.buckets
+
+    @property
+    def n_buckets(self) -> int:
+        return self.plan.n_buckets
+
+    @property
+    def n_leaves(self) -> int:
+        return self.plan.n_leaves
+
+    def split(self) -> Optional[AxisSplit]:
+        return axis_split(self.axes, self.axis_sizes)
+
+    def padded_size(self, i: int) -> int:
+        """Bucket ``i``'s element count padded up to the intra width (a
+        ``psum_scatter`` needs an even split; the zero tail reduces to
+        zeros and is sliced off after the all-gather)."""
+        b = self.plan.buckets[i]
+        if self.schedules[i] != "hier_rs_ag":
+            return b.size
+        k = self.split().intra_size
+        return -(-b.size // k) * k
+
+    def shard_size(self, i: int) -> int:
+        """Per-rank shard length of bucket ``i`` between the intra
+        reduce-scatter and the intra all-gather (= the inter hop's
+        payload, and the shape of the per-hop EF residual)."""
+        if self.schedules[i] != "hier_rs_ag":
+            return self.plan.buckets[i].size
+        return self.padded_size(i) // self.split().intra_size
+
+    def schedule_census(self) -> dict:
+        """``{schedule: bucket count}`` — the bench fingerprint."""
+        out: dict = {}
+        for s in self.schedules:
+            out[s] = out.get(s, 0) + 1
+        return out
+
+    def plan_hash(self) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(self.plan.plan_hash().encode())
+        h.update(("|sched=" + ",".join(self.schedules)).encode())
+        h.update(("|axes=" + ",".join(
+            f"{a}:{s}" for a, s in zip(self.axes, self.axis_sizes)
+        )).encode())
+        return h.hexdigest()
+
+    def describe(self) -> str:
+        return " ".join(
+            f"[{i}]{b.dtype}x{b.size}:{s}"
+            for i, (b, s) in enumerate(zip(self.plan.buckets,
+                                           self.schedules))
+        )
+
+
+def plan_wire(tree, wire: WireConfig, mesh,
+              axes: Optional[Sequence[str]] = None) -> WirePlan:
+    """Plan buckets AND per-bucket schedules for ``tree``'s gradient
+    wire over ``mesh``'s ``axes`` — the schedule-aware successor of
+    :func:`~chainermn_tpu.comm_wire.planner.plan_of_tree` the optimizer
+    tiers call.  Pure function of (leaf shapes/dtypes, wire knobs, axis
+    names+sizes): the returned plan's hash is the cross-process
+    agreement token.
+
+    An explicit ``wire.schedule="hier_rs_ag"`` on a mesh with no
+    genuine split — notably the width-1 ``mn_inter`` ragged-topology
+    fallback — collapses to ``flat`` with ONE logged warning (not one
+    per bucket), instead of emitting degenerate inter-hop collectives.
+    """
+    if axes is None:
+        axes = tuple(getattr(mesh, "axis_names", ()) or dict(mesh).keys())
+    axes = tuple(str(a) for a in axes)
+    sizes = mesh_axis_sizes(mesh, axes)
+    plan = plan_of_tree(tree, wire.bucket_bytes, wire.max_buckets)
+    requested = getattr(wire, "schedule", "auto") or "auto"
+    split = axis_split(axes, sizes)
+    if requested == "hier_rs_ag" and split is None:
+        warnings.warn(
+            "wire schedule 'hier_rs_ag' requested but the sync axes "
+            f"{axes} (sizes {sizes}) carry no genuine (inter, intra) "
+            "split — a width-1 'mn_inter' axis (ragged-topology "
+            "fallback) or a flat mesh cannot stage; collapsing every "
+            "bucket to the 'flat' schedule."
+        )
+    scheds = tuple(
+        schedule_for_bucket(b, dict(zip(axes, sizes)), axes=axes,
+                            requested=requested)
+        for b in plan.buckets
+    )
+    return WirePlan(plan=plan, schedules=scheds, axes=axes,
+                    axis_sizes=sizes)
+
+
+# ----------------------------------------------------------------------
+# scheduled reduction (compiled tier)
+# ----------------------------------------------------------------------
+def zero_residuals_wire(wplan: WirePlan) -> Tuple[jnp.ndarray, ...]:
+    """Zero error-feedback carry matching ``wplan``: full bucket shape
+    for flat buckets, per-hop SHARD shape for ``hier_rs_ag`` buckets
+    (the residual lives at the compression point — the inter hop's
+    scattered payload — not at full bucket width)."""
+    out = []
+    for i, b in enumerate(wplan.buckets):
+        n = (wplan.shard_size(i)
+             if wplan.schedules[i] == "hier_rs_ag" else b.size)
+        out.append(jnp.zeros((n,), jnp.dtype(b.dtype)))
+    return tuple(out)
+
+
+def _reduce_hier(items, wplan: WirePlan, n: int, config: WireConfig,
+                 residuals) -> Tuple[list, list]:
+    """Multi-hop reduction of the hier-scheduled buckets.
+
+    ``items``: list of ``(plan_index, flat_bucket)``.  Per bucket:
+    zero-pad to the intra width, full-precision ``psum_scatter`` over
+    the intra axis, add the carried per-hop residual, encode with the
+    codec, ``psum`` over the inter axis, decode, mean-divide in the
+    native dtype (off the wire, same rule as the flat codecs),
+    ``all_gather`` over the intra axis, slice the pad off.  int8's
+    absmax agreement is ONE batched ``pmax`` over the inter axis for
+    ALL hier buckets (the flat tier's one-extra-collective contract,
+    applied per schedule class).
+    """
+    split = wplan.split()
+    assert split is not None, "hier schedule planned without a split"
+    codec = config.codec
+    ef = bool(config.error_feedback) and codec not in ("none", "f32")
+    wire_dtype = _CAST_WIRE.get(codec)
+
+    # hop 1: full-precision intra reduce-scatter (+ per-hop EF carry)
+    locals_ = []
+    for i, g in items:
+        pad = wplan.padded_size(i) - g.shape[0]
+        gp = jnp.pad(g, (0, pad)) if pad else g
+        local = lax.psum_scatter(
+            gp, split.intra, scatter_dimension=0, tiled=True
+        )
+        if residuals is not None:
+            local = local + residuals[i].astype(local.dtype)
+        locals_.append(local)
+
+    means = {}
+    new_res = {}
+    if codec == "int8":
+        # one batched scale agreement over the INTER axis for all hier
+        # buckets: the integer sum crosses only inter, so only inter
+        # peers (the ranks holding the same shard) must share the grid
+        absmax = jnp.stack([jnp.max(jnp.abs(_f32(l))) for l in locals_])
+        shared = lax.pmax(absmax, (split.inter,))
+        scales = shared / _INT8_MAX
+        for k, ((i, g), local) in enumerate(zip(items, locals_)):
+            s = scales[k]
+            safe = jnp.where(s > 0, s, 1.0)
+            q = jnp.clip(
+                jnp.round(_f32(local) / safe), -_INT8_MAX, _INT8_MAX
+            ).astype(jnp.int8)
+            summed = lax.psum(q.astype(jnp.int32), (split.inter,))
+            shard_mean = ((_f32(summed) * s) / n).astype(g.dtype)
+            out = lax.all_gather(
+                shard_mean, split.intra, axis=0, tiled=True
+            )
+            means[i] = out[: g.shape[0]]
+            if ef:
+                new_res[i] = (_f32(local) - _f32(q) * s).astype(g.dtype)
+    else:
+        for (i, g), local in zip(items, locals_):
+            w = local if wire_dtype is None else local.astype(wire_dtype)
+            summed = lax.psum(w, (split.inter,))
+            # decode FIRST, divide in the native dtype (codecs rule:
+            # the psum result is already off the wire)
+            shard_mean = summed.astype(g.dtype) / n
+            out = lax.all_gather(
+                shard_mean, split.intra, axis=0, tiled=True
+            )
+            means[i] = out[: g.shape[0]]
+            if ef:
+                new_res[i] = local - w.astype(local.dtype)
+    return means, new_res
+
+
+def reduce_wire(
+    buckets: Sequence[jnp.ndarray],
+    wplan: WirePlan,
+    n: int,
+    config: WireConfig,
+    residuals: Optional[Sequence[jnp.ndarray]] = None,
+) -> Tuple[List[jnp.ndarray], List[jnp.ndarray]]:
+    """Mean-reduce flat wire buckets under ``wplan``'s per-bucket
+    schedules — the scheduled successor of
+    :func:`~chainermn_tpu.comm_wire.codecs.reduce_buckets` (which it
+    delegates to, arithmetic-identically, for the flat-scheduled
+    subset, so an all-flat plan stays bit-compatible with the
+    pre-schedule wire).  Returns ``(means, new_residuals)`` in plan
+    order; residual entries are shard-shaped for hier buckets.
+
+    Must be called under bound mesh axes (shard_map).
+    """
+    from .codecs import reduce_buckets
+
+    ef = bool(config.error_feedback) and config.codec not in (
+        "none", "f32"
+    )
+    buckets = list(buckets)
+    if not buckets:
+        return [], []
+    flat_items = [
+        (i, g) for i, g in enumerate(buckets)
+        if wplan.schedules[i] != "hier_rs_ag"
+    ]
+    hier_items = [
+        (i, g) for i, g in enumerate(buckets)
+        if wplan.schedules[i] == "hier_rs_ag"
+    ]
+    means: dict = {}
+    new_res: dict = {}
+    if flat_items:
+        sub_res = (
+            [residuals[i] for i, _ in flat_items] if residuals else None
+        )
+        m, r = reduce_buckets(
+            [g for _, g in flat_items], wplan.axes, n, config, sub_res
+        )
+        for (i, _), mi in zip(flat_items, m):
+            means[i] = mi
+        for (i, _), ri in zip(flat_items, r):
+            new_res[i] = ri
+    if hier_items:
+        m, r = _reduce_hier(hier_items, wplan, n, config, residuals)
+        means.update(m)
+        new_res.update(r)
+    out_means = [means[i] for i in range(len(buckets))]
+    out_res = [new_res[i] for i in range(len(buckets))] if ef else []
+    return out_means, out_res
+
+
+# ----------------------------------------------------------------------
+# bcast tree (eager tier)
+# ----------------------------------------------------------------------
+def bcast_tree_stages(axes: Sequence[str],
+                      axis_sizes: Sequence[int]) -> Tuple[Tuple[str, ...],
+                                                          ...]:
+    """Masked-psum stage axes for a broadcast over ``axes``.
+
+    On a genuine hierarchical split the flat masked psum becomes the
+    ``bcast_tree`` schedule — ``((inter,), (intra,))``: the first
+    masked psum ships the payload across slices ONCE (root → the
+    leader at root's intra position in every slice), the second spreads
+    it over ICI inside each slice.  The staged sum adds only zeros to
+    the payload, so the result is bit-identical to the flat spelling.
+    Everything else (flat meshes, width-1 inter) keeps the one-stage
+    ``(axes,)`` form.
+    """
+    axes = tuple(str(a) for a in axes)
+    split = axis_split(axes, axis_sizes)
+    if split is None:
+        return (axes,)
+    return ((split.inter,), (split.intra,))

@@ -67,7 +67,10 @@ def create_communicator(
       allreduce_grad_dtype: optional reduced precision (e.g. ``bfloat16`` /
         ``float16``) for gradient allreduce, as in PureNcclCommunicator.
       **kwargs: variant-specific options (e.g. ``tp_size`` for ``hybrid``,
-        ``sp_size``/``tp_size`` for ``mesh``).
+        ``sp_size``/``tp_size`` for ``mesh``; XLA-tier communicators
+        accept ``wire_schedule="auto"|"flat"|"hier_rs_ag"`` — the eager
+        ``allreduce_grad``'s multi-hop schedule knob, ``"flat"`` pinning
+        the bit-compat single-psum baseline).
     """
     try:
         cls = _COMMUNICATORS[communicator_name]

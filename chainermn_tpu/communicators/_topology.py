@@ -32,10 +32,27 @@ def _node_key(device: Any) -> Any:
     Prefer the TPU slice index (chips within a slice are ICI-connected, the
     moral equivalent of "same node" for collective topology); fall back to the
     owning host process.
+
+    ``CHAINERMN_TPU_FAKE_SLICE_SIZE=<k>`` groups devices that carry NO
+    real slice index into synthetic slices of ``k`` by device id — how
+    the CPU-mesh bench rungs and tests exercise the hierarchical
+    (multi-hop schedule) paths on a single host.  Devices with a real
+    ``slice_index`` are never regrouped, so the knob cannot mislabel an
+    actual TPU topology.
     """
+    import os
+
     slice_index = getattr(device, "slice_index", None)
     if slice_index is not None:
         return ("slice", slice_index)
+    fake = os.environ.get("CHAINERMN_TPU_FAKE_SLICE_SIZE")
+    if fake:
+        try:
+            k = int(fake)
+        except ValueError:
+            k = 0
+        if k > 0:
+            return ("slice", device.id // k)
     return ("process", device.process_index)
 
 
@@ -76,6 +93,20 @@ class Topology:
     def create(cls, devices: Sequence[Any]) -> "Topology":
         devs = sort_devices(devices)
         keys = [_node_key(d) for d in devs]
+        if (
+            len(set(keys)) == 1
+            and len({d.process_index for d in devs}) > 1
+            and all(getattr(d, "platform", "") == "cpu" for d in devs)
+        ):
+            # The CPU backend reports slice_index=0 for EVERY device of
+            # a multi-process (gloo) world — a degenerate single-slice
+            # claim, not a real ICI island.  Fall back to the
+            # reference's hostname grouping (one node per process) so
+            # hierarchical layouts factorize across hosts, exactly as
+            # ChainerMN's init_ranks did.  Real TPU slices spanning
+            # several hosts (platform "tpu") are untouched: a
+            # multi-host slice IS one ICI island.
+            keys = [("process", d.process_index) for d in devs]
         unique_keys: list = []
         for k in keys:
             if k not in unique_keys:

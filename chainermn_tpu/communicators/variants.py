@@ -83,6 +83,18 @@ class HierarchicalCommunicator(XlaCommunicatorBase):
     ('mn_inter', 'mn_intra') axes — XLA schedules the reduction
     hierarchically along the mesh, with the intra axis on ICI and the inter
     axis on DCN.
+
+    The axis pair is also the substrate of the AUTHORED multi-hop
+    schedules (``comm_wire.schedules``, ISSUE 11): the gradient wire's
+    ``hier_rs_ag`` buckets stage a full-precision intra reduce-scatter,
+    a codec-compressed inter all-reduce on the 1/K shard, and an intra
+    all-gather; the eager ``bcast`` lowers as the two-stage
+    ``bcast_tree`` multicast (inter root->leaders, intra
+    leaders->slices) instead of one flat masked psum; and the eager
+    ``allreduce_grad`` routes cost-model-qualified buckets through the
+    staged program.  On the ragged fallback below the width-1
+    ``mn_inter`` axis disqualifies every staged schedule (the planner
+    collapses them to flat, loudly for explicit requests).
     """
 
     def _build_mesh(self) -> Mesh:

@@ -64,6 +64,7 @@ class XlaCommunicatorBase(CommunicatorBase):
         devices: Optional[Sequence] = None,
         allreduce_grad_dtype=None,
         *,
+        wire_schedule: str = "auto",
         _topology: Optional[Topology] = None,
     ):
         if _topology is None:
@@ -76,6 +77,21 @@ class XlaCommunicatorBase(CommunicatorBase):
             if allreduce_grad_dtype is not None
             else None
         )
+        # eager-tier schedule knob (the analogue of the compiled wire's
+        # WireConfig.schedule): "auto" lets the cost model stage
+        # qualifying allreduce_grad buckets onto the multi-hop program
+        # on hierarchical meshes, "flat" pins the single-psum baseline
+        # (bit-compat with pre-schedule releases — the staged reduction
+        # reassociates the summation tree), "hier_rs_ag" forces staging
+        # wherever the mesh supports it.
+        from ..comm_wire.schedules import GRAD_SCHEDULES
+
+        if wire_schedule not in ("auto",) + GRAD_SCHEDULES:
+            raise ValueError(
+                f"unknown wire_schedule {wire_schedule!r}; one of "
+                f"{('auto',) + GRAD_SCHEDULES}"
+            )
+        self._wire_schedule = wire_schedule
         self._mesh = self._build_mesh()
         self._obj_store = create_obj_store(
             self.size, self.process_count,
@@ -164,13 +180,36 @@ class XlaCommunicatorBase(CommunicatorBase):
         return out
 
     @functools.cached_property
+    def _hier_split(self):
+        """The mesh's (inter, intra) axis split, or None on flat /
+        degenerate meshes — the input to every eager schedule choice
+        (``comm_wire.schedules``)."""
+        from ..comm_wire import axis_split, mesh_axis_sizes
+
+        axes = self.axis_names
+        return axis_split(axes, mesh_axis_sizes(self._mesh, axes))
+
+    @functools.cached_property
     def _bcast_fn(self):
+        # bcast_tree schedule (ISSUE 11): on a hierarchical mesh the
+        # single flat masked psum becomes a two-stage multicast tree —
+        # masked psum over mn_inter (root -> one leader per slice: the
+        # payload crosses the DCN-class links once per slice), then
+        # masked psum over mn_intra (leader -> slice, ICI).  The staged
+        # sum only adds zeros to the payload, so the result is
+        # bit-identical to the flat spelling; flat meshes (and the
+        # width-1-inter ragged fallback) keep the one-stage form.
+        from ..comm_wire import bcast_tree_stages, mesh_axis_sizes
+
         axes, shape = self.axis_names, dict(self._mesh.shape)
+        stages = bcast_tree_stages(axes, mesh_axis_sizes(self._mesh, axes))
 
         def f(x, root):
             me = _linear_rank(axes, shape)
             masked = jnp.where(me == root, x, jnp.zeros_like(x))
-            return lax.psum(masked, axes)
+            for stage_axes in stages:
+                masked = lax.psum(masked, stage_axes)
+            return masked
 
         spec = self._stack_spec
         return jax.jit(
@@ -371,6 +410,40 @@ class XlaCommunicatorBase(CommunicatorBase):
             fns[op] = self._shard(f)
         return fns
 
+    @functools.cached_property
+    def _allreduce_grad_hier_fns(self):
+        """Eager multi-hop bucket reduction (``hier_rs_ag``,
+        comm_wire.schedules): full-precision ``psum_scatter`` over the
+        intra (ICI) axis, the ``allreduce_grad_dtype`` cast applied to
+        the inter (DCN-class) hop only, intra ``all_gather`` — the
+        eager analogue of the compiled wire's staged schedule.  Only
+        built on meshes with a genuine (inter, intra) split."""
+        split = self._hier_split
+        dt = self._allreduce_grad_dtype
+        size = self.size
+        fns = {}
+        for op in ("sum", "mean"):
+            def f(x, _op=op):  # per-shard (1, cols)
+                row = jnp.squeeze(x, 0)
+                cols = row.shape[0]
+                pad = (-cols) % split.intra_size
+                rp = jnp.pad(row, (0, pad)) if pad else row
+                local = lax.psum_scatter(
+                    rp, split.intra, scatter_dimension=0, tiled=True
+                )
+                w = local if dt is None else local.astype(dt)
+                summed = lax.psum(w, (split.inter,))
+                r = summed.astype(row.dtype)
+                if _op == "mean":
+                    r = r / size
+                out = lax.all_gather(
+                    r, split.intra, axis=0, tiled=True
+                )
+                return out[:cols][None]
+
+            fns[op] = self._shard(f)
+        return fns
+
     def allreduce_grad(self, grads, *, mean: bool = True):
         """Bucketed eager gradient allreduce on stacked arrays.
 
@@ -378,7 +451,11 @@ class XlaCommunicatorBase(CommunicatorBase):
         bucket plan and each bucket ships through ONE compiled
         collective program — the eager tier's analogue of the compiled
         path's flat wire (one launch per bucket instead of per leaf,
-        and a bounded number of cached jit programs).
+        and a bounded number of cached jit programs).  On a
+        hierarchical mesh, buckets the cost model stages (ISSUE 11 —
+        ``schedule_for_bucket``) ride the multi-hop rs→ar→ag program
+        instead of the flat psum; the per-rank arithmetic is the same
+        mean with the wire cast moved to the inter hop only.
         """
         from .. import comm_wire as _cw
 
@@ -396,6 +473,23 @@ class XlaCommunicatorBase(CommunicatorBase):
         per_rank = [l[0] if hasattr(l, "shape") and np.ndim(l) else l
                     for l in leaves]
         plan = _cw.make_plan(per_rank)
+        split = self._hier_split
+
+        def bucket_fn(b):
+            """The compiled program for one bucket — flat psum, or the
+            staged hier program when the communicator's ``wire_schedule``
+            knob (default "auto": the cost model) schedules it — a pure
+            function of bucket bytes + mesh + knob, so every process
+            picks the same program."""
+            if split is None or self._wire_schedule == "flat":
+                return fn
+            payload = int(b.size) * np.dtype(b.dtype).itemsize
+            if _cw.schedule_for_bucket(
+                payload, self._mesh, axes=self.axis_names,
+                requested=self._wire_schedule,
+            ) == "hier_rs_ag":
+                return self._allreduce_grad_hier_fns[op]
+            return fn
 
         def run():
             # telemetry: per-bucket wire.ship / collective.psum spans
@@ -418,7 +512,10 @@ class XlaCommunicatorBase(CommunicatorBase):
                 # Reduction order and arithmetic are unchanged:
                 # bit-identical to the serial schedule.
                 staged = [self._put(cat) for cat in packed]
-                red = [fn(s) for s in staged]
+                red = [
+                    bucket_fn(plan.buckets[k])(s)
+                    for k, s in enumerate(staged)
+                ]
             else:
                 with _obs.span("collective.allreduce_grad",
                                buckets=plan.n_buckets):
@@ -435,7 +532,7 @@ class XlaCommunicatorBase(CommunicatorBase):
                             "collective.psum", bucket=k,
                             bytes=b.size * np.dtype(b.dtype).itemsize,
                         ):
-                            r = fn(s)
+                            r = bucket_fn(b)(s)
                             jax.block_until_ready(r)
                         red.append(r)
             out = _cw.unpack_stacked(

@@ -99,25 +99,35 @@ def _sync_grads_per_leaf(grads, comm, comm_dtype=None, axes=None):
 
 
 def _sync_grads_wire(grads, comm, wire, axes=None, residuals=None):
-    """Bucketed flat-wire gradient sync: flatten the grad pytree into
-    the deterministic bucket plan, ONE collective per bucket, unflatten.
+    """Bucketed wire gradient sync: flatten the grad pytree into the
+    deterministic bucket plan, reduce each bucket under its planner-
+    chosen collective schedule (``comm_wire.schedules`` — ONE flat psum
+    per bucket, or the hier rs→ar→ag triple with the codec on the
+    inter hop only), unflatten.
 
     Returns ``(synced_tree, new_residuals)``; ``new_residuals`` is ()
     unless ``wire.error_feedback``.  Element order within a bucket is
-    tree-flatten order, so the uncompressed bucketed psum is
+    tree-flatten order, so the uncompressed flat-scheduled psum is
     bit-identical to the per-leaf psum (elementwise reduction — grouping
     changes neither summands nor rank order; pinned at 0 tolerance by
-    tests/test_comm_wire.py)."""
+    tests/test_comm_wire.py).  The hier schedule reassociates the
+    reduction tree (per-slice partial sums), which is exact on
+    exactly-representable data (pinned at 0 tolerance by
+    tests/test_schedules.py) and differs only by summation rounding
+    order otherwise."""
     from . import comm_wire as _cw
 
     axes = comm.axis_names if axes is None else tuple(axes)
     n = _axis_size(comm, axes)
-    plan = _cw.plan_of_tree(grads, wire.bucket_bytes, wire.max_buckets)
-    buckets = _cw.flatten_to_buckets(plan, grads)
-    means, new_res = _cw.reduce_buckets(
-        buckets, axes, n, wire, residuals if residuals else None
+    wplan = _cw.plan_wire(grads, wire, comm.mesh, axes)
+    buckets = _cw.flatten_to_buckets(wplan.plan, grads)
+    means, new_res = _cw.reduce_wire(
+        buckets, wplan, n, wire, residuals if residuals else None
     )
-    return _cw.unflatten_from_buckets(plan, means, grads), tuple(new_res)
+    return (
+        _cw.unflatten_from_buckets(wplan.plan, means, grads),
+        tuple(new_res),
+    )
 
 
 def _sync_grads(grads, comm, comm_dtype=None, axes=None, wire="auto"):
@@ -194,12 +204,30 @@ class _MultiNodeOptimizer:
     """
 
     def __init__(self, actual_optimizer: optax.GradientTransformation,
-                 comm, wire="auto", overlap="none"):
+                 comm, wire="auto", overlap="none", tune_trace=None):
         from .comm_wire import resolve_overlap, resolve_wire
+        from .comm_wire.planner import tune_wire_for_trace
 
         self._opt = actual_optimizer
         self._comm = comm
         self._wire = resolve_wire(wire, comm)  # None => per-leaf legacy
+        if (
+            self._wire is not None
+            and tune_trace is not None
+            and wire in (None, "auto")
+        ):
+            # ISSUE 11 satellite: `wire="auto"` with a measured trace in
+            # hand consults the cost-model tuner (PR 6's
+            # tune_wire_for_trace — built but production-unconsumed
+            # until now) instead of the fixed 4 MiB/6-bucket constants:
+            # the byte target scales with the worst hop class the
+            # trace's reductions cross, and a small total collapses the
+            # slot budget to 1.
+            records = getattr(tune_trace, "records", tune_trace)
+            bucket_bytes, max_buckets = tune_wire_for_trace(records)
+            self._wire = self._wire._replace(
+                bucket_bytes=bucket_bytes, max_buckets=max_buckets
+            )
         self._overlap = resolve_overlap(overlap)
 
     @property
@@ -229,8 +257,11 @@ class _MultiNodeOptimizer:
         w = self._wire
         if w is None or not w.error_feedback:
             return ()
-        plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
-        return _cw.zero_residuals(plan, params)
+        # schedule-aware shapes: a hier bucket's residual lives at the
+        # compression point (the inter hop's scattered shard), not at
+        # full bucket width
+        wplan = _cw.plan_wire(params, w, self._comm.mesh)
+        return _cw.zero_residuals_wire(wplan)
 
     def _check_plan_agreement(self, params):
         """Cross-process plan guard at init time: in a multi-controller
@@ -248,7 +279,14 @@ class _MultiNodeOptimizer:
         leaves = jax.tree_util.tree_leaves(params)
         if any(isinstance(l, jax.core.Tracer) for l in leaves):
             return
-        plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
+        # the exchanged hash covers bucket layout AND the per-bucket
+        # collective schedule (WirePlan.plan_hash): ranks scheduling
+        # apart would mis-pair collectives exactly like a layout split
+        mesh = getattr(comm, "mesh", None)
+        if mesh is not None:
+            plan = _cw.plan_wire(params, w, mesh)
+        else:
+            plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
         _cw.plan_agreement(comm, plan)
 
     def init(self, params):
@@ -267,6 +305,36 @@ class _MultiNodeOptimizer:
         axes = comm.axis_names if sync_axes is None else tuple(sync_axes)
         residual = getattr(state, "wire_residual", ())
         if axes and _axes_bound(axes) and not _no_exchange(comm):
+            if residual and axes != tuple(comm.axis_names):
+                # The residual carry was shaped by init against the
+                # FULL mesh axes; a different sync-axis set can
+                # re-schedule a bucket between hier (shard-width
+                # residual) and flat (full-width), silently mis-shaping
+                # the add.  Only an ACTUAL shape flip is an error —
+                # meshes where neither axis set can stage keep their
+                # axes-independent flat residuals and stay legal — and
+                # the check lives INSIDE the sync branch: a skipped
+                # sync (no-exchange A/B, eager path) never touches the
+                # residual, so it must not raise (trace-time cost only).
+                from . import comm_wire as _cw
+
+                def res_shapes(wp):
+                    return tuple(
+                        wp.shard_size(i) for i in range(wp.n_buckets)
+                    )
+
+                full = _cw.plan_wire(grads, self._wire, comm.mesh)
+                sub = _cw.plan_wire(grads, self._wire, comm.mesh, axes)
+                if res_shapes(full) != res_shapes(sub):
+                    raise ValueError(
+                        "error_feedback cannot sync over the axis "
+                        f"subset {axes}: the residual carry was "
+                        "planned against the full mesh axes "
+                        f"{tuple(comm.axis_names)}, and the subset "
+                        "re-schedules the buckets onto different "
+                        "residual shapes "
+                        f"({res_shapes(full)} vs {res_shapes(sub)})"
+                    )
             if self._wire is None:
                 grads = _sync_grads_per_leaf(
                     grads, comm, comm.allreduce_grad_dtype, axes=axes
@@ -301,21 +369,24 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
     buffer swap).
     """
 
-    def _plan(self, tree):
+    def _plan(self, tree, axes=None):
+        """Schedule-aware wire plan (``WirePlan``): the stale buckets
+        are stored flat either way, but the SYNC of the previous step's
+        buckets follows the planner-chosen schedule like the plain
+        wrapper's."""
         from . import comm_wire as _cw
 
-        w = self._wire
-        return _cw.plan_of_tree(tree, w.bucket_bytes, w.max_buckets)
+        return _cw.plan_wire(tree, self._wire, self._comm.mesh, axes)
 
-    def _store(self, plan, tree):
+    def _store(self, wplan, tree):
         """Flatten grads into the stale-grad buffer: flat buckets in the
         wire's storage dtype (half the state bytes for cast codecs)."""
         from . import comm_wire as _cw
 
-        buckets = _cw.flatten_to_buckets(plan, tree)
+        buckets = _cw.flatten_to_buckets(wplan.plan, tree)
         return tuple(
             b.astype(_cw.storage_dtype(self._wire, spec.dtype))
-            for b, spec in zip(buckets, plan.buckets)
+            for b, spec in zip(buckets, wplan.buckets)
         )
 
     def init(self, params):
@@ -323,8 +394,8 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
         if self._wire is None:  # legacy per-leaf wire: param-shaped tree
             prev = jax.tree_util.tree_map(jnp.zeros_like, params)
         else:
-            plan = self._plan(params)
-            prev = self._store(plan, jax.tree_util.tree_map(
+            wplan = self._plan(params)
+            prev = self._store(wplan, jax.tree_util.tree_map(
                 jnp.zeros_like, params
             ))
         return DoubleBufferingState(
@@ -347,19 +418,22 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
                 )
             new_prev = grads
         else:
-            plan = self._plan(grads)
+            wplan = self._plan(grads, axes)
             # stored buckets back to the plan's native dtype: the codec
             # re-casts onto the wire itself, the decode stays native
             prev_buckets = [
                 b.astype(jnp.dtype(spec.dtype))
-                for b, spec in zip(state.prev_grads, plan.buckets)
+                for b, spec in zip(state.prev_grads, wplan.buckets)
             ]
             if do_sync:
-                prev_buckets, _ = _cw.reduce_buckets(
-                    prev_buckets, axes, _axis_size(comm, axes), self._wire
+                prev_buckets, _ = _cw.reduce_wire(
+                    prev_buckets, wplan, _axis_size(comm, axes),
+                    self._wire,
                 )
-            prev = _cw.unflatten_from_buckets(plan, prev_buckets, grads)
-            new_prev = self._store(plan, grads)
+            prev = _cw.unflatten_from_buckets(
+                wplan.plan, prev_buckets, grads
+            )
+            new_prev = self._store(wplan, grads)
         updates, inner = self._opt.update(prev, state.inner_state, params)
         return updates, DoubleBufferingState(inner, state.step + 1, new_prev)
 
@@ -533,7 +607,67 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
         if _axes_bound(axes):
             idx = lax.axis_index(axes)
 
-            def scatter(g):
+            # ISSUE 11: ZeRO's blocked path grows the same per-bucket
+            # schedule choice as the flat wire.  A hier-scheduled
+            # scatter stages intra-slice first (full precision, ICI)
+            # and crosses the inter (DCN-class) links only with the
+            # 1/K-reduced partial — wire-cast on that hop alone — via a
+            # LOCAL block transpose that keeps ownership linear (rank
+            # i*K+j still owns block i*K+j), so the state layout, the
+            # elastic resharder, and state_partition_spec are untouched.
+            from .comm_wire import (
+                axis_split as _axis_split,
+                mesh_axis_sizes as _mesh_sizes,
+                schedule_for_bucket as _sched_for,
+            )
+
+            split = _axis_split(axes, _mesh_sizes(comm.mesh, axes))
+            requested = (
+                getattr(self._wire, "schedule", "auto")
+                if self._wire is not None else "flat"
+            )
+            if requested == "hier_rs_ag" and split is None:
+                import warnings
+
+                warnings.warn(
+                    "zero_redundancy wire schedule 'hier_rs_ag' "
+                    f"requested but axes {axes} carry no genuine "
+                    "(inter, intra) split (width-1 'mn_inter' ragged "
+                    "fallback or flat mesh); collapsing to 'flat'."
+                )
+            sizes_env = dict(zip(axes, _mesh_sizes(comm.mesh, axes)))
+
+            def _hier(payload_bytes: int) -> bool:
+                if self._wire is None or split is None:
+                    return False
+                return _sched_for(
+                    payload_bytes, sizes_env, axes=axes,
+                    requested=requested,
+                ) == "hier_rs_ag"
+
+            def _y_order(g):
+                # y[j*I+i] = g[i*K+j]: after intra-then-inter staged
+                # scatters, rank (i, j) lands on y-row j*I+i = its own
+                # linear block i*K+j — ownership unchanged
+                i_, k_ = split.inter_size, split.intra_size
+                return g.reshape(i_, k_, -1).transpose(1, 0, 2).reshape(
+                    g.shape[0], -1
+                )
+
+            def scatter(g, hier=False):
+                if hier:
+                    part = lax.psum_scatter(  # intra hop, full precision
+                        _y_order(g), split.intra, scatter_dimension=0,
+                        tiled=True,
+                    )
+                    pw = (
+                        part.astype(wire_dtype)
+                        if wire_dtype is not None else part
+                    )
+                    local = lax.psum_scatter(  # inter hop, on the wire
+                        pw, split.inter, scatter_dimension=0, tiled=False
+                    )
+                    return (local.astype(g.dtype) / n)[None]
                 gw = g.astype(wire_dtype) if wire_dtype is not None else g
                 local = lax.psum_scatter(
                     gw, axes, scatter_dimension=0, tiled=False
@@ -541,13 +675,32 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
                 # mean in the native dtype, not on the wire
                 return (local.astype(g.dtype) / n)[None]
 
-            def gather(u):
+            def gather(u, hier=False):
+                if hier:
+                    i_, k_ = split.inter_size, split.intra_size
+                    a = lax.all_gather(  # inter hop: rebuild the chunk
+                        jnp.squeeze(u, 0), split.inter, axis=0,
+                        tiled=False,
+                    )
+                    z = lax.all_gather(  # intra hop: rebuild y-order
+                        a, split.intra, axis=0, tiled=True
+                    )
+                    return z.reshape(k_, i_, -1).transpose(
+                        1, 0, 2
+                    ).reshape(z.shape[0], -1)
                 return lax.all_gather(u, axes, axis=0, tiled=True)
+
+            def _leaf_hier(g):
+                return _hier(int(np.prod(g.shape)) * g.dtype.itemsize)
 
             leaves, treedef = jax.tree_util.tree_flatten(g_blocks)
             if self._wire is None or len(leaves) <= 1:
-                local_g = tree_map(scatter, g_blocks)
-                gather_blocks = lambda upd: tree_map(gather, upd)  # noqa: E731
+                local_g = tree_map(
+                    lambda g: scatter(g, _leaf_hier(g)), g_blocks
+                )
+                gather_blocks = lambda upd: tree_map(  # noqa: E731
+                    lambda u, g: gather(u, _leaf_hier(g)), upd, g_blocks
+                )
             else:
                 # Bucketed wire: concatenate blocked leaves column-wise
                 # into dtype-homogeneous buckets -> ONE reduce-scatter
@@ -558,14 +711,20 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
                 # so comm_wire.pack_stacked's flat (size, -1) layout
                 # does not apply.
                 plan = self._wire_groups(leaves)
+
+                def _bucket_hier(b):
+                    return _hier(
+                        int(b.size) * jnp.dtype(b.dtype).itemsize
+                    )
+
                 local_leaves = [None] * len(leaves)
                 packed = []
                 for b in plan.buckets:
                     cat = jnp.concatenate(
                         [leaves[s.index] for s in b.slots], axis=1
                     )
-                    packed.append((b, scatter(cat)))  # (1, K)
-                for b, loc in packed:
+                    packed.append((b, scatter(cat, _bucket_hier(b))))
+                for b, loc in packed:  # loc: (1, K)
                     col = 0
                     for s in b.slots:
                         k = s.shape[1]
@@ -581,7 +740,7 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
                     for b in plan.buckets:
                         cat = gather(jnp.concatenate(
                             [up_leaves[s.index] for s in b.slots], axis=1
-                        ))
+                        ), _bucket_hier(b))
                         col = 0
                         for s in b.slots:
                             k = s.shape[1]
@@ -618,6 +777,7 @@ def create_multi_node_optimizer(
     zero_redundancy: bool = False,
     wire="auto",
     overlap="none",
+    tune_trace=None,
 ) -> _MultiNodeOptimizer:
     """Wrap an optax optimizer for multi-chip training.
 
@@ -638,11 +798,37 @@ def create_multi_node_optimizer(
       as the A/B baseline and escape hatch.
     * a codec name (``"none"``/``"f32"``/``"bf16"``/``"f16"``/
       ``"int8"``) or a :class:`~chainermn_tpu.comm_wire.WireConfig`
-      (codec + bucket_bytes + max_buckets + error_feedback) — explicit
-      control.  ``int8`` ships 1 byte/element plus one f32 scale per
-      bucket; combine with ``error_feedback=True`` so rounding error is
-      carried into the next step (fp32-equivalent convergence, pinned
-      by the MLP convergence test).
+      (codec + bucket_bytes + max_buckets + error_feedback +
+      schedule) — explicit control.  ``int8`` ships 1 byte/element
+      plus one f32 scale per bucket; combine with
+      ``error_feedback=True`` so rounding error is carried into the
+      next step (fp32-equivalent convergence, pinned by the MLP
+      convergence test).
+
+    ``WireConfig.schedule`` (``"auto"``/``"flat"``/``"hier_rs_ag"``)
+    selects the per-bucket collective schedule
+    (``comm_wire.schedules``): on a hierarchical
+    (``mn_inter`` × ``mn_intra``) mesh, ``hier_rs_ag`` replaces each
+    bucket's flat psum with a full-precision intra-slice
+    reduce-scatter, a codec-compressed inter-slice all-reduce on the
+    1/K shard (the codec — and the error-feedback residual — applies
+    to that hop only, DynamiQ-style), and an intra all-gather; the
+    ``auto`` decision stages a bucket exactly when the ring-formula
+    inter-hop byte savings clear the launch-latency threshold.  The
+    chosen schedule is part of the agreed plan hash, so ranks cannot
+    schedule apart.  Meshes with no genuine split (incl. the ragged
+    width-1 ``mn_inter`` fallback) collapse an explicit ``hier_rs_ag``
+    to ``flat`` with a logged warning.
+
+    ``tune_trace``: a :class:`~chainermn_tpu.analysis.trace.
+    CollectiveTrace` (or its records) of the step that will ship these
+    gradients.  With ``wire="auto"``, the bucket byte target and slot
+    budget are then tuned by ``comm_wire.tune_wire_for_trace`` from
+    the trace's per-collective cost model (``bytes_on_wire`` + hop
+    class) instead of the fixed 4 MiB / 6-bucket constants — the
+    production consumer of the PR 6 tuner.  Typical use: build a step,
+    ``tr = step.collective_trace(p, o, batch)``, then rebuild the
+    optimizer with ``tune_trace=tr``.
 
     ``overlap`` (``"none"``/``"bucket"``): the bucket-granularity
     comm/compute overlap engine (``comm_wire.overlap``).  With
@@ -690,7 +876,8 @@ def create_multi_node_optimizer(
         cls = _DoubleBufferingOptimizer
     else:
         cls = _MultiNodeOptimizer
-    opt = cls(actual_optimizer, communicator, wire=wire, overlap=overlap)
+    opt = cls(actual_optimizer, communicator, wire=wire, overlap=overlap,
+              tune_trace=tune_trace)
     cfg = opt.wire  # resolved + validated ONCE, by the constructor
     if cfg is not None and cfg.error_feedback:
         if double_buffering:

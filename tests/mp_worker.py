@@ -848,6 +848,132 @@ def scenario_overlap_fault(pid, nproc, scratch):
     }
 
 
+def scenario_multihop_fault(pid, nproc, scratch):
+    """ISSUE 11 satellite: the hier_rs_ag multi-hop wire in a REAL
+    2-proc hierarchical world (2 processes x 2 local CPU devices: the
+    process grouping IS the slice grouping, so the mesh genuinely
+    factorizes ('mn_inter', 'mn_intra') = (2, 2)), under the fault
+    injector.
+
+    The spawning test truncates ``obj_store.exchange`` calls #1 and #3
+    on every process — the standalone schedule/plan agreement below and
+    the one ``opt.init`` re-runs inside the training run: each torn
+    payload is observed by every rank in lockstep, retried, and the
+    multi-hop program must come through untouched —
+
+    * the agreed WirePlan hash covers bucket layout AND per-bucket
+      schedule, and every rank lands on the same one;
+    * the step's collective trace carries the full rs→ar→ag triple per
+      hier bucket, hashes identically before and after the faulted run,
+      and agrees across ranks;
+    * the loss trajectory and final params are BIT-IDENTICAL to a
+      no-fault run of the same schedule (the injected faults are
+      call-count-addressed to the first run's exchanges only).
+    """
+    import hashlib
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.comm_wire import WireConfig, plan_agreement, plan_wire
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = _comm("hierarchical")
+    assert dict(comm.mesh.shape) == {"mn_inter": nproc,
+                                     "mn_intra": comm.size // nproc}, (
+        dict(comm.mesh.shape)
+    )
+    rng = np.random.RandomState(0)  # same seed -> same model everywhere
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+        "w3": jnp.asarray(rng.randn(4, 4) * 0.3, jnp.float32),
+    }
+    # tiny buckets -> one per leaf: a genuinely multi-bucket multi-hop
+    # program (every bucket staged rs -> ar -> ag)
+    wire = WireConfig(schedule="hier_rs_ag", bucket_bytes=64,
+                      max_buckets=0)
+
+    # schedule/plan agreement: the first exchange carries a truncated
+    # payload -> PayloadCorruptionError on EVERY rank -> lockstep retry
+    # -> every rank agrees on layout AND schedule
+    wplan = plan_wire(params, wire, comm.mesh)
+    assert set(wplan.schedules) == {"hier_rs_ag"}, wplan.schedules
+    agreed = plan_agreement(comm, wplan)
+    assert agreed == wplan.plan_hash()
+    inj = fi.active()
+    assert inj is not None, "fault injector must be env-activated"
+    assert inj.log.counts.get("fault_injected", 0) >= 1, (
+        "the truncate fault must have fired before the retry succeeded"
+    )
+
+    w_true = rng.randn(8, 4).astype(np.float32)
+    x_all = rng.randn(16, 8).astype(np.float32)
+    y_all = x_all @ w_true
+
+    def loss_fn(p, b):
+        bx, by = b
+        h = jnp.tanh(bx @ p["w1"])
+        return jnp.mean(((h @ p["w2"]) @ p["w3"] - by) ** 2)
+
+    lo = pid * (16 // nproc)
+    hi = lo + 16 // nproc
+    batch = (x_all[lo:hi], y_all[lo:hi])
+
+    def run():
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.05), comm, wire=wire
+        )
+        step = build_train_step(comm, loss_fn, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        pre_hash = step.collective_trace(p, o, batch).trace_hash()
+        losses = []
+        for _ in range(10):
+            p, o, m = step(p, o, batch)
+            losses.append(float(m["loss"]))
+        post_hash = step.collective_trace(p, o, batch).trace_hash()
+        return step, p, o, pre_hash, post_hash, losses
+
+    # faulted run first: opt.init's plan-agreement exchange is call #3
+    # and absorbs the second injected truncation
+    step_a, p_a, o_a, pre_a, post_a, losses_a = run()
+    assert inj.log.counts.get("fault_injected", 0) >= 2, (
+        "both injected truncations must have fired",
+        dict(inj.log.counts),
+    )
+    # retried transients did not reorder or drop a hop
+    assert pre_a == post_a
+    hashes = comm.allgather_obj(post_a)
+    assert all(h == hashes[0] for h in hashes), hashes
+    tr = step_a.collective_trace(p_a, o_a, batch)
+    n_buckets = wplan.n_buckets
+    assert n_buckets >= 3
+    census = tr.census()
+    assert census.get("reduce_scatter", 0) == n_buckets, census
+    assert census.get("all_gather", 0) == n_buckets, census
+    assert census.get("all_reduce", 0) == n_buckets + 1, census
+
+    # no-fault reference run of the same schedule: bit-identical
+    step_b, p_b, o_b, pre_b, post_b, losses_b = run()
+    assert losses_a == losses_b, (losses_a, losses_b)
+    for k in sorted(params):
+        np.testing.assert_array_equal(
+            np.asarray(p_a[k]), np.asarray(p_b[k])
+        )
+    digests = comm.allgather_obj(hashlib.sha256(
+        b"".join(np.asarray(p_a[k]).tobytes() for k in sorted(p_a))
+    ).hexdigest())
+    assert all(d == digests[0] for d in digests), digests
+    return {
+        "faults": inj.log.counts.get("fault_injected", 0),
+        "final_loss": losses_a[-1],
+        "buckets": n_buckets,
+        "mesh": dict(comm.mesh.shape),
+    }
+
+
 def scenario_trace_divergence(pid, nproc, scratch):
     """ISSUE 5 satellite: two processes build INTENTIONALLY divergent
     train steps (the rank named by CHAINERMN_TPU_DIVERGE_RANK adds one

@@ -772,8 +772,13 @@ class TestWireBenchRungsCI:
         env = subprocess_env(8)
         env.update({"HUNT_MLP_UNITS": "32", "HUNT_MLP_BATCH": "8",
                     "HUNT_K": "4", "HUNT_REPEATS": "2"})
+        # one subprocess covers the PR 3 wire ladder AND the ISSUE 11
+        # multi-hop schedule rungs (wire_flat/wire_hier/wire_hier_int8
+        # run on a hierarchical mesh of 2 synthetic slices — the bench
+        # sets CHAINERMN_TPU_FAKE_SLICE_SIZE itself under --cpu-mesh)
         rungs = ["wire_perleaf_sync", "wire_bucketed_sync",
-                 "wire_int8_sync"]
+                 "wire_int8_sync",
+                 "wire_flat", "wire_hier", "wire_hier_int8"]
         proc = subprocess.run(
             [sys.executable,
              os.path.join(repo, "benchmarks", "comm_overlap_bench.py"),
@@ -809,6 +814,27 @@ class TestWireBenchRungsCI:
         # the leaf storm the bucket plan replaces, in numbers
         assert (recs["wire_bucketed_sync"]["wire_buckets"]
                 < recs["wire_perleaf_sync"]["wire_n_leaves"])
+        # ISSUE 11 rungs: schedule/codec fingerprints on a genuinely
+        # factorized (2, 4) hierarchical mesh — wire_flat pins the
+        # single-psum baseline, wire_hier/_int8 the staged program
+        for name in ("wire_flat", "wire_hier", "wire_hier_int8"):
+            assert recs[name]["mesh_shape"] == {
+                "mn_inter": 2, "mn_intra": 4,
+            }, recs[name]
+            assert "wire_plan_hash" in recs[name]
+        assert recs["wire_flat"]["wire_schedules"] == {
+            "flat": recs["wire_flat"]["wire_buckets"]
+        }
+        assert recs["wire_hier"]["wire_schedules"] == {
+            "hier_rs_ag": recs["wire_hier"]["wire_buckets"]
+        }
+        assert recs["wire_hier_int8"]["wire_codec"] == "int8"
+        assert recs["wire_hier_int8"]["wire_schedules"] == {
+            "hier_rs_ag": recs["wire_hier_int8"]["wire_buckets"]
+        }
+        # same layout, different schedule => different agreed plan hash
+        assert (recs["wire_flat"]["wire_plan_hash"]
+                != recs["wire_hier"]["wire_plan_hash"])
 
 
 # ----------------------------------------------------------------------

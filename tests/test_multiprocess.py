@@ -272,6 +272,36 @@ class TestWireInt8:
         assert all(p["faults"] >= 2 for p in payloads)
         assert all(p["buckets"] >= 3 for p in payloads)
 
+    def test_multihop_schedule_under_fault_injector(self, tmp_path):
+        """ISSUE 11 satellite: the hier_rs_ag multi-hop wire across a
+        REAL 2-process hierarchical world (process grouping = slice
+        grouping, so the mesh factorizes (2, 2)) with truncate faults
+        injected during schedule/plan agreement — the lockstep retry
+        completes, every rank lands on the same WirePlan hash (bucket
+        layout AND schedule), the trace carries the rs→ar→ag triple
+        per bucket and hashes identically across ranks and across the
+        faulted run, and loss/params are bit-identical to the no-fault
+        run (all asserted inside the scenario)."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "truncate",
+             "at": [1, 3], "truncate_to": 4},
+        ])
+        res = run_world(
+            "multihop_fault", n_procs=2, local_devices=2,
+            tmpdir=tmp_path, timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "multihop_fault")
+        assert all(p["faults"] >= 2 for p in payloads)
+        assert all(p["buckets"] >= 3 for p in payloads)
+        assert all(
+            p["mesh"] == {"mn_inter": 2, "mn_intra": 2}
+            for p in payloads
+        )
+        assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
+
 
 class TestTelemetry:
     def test_straggler_flagged_and_timeline_exported_both_ranks(

@@ -217,6 +217,38 @@ class TestDiffRules:
         assert [r.metric for r in regs] == ["overlap_on"]
         assert regs[0].direction == "lower-better"
 
+    def test_wire_schedule_rungs_gated_direction_aware(self, tmp_path):
+        """ISSUE 11 satellite: the ``wire_flat``/``wire_hier``/
+        ``wire_hier_int8`` rungs gate like every variant row —
+        step_time_ms synthesized as the value, lower-is-better, the
+        rung's own spread as tolerance — and the schedule/codec
+        fingerprint fields ride along without confusing the loader."""
+        def rows(hier_ms, int8_ms):
+            return [
+                {"variant": "wire_flat", "step_time_ms": 10.0,
+                 "n_measurements": 2, "spread_max_over_min": 1.03,
+                 "wire_schedules": {"flat": 4},
+                 "wire_plan_hash": "abc", "wire_codec": "none"},
+                {"variant": "wire_hier", "step_time_ms": hier_ms,
+                 "n_measurements": 2, "spread_max_over_min": 1.03,
+                 "wire_schedules": {"hier_rs_ag": 4},
+                 "wire_plan_hash": "def", "wire_codec": "none"},
+                {"variant": "wire_hier_int8", "step_time_ms": int8_ms,
+                 "n_measurements": 2, "spread_max_over_min": 1.03,
+                 "wire_schedules": {"hier_rs_ag": 4},
+                 "wire_plan_hash": "def", "wire_codec": "int8"},
+            ]
+
+        old = _capture(tmp_path, "BENCH_r90.json", rows(8.0, 7.0))
+        # hier regressed beyond spread; int8 moved within it
+        new = _capture(tmp_path, "BENCH_r91.json", rows(9.5, 7.1))
+        ro, rn = load_rows(old), load_rows(new)
+        for name in ("wire_flat", "wire_hier", "wire_hier_int8"):
+            assert lower_is_better(name, rn[name]), name
+        regs = diff_rows(ro, rn)
+        assert [r.metric for r in regs] == ["wire_hier"]
+        assert regs[0].direction == "lower-better"
+
     def test_overlap_variant_rows_spread_gated(self, tmp_path):
         """A move inside the rung's own recorded spread passes."""
         old = _capture(tmp_path, "BENCH_r90.json", [

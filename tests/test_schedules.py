@@ -1,0 +1,774 @@
+"""Topology-aware multi-hop collective schedules (ISSUE 11).
+
+The tentpole pins, in order of load-bearingness:
+
+* full-precision ``hier_rs_ag`` is BIT-IDENTICAL to the flat psum on
+  exactly-representable data (0 tolerance, every leaf, incl. the ZeRO
+  blocked path) — the staged schedule computes the same summands with
+  the same mean-divide placement; only the summation TREE is
+  reassociated, which is exact whenever the partial sums are (dyadic
+  data), and within float roundoff otherwise (pinned at rtol);
+* the schedule choice is PURE in the plan: same shapes + mesh ⇒ same
+  ``WirePlan.plan_hash()`` on every rank, and the hash moves when the
+  schedule or the mesh factorization does;
+* per-schedule collective counts: flat = 1 all-reduce/bucket; hier =
+  1 reduce-scatter + 1 all-reduce + 1 all-gather per bucket (+1 batched
+  scale pmax for int8) — enforced via the pinned budgets AND
+  cross-checked against the lowered HLO with ZERO partitioner
+  insertions (``assert_attributed``);
+* int8 inter-hop + error feedback stays within the existing
+  1%-of-fp32-loss pin over 200 MLP steps;
+* a width-1 ``mn_inter`` axis (the ragged-topology fallback) collapses
+  an explicit ``hier_rs_ag`` to ``flat`` with a logged warning;
+* ``assert_overlap_order`` passes on the overlapped multi-hop program
+  (each bucket's rs→ar→ag triple is ONE readiness unit headed by the
+  intra reduce-scatter) and fails on the synchronous multi-bucket one.
+
+The (2, 4) hierarchical mesh comes from grouping the 8 virtual CPU
+devices into 2 synthetic slices (the test_topology.py recipe).
+"""
+
+import warnings
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+import optax
+
+import chainermn_tpu as cmn
+from chainermn_tpu import comm_wire as cw
+from chainermn_tpu.analysis import enforce, trace_collectives
+from chainermn_tpu.communicators import _topology
+from chainermn_tpu.optimizers import build_train_step
+
+
+@pytest.fixture(scope="module")
+def hier_comm(devices8):
+    """(2, 4) hierarchical mesh over the 8 virtual CPU devices: 2
+    synthetic slices of 4 (mesh geometry is fixed at construction, so
+    the key patch only needs to live through create_communicator)."""
+    orig = _topology._node_key
+    _topology._node_key = lambda d: ("slice", d.id // 4)
+    try:
+        comm = cmn.create_communicator("hierarchical", devices=devices8)
+    finally:
+        _topology._node_key = orig
+    assert dict(comm.mesh.shape) == {"mn_inter": 2, "mn_intra": 4}
+    return comm
+
+
+@pytest.fixture(scope="module")
+def flat_comm(devices8):
+    return cmn.create_communicator("tpu", devices=devices8)
+
+
+def _assert_tree_bit_equal(a, b):
+    la, ta = jax.tree_util.tree_flatten(a)
+    lb, tb = jax.tree_util.tree_flatten(b)
+    assert ta == tb
+    for x, y in zip(la, lb):
+        np.testing.assert_array_equal(np.asarray(x), np.asarray(y))
+
+
+# ----------------------------------------------------------------------
+# decision rule + plan purity
+# ----------------------------------------------------------------------
+class TestScheduleDecision:
+    MESH24 = {"mn_inter": 2, "mn_intra": 4}
+
+    def test_axis_split_shapes(self):
+        split = cw.axis_split(("mn_inter", "mn_intra"), (2, 4))
+        assert split == cw.AxisSplit("mn_inter", "mn_intra", 2, 4)
+        # width-1 inter (ragged fallback), flat names, missing half
+        assert cw.axis_split(("mn_inter", "mn_intra"), (1, 8)) is None
+        assert cw.axis_split(("mn",), (8,)) is None
+        assert cw.axis_split(("mn_intra",), (8,)) is None
+
+    def test_auto_stages_large_buckets_only(self):
+        big = 4 * 1024 * 1024
+        assert cw.schedule_for_bucket(big, self.MESH24) == "hier_rs_ag"
+        # small payloads are launch-latency-bound: 3 collectives lose
+        assert cw.schedule_for_bucket(512, self.MESH24) == "flat"
+        # the threshold is the documented constant
+        split = cw.axis_split(("mn_inter", "mn_intra"), (2, 4))
+        assert cw.hier_inter_savings(big, split) \
+            >= cw.MIN_HIER_INTER_SAVINGS
+
+    def test_flat_mesh_never_stages(self):
+        assert cw.schedule_for_bucket(
+            1 << 30, {"mn": 8}, axes=("mn",)
+        ) == "flat"
+        assert cw.schedule_for_bucket(
+            1 << 30, {"mn_inter": 1, "mn_intra": 8}
+        ) == "flat"
+
+    def test_requested_schedule_honored(self):
+        assert cw.schedule_for_bucket(
+            8, self.MESH24, requested="hier_rs_ag"
+        ) == "hier_rs_ag"
+        assert cw.schedule_for_bucket(
+            1 << 30, self.MESH24, requested="flat"
+        ) == "flat"
+        with pytest.raises(ValueError, match="schedule"):
+            cw.schedule_for_bucket(8, self.MESH24, requested="spray")
+
+    def test_plan_hash_pure_and_schedule_aware(self, hier_comm):
+        tree = {"w": jnp.zeros((2048, 256)), "b": jnp.zeros((7,))}
+        structs = jax.tree_util.tree_map(
+            lambda l: jax.ShapeDtypeStruct(l.shape, l.dtype), tree
+        )
+        wire = cw.WireConfig(schedule="hier_rs_ag")
+        h = cw.plan_wire(tree, wire, hier_comm.mesh).plan_hash()
+        # pure function of shapes + mesh: structs hash identically
+        assert cw.plan_wire(structs, wire, hier_comm.mesh).plan_hash() \
+            == h
+        # the schedule is IN the hash: flat plans hash differently...
+        flat = cw.plan_wire(
+            tree, cw.WireConfig(schedule="flat"), hier_comm.mesh
+        )
+        assert flat.plan_hash() != h
+        # ...even though the bucket layout is identical
+        assert flat.plan.plan_hash() == \
+            cw.plan_wire(tree, wire, hier_comm.mesh).plan.plan_hash()
+        # and so is the mesh signature
+        assert cw.plan_wire(
+            tree, wire, {"mn_inter": 4, "mn_intra": 2}
+        ).plan_hash() != h
+
+    def test_wireconfig_rejects_unknown_schedule(self):
+        with pytest.raises(ValueError, match="schedule"):
+            cw.WireConfig(schedule="multipath").validate()
+
+    def test_ragged_width1_inter_collapses_with_warning(self, devices8):
+        """Satellite: an explicit hier_rs_ag on the width-1 'mn_inter'
+        ragged fallback must collapse to flat with a logged warning —
+        not emit degenerate inter-hop collectives."""
+        comm = cmn.create_communicator(
+            "hierarchical", devices=devices8[:4]
+        )  # one node -> (1, 4) mesh: the degenerate two-level layout
+        assert dict(comm.mesh.shape) == {"mn_inter": 1, "mn_intra": 4}
+        tree = {"w": jnp.zeros((64,))}
+        with pytest.warns(UserWarning, match="collaps"):
+            wplan = cw.plan_wire(
+                tree, cw.WireConfig(schedule="hier_rs_ag"), comm.mesh
+            )
+        assert set(wplan.schedules) == {"flat"}
+        # auto on the same mesh stays silent (nothing was requested)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")
+            wplan = cw.plan_wire(
+                tree, cw.WireConfig(schedule="auto"), comm.mesh
+            )
+        assert set(wplan.schedules) == {"flat"}
+
+
+# ----------------------------------------------------------------------
+# numerics: bit identity on exact data, roundoff closeness otherwise
+# ----------------------------------------------------------------------
+def _two_leaf_loss(params, batch):
+    m = batch.mean(axis=0)
+    return 0.5 * jnp.sum((params["a"] - m[:4]) ** 2) + 0.5 * jnp.sum(
+        (params["b"] - m[4:].reshape(1, 3)) ** 2
+    )
+
+
+def _run_two_leaf(comm, wire, batch_np, n_steps=3, lr=0.5):
+    opt = cmn.create_multi_node_optimizer(optax.sgd(lr), comm, wire=wire)
+    params = {"a": jnp.zeros((4,)), "b": jnp.zeros((1, 3))}
+    step = build_train_step(comm, _two_leaf_loss, opt, donate=False)
+    p, o = step.place(params, opt.init(params))
+    bx = jax.device_put(jnp.asarray(batch_np), step.batch_sharding)
+    for _ in range(n_steps):
+        p, o, _ = step(p, o, bx)
+    return p
+
+
+class TestHierBitIdentity:
+    def test_hier_equals_flat_bit_exact_on_dyadic_data(self, hier_comm):
+        """Acceptance: full-precision hier_rs_ag vs flat at 0 tolerance.
+        Integer batch rows + lr=0.5 keep every gradient, partial sum,
+        and update dyadic, so the staged reduction tree's reassociation
+        is exact and the schedules must agree bit-for-bit."""
+        x = np.arange(56, dtype=np.float32).reshape(8, 7)
+        p_flat = _run_two_leaf(
+            hier_comm, cw.WireConfig(schedule="flat", bucket_bytes=64,
+                                     max_buckets=0), x
+        )
+        p_hier = _run_two_leaf(
+            hier_comm, cw.WireConfig(schedule="hier_rs_ag",
+                                     bucket_bytes=64, max_buckets=0), x
+        )
+        _assert_tree_bit_equal(p_flat, p_hier)
+
+    def test_hier_matches_flat_within_roundoff_on_random_data(
+        self, hier_comm
+    ):
+        """On arbitrary float data the reassociated tree differs only
+        by summation rounding order — same summands, same divide."""
+        x = np.random.RandomState(3).randn(8, 7).astype(np.float32)
+        p_flat = _run_two_leaf(
+            hier_comm, cw.WireConfig(schedule="flat"), x
+        )
+        p_hier = _run_two_leaf(
+            hier_comm, cw.WireConfig(schedule="hier_rs_ag"), x
+        )
+        for k in p_flat:
+            np.testing.assert_allclose(
+                np.asarray(p_flat[k]), np.asarray(p_hier[k]), rtol=1e-5
+            )
+
+    def test_zero_redundancy_hier_bit_exact_and_census(self, hier_comm):
+        """The ZeRO blocked path: staged intra/inter scatter-gather
+        (ownership kept LINEAR via the local block transpose, so
+        state_partition_spec and the elastic resharder see the same
+        layout) is bit-identical to the flat ZeRO scatter on dyadic
+        data, with 2 rs + 2 ag per bucket pinned."""
+        params = {"w": jnp.zeros((8,)), "v": jnp.zeros((16,))}
+
+        def loss(p, b):
+            m = b.mean(axis=0)
+            return 0.5 * jnp.sum((p["w"] - m[:8]) ** 2) + 0.5 * jnp.sum(
+                (p["v"] - m[8:]) ** 2
+            )
+
+        x = (np.arange(8 * 24) % 7).astype(np.float32).reshape(8, 24) * 4
+
+        def run(schedule):
+            wire = cw.WireConfig(codec="bf16", schedule=schedule,
+                                 bucket_bytes=64, max_buckets=0)
+            opt = cmn.create_multi_node_optimizer(
+                optax.sgd(0.5, momentum=0.5), hier_comm,
+                zero_redundancy=True, wire=wire,
+            )
+            step = build_train_step(hier_comm, loss, opt, donate=False)
+            p, o = step.place(params, opt.init(params))
+            bx = jax.device_put(jnp.asarray(x), step.batch_sharding)
+            for _ in range(3):
+                p, o, _ = step(p, o, bx)
+            return p, step.collective_trace(p, o, bx)
+
+        p_flat, tr_flat = run("flat")
+        p_hier, tr_hier = run("hier_rs_ag")
+        _assert_tree_bit_equal(p_flat, p_hier)
+        # flat: 1 rs + 1 ag per bucket; hier: 2 of each (intra + inter)
+        n_buckets = tr_flat.count("reduce_scatter")
+        assert tr_hier.count("reduce_scatter") == 2 * n_buckets
+        assert tr_hier.count("all_gather") == 2 * n_buckets
+        assert tr_hier.count("all_reduce") == 1  # loss pmean only
+        enforce("zero_hier_train_step", tr_hier)
+
+
+# ----------------------------------------------------------------------
+# census, budget pins, HLO attribution (acceptance criteria)
+# ----------------------------------------------------------------------
+class TestHierCensusAndAttribution:
+    def _mlp_step(self, comm, wire):
+        from chainermn_tpu.models import MLP
+
+        model = MLP(n_units=64)
+        params = model.init(jax.random.PRNGKey(0), jnp.zeros((1, 28, 28)))
+
+        def loss_fn(p, b):
+            x, y = b
+            return optax.softmax_cross_entropy_with_integer_labels(
+                model.apply(p, x), y
+            ).mean()
+
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.05), comm, wire=wire
+        )
+        step = build_train_step(comm, loss_fn, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        batch = (
+            jax.device_put(jnp.zeros((64, 28, 28)), step.batch_sharding),
+            jax.device_put(jnp.zeros((64,), jnp.int32),
+                           step.batch_sharding),
+        )
+        return step, p, o, batch, params
+
+    def test_per_schedule_collective_counts(self, hier_comm):
+        """Acceptance: flat = 1 ar/bucket (+1 loss pmean); hier = 1 rs
+        + 1 ar + 1 ag per bucket (+1 loss pmean), enforced by the new
+        budget pins — via the static analyzer, nothing compiles."""
+        wire = cw.WireConfig(schedule="hier_rs_ag")
+        step, p, o, batch, params = self._mlp_step(hier_comm, wire)
+        wplan = cw.plan_wire(params, wire, hier_comm.mesh)
+        n = wplan.n_buckets
+        assert set(wplan.schedules) == {"hier_rs_ag"}
+        tr = step.collective_trace(p, o, batch)
+        assert tr.count("reduce_scatter") == n
+        assert tr.count("all_gather") == n
+        assert tr.count("all_reduce") == n + 1  # inter hops + loss pmean
+        enforce("hier_train_step", tr)
+        # hop attribution of the triple: rs/ag are intra, the bucket
+        # all-reduces inter — the wire_census SHOWS the inter-byte win
+        census = tr.wire_census(by_class=True)
+        assert census["intra/reduce_scatter"] > 0
+        assert census["intra/all_gather"] > 0
+        assert 0 < census["inter/all_reduce"] \
+            < census["intra/reduce_scatter"]
+
+    def test_int8_adds_exactly_one_scale_pmax(self, hier_comm):
+        wire = cw.WireConfig(codec="int8", error_feedback=True,
+                             schedule="hier_rs_ag")
+        step, p, o, batch, params = self._mlp_step(hier_comm, wire)
+        n = cw.plan_wire(params, wire, hier_comm.mesh).n_buckets
+        tr = step.collective_trace(p, o, batch)
+        # buckets' inter psums + loss pmean + ONE batched scale pmax
+        assert tr.count("all_reduce") == n + 2
+        assert tr.count("reduce_scatter") == n
+        enforce("hier_int8_train_step", tr)
+
+    def test_hier_step_attributes_with_zero_insertions(self, hier_comm):
+        """Acceptance: every collective in a hier_rs_ag train step is
+        attributed to an authored record with ZERO partitioner
+        insertions (compiled-HLO attribution), and the walker census
+        agrees with the lowered text."""
+        from chainermn_tpu.analysis import (
+            assert_attributed, assert_census_agreement,
+        )
+
+        wire = cw.WireConfig(schedule="hier_rs_ag")
+        step, p, o, batch, params = self._mlp_step(hier_comm, wire)
+        tr = step.collective_trace(p, o, batch)
+        lowered = step.get_jitted(p, o).lower(p, o, batch)
+        assert_census_agreement(tr, lowered.as_text())
+        report = assert_attributed(
+            tr, lowered.compile().as_text(), name="hier_mlp_train_step"
+        )
+        for label, rep in report.items():
+            assert rep["implicit"] == [], (label, rep)
+
+
+# ----------------------------------------------------------------------
+# int8 inter hop + per-hop error feedback
+# ----------------------------------------------------------------------
+class TestHierInt8ErrorFeedback:
+    def _mlp_run(self, comm, wire, n_steps, lr=0.05):
+        rng = np.random.RandomState(0)
+        w_true = rng.randn(8, 4).astype(np.float32)
+        x = rng.randn(64, 8).astype(np.float32)
+        y = x @ w_true
+        params = {
+            "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+            "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+        }
+
+        def loss_fn(p, b):
+            bx, by = b
+            h = jnp.tanh(bx @ p["w1"])
+            return jnp.mean((h @ p["w2"] - by) ** 2)
+
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(lr), comm, wire=wire
+        )
+        step = build_train_step(comm, loss_fn, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        batch = (
+            jax.device_put(jnp.asarray(x), step.batch_sharding),
+            jax.device_put(jnp.asarray(y), step.batch_sharding),
+        )
+        loss = None
+        for _ in range(n_steps):
+            p, o, m = step(p, o, batch)
+            loss = float(m["loss"])
+        return loss, p, o
+
+    def test_int8_inter_hop_ef_within_1pct_of_fp32(self, hier_comm):
+        """Satellite: the compressed INTER hop + per-hop EF matches the
+        fp32 wire within the existing 1% loss pin over 200 MLP steps."""
+        l_fp32, _, _ = self._mlp_run(hier_comm, "auto", 200)
+        l_int8, _, _ = self._mlp_run(
+            hier_comm,
+            cw.WireConfig(codec="int8", error_feedback=True,
+                          schedule="hier_rs_ag"),
+            200,
+        )
+        assert l_int8 <= l_fp32 * 1.01 + 1e-7, (
+            f"hier int8+EF loss {l_int8} vs fp32 {l_fp32} exceeds 1%"
+        )
+
+    def test_ef_rejects_axes_subset_only_on_shape_flip(self, hier_comm):
+        """The residual carry is planned against the FULL mesh axes at
+        init; a sync-axes subset that re-schedules a bucket between
+        hier (shard-width residual) and flat (full-width) is refused
+        loudly — but only when the sync actually EXECUTES (bound mesh
+        axes; a skipped sync never touches the residual), and only on
+        an actual shape flip: a flat-scheduled wire's residual shapes
+        are axes-independent, so its subset sync stays legal."""
+        from jax.sharding import PartitionSpec as P
+
+        params = {"w": jnp.zeros((64,))}
+
+        def trace_update(opt, state, sync_axes):
+            def body(g):
+                upd, _ = opt.update(
+                    {"w": g}, state, {"w": g}, sync_axes=sync_axes
+                )
+                return upd["w"]
+
+            sm = jax.shard_map(
+                body, mesh=hier_comm.mesh, in_specs=P(), out_specs=P(),
+                check_vma=False,
+            )
+            return jax.make_jaxpr(sm)(jnp.zeros((64,)))
+
+        wire = cw.WireConfig(codec="int8", error_feedback=True,
+                             schedule="hier_rs_ag")
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), hier_comm, wire=wire
+        )
+        state = opt.init(params)
+        with pytest.warns(UserWarning, match="collaps"), \
+                pytest.raises(ValueError, match="axis subset"):
+            trace_update(opt, state, ("mn_intra",))
+        # the full axis set stays legal...
+        assert trace_update(
+            opt, state, ("mn_inter", "mn_intra")
+        ) is not None
+        # ...an UNBOUND (eager) update never raises — the guard lives
+        # inside the sync branch, and a skipped sync is harmless...
+        upd, _ = opt.update(params, state, params,
+                            sync_axes=("mn_intra",))
+        assert upd is not None
+        # ...and a flat-scheduled wire's subset sync keeps working
+        # (pre-schedule behavior: residual shapes are axes-independent)
+        flat_opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), hier_comm,
+            wire=cw.WireConfig(codec="int8", error_feedback=True,
+                               schedule="flat"),
+        )
+        flat_state = flat_opt.init(params)
+        assert trace_update(
+            flat_opt, flat_state, ("mn_intra",)
+        ) is not None
+
+    def test_residuals_are_shard_shaped(self, hier_comm):
+        """The EF carry lives at the compression point: the inter hop's
+        scattered shard (bucket_size / intra_size), not full width."""
+        wire = cw.WireConfig(codec="int8", error_feedback=True,
+                             schedule="hier_rs_ag")
+        _, _, o = self._mlp_run(hier_comm, wire, 2)
+        params = {
+            "w1": jnp.zeros((8, 16)), "w2": jnp.zeros((16, 4)),
+        }
+        wplan = cw.plan_wire(params, wire, hier_comm.mesh)
+        res = o.wire_residual
+        assert len(res) == wplan.n_buckets
+        for i, r in enumerate(res):
+            assert r.shape == (wplan.shard_size(i),)
+        # quantization of off-grid grads leaves a nonzero residual
+        assert any(np.any(np.asarray(r) != 0) for r in res)
+
+
+# ----------------------------------------------------------------------
+# overlap engine: the triple as one readiness unit
+# ----------------------------------------------------------------------
+class TestOverlapMultiHop:
+    def _pieces(self, comm, overlap):
+        rng = np.random.RandomState(0)
+        params = {
+            "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+            "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+            "w3": jnp.asarray(rng.randn(4, 4) * 0.3, jnp.float32),
+        }
+        wire = cw.WireConfig(schedule="hier_rs_ag", bucket_bytes=64,
+                             max_buckets=0)  # one bucket per leaf
+        x = rng.randn(16, 8).astype(np.float32)
+        y = (x @ rng.randn(8, 4)).astype(np.float32)
+
+        def loss(p, b):
+            bx, by = b
+            h = jnp.tanh(bx @ p["w1"])
+            return jnp.mean(((h @ p["w2"]) @ p["w3"] - by) ** 2)
+
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.05), comm, wire=wire, overlap=overlap
+        )
+        step = build_train_step(comm, loss, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        batch = (
+            jax.device_put(jnp.asarray(x), step.batch_sharding),
+            jax.device_put(jnp.asarray(y), step.batch_sharding),
+        )
+        losses = []
+        for _ in range(4):
+            p, o, m = step(p, o, batch)
+            losses.append(float(m["loss"]))
+        wplan = cw.plan_wire(params, wire, comm.mesh)
+        return step, p, o, batch, losses, wplan
+
+    def test_overlapped_multihop_passes_order_check(self, hier_comm):
+        """Acceptance: assert_overlap_order on the overlapped multi-hop
+        program — every hier bucket's readiness unit (headed by the
+        intra reduce-scatter) issues at its dependency frontier, and
+        the rs→ar→ag triple is complete per bucket."""
+        step, p, o, batch, losses_b, wplan = self._pieces(
+            hier_comm, "bucket"
+        )
+        assert wplan.n_buckets >= 3
+        assert set(wplan.schedules) == {"hier_rs_ag"}
+        jb = step.get_jitted(p, o).scheduled_jaxpr(p, o, batch)
+        cw.assert_overlap_order(jb, wplan, label="hier_overlapped")
+        # Finding-style spelling agrees (one source of truth)
+        from chainermn_tpu.analysis import check_overlap
+
+        assert check_overlap(jb, wplan) == []
+
+        # the synchronous program FAILS: heads queue at the tail
+        step_s, p_s, o_s, batch_s, losses_s, _ = self._pieces(
+            hier_comm, "none"
+        )
+        js = jax.make_jaxpr(step_s.get_jitted(p_s, o_s))(
+            p_s, o_s, batch_s
+        )
+        assert cw.order_violations(js, wplan)
+
+        # and the overlap schedule is a pure reorder: bit-identical
+        assert losses_b == losses_s
+
+    def test_flat_bucket_cannot_mask_lost_inter_hop(self, hier_comm):
+        """Size-collision regression: a flat bucket whose fused psum
+        has the SAME operand size as a hier bucket's shard must not
+        satisfy the triple-completeness count — the hops are matched
+        by mesh AXES (inter psum over mn_inter, ag over mn_intra), not
+        by size alone."""
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        # hier bucket: 64 elements over intra width 4 -> shard 16;
+        # flat bucket: 16 elements -> its psum collides at size 16
+        wplan = cw.WirePlan(
+            plan=cw.make_plan(
+                [jnp.zeros((64,)), jnp.zeros((16,), jnp.bfloat16)],
+                bucket_bytes=1, max_buckets=0,
+            ),
+            schedules=("hier_rs_ag", "flat"),
+            axes=("mn_inter", "mn_intra"),
+            axis_sizes=(2, 4),
+        )
+        assert wplan.shard_size(0) == 16
+
+        def lost_inter_hop(g, f):
+            # hier bucket's rs + ag but NO inter psum; the flat
+            # bucket's psum (size 16, over BOTH axes) is present
+            local = lax.psum_scatter(
+                g, "mn_intra", scatter_dimension=0, tiled=True
+            )
+            out = lax.all_gather(local, "mn_intra", axis=0, tiled=True)
+            flat = lax.psum(f, ("mn_inter", "mn_intra"))
+            return out, flat
+
+        body = jax.shard_map(
+            lost_inter_hop, mesh=hier_comm.mesh,
+            in_specs=(P(), P()), out_specs=(P(), P()),
+            check_vma=False,
+        )
+        jaxpr = jax.make_jaxpr(body)(
+            jnp.zeros((64,)), jnp.zeros((16,), jnp.bfloat16)
+        )
+        msgs = cw.order_violations(jaxpr, wplan)
+        assert any(
+            "triple incomplete" in m and "inter all-reduce" in m
+            for m in msgs
+        ), msgs
+
+    def test_dropped_hop_is_detected(self, hier_comm):
+        """The triple-completeness half of the contract: a program
+        carrying the rs but not the inter/ag hops must be flagged."""
+        wire = cw.WireConfig(schedule="hier_rs_ag", bucket_bytes=64,
+                             max_buckets=0)
+        params = {"w": jnp.zeros((16,))}
+        wplan = cw.plan_wire(params, wire, hier_comm.mesh)
+        mesh = hier_comm.mesh
+
+        def rs_only(g):
+            from jax import lax
+
+            return lax.psum_scatter(
+                g, "mn_intra", scatter_dimension=0, tiled=True
+            )
+
+        from jax.sharding import PartitionSpec as P
+
+        body = jax.shard_map(
+            rs_only, mesh=mesh, in_specs=P(), out_specs=P(),
+            check_vma=False,
+        )
+        jaxpr = jax.make_jaxpr(body)(jnp.zeros((16,)))
+        msgs = cw.order_violations(jaxpr, wplan)
+        assert any("triple incomplete" in m for m in msgs), msgs
+
+
+# ----------------------------------------------------------------------
+# eager tier: bcast_tree + hierarchical bucket dispatch
+# ----------------------------------------------------------------------
+class TestEagerTier:
+    def test_bcast_tree_two_stages_and_oracle(self, hier_comm):
+        x = np.arange(24, dtype=np.float32).reshape(8, 3)
+        out = np.asarray(hier_comm.bcast(x, root=2))
+        np.testing.assert_array_equal(
+            out, np.broadcast_to(x[2], (8, 3))
+        )
+        tr = trace_collectives(
+            lambda a, r: hier_comm._bcast_fn(a, r),
+            jnp.asarray(x), jnp.int32(2),
+        )
+        # inter (root -> slice leaders) then intra (leader -> slice)
+        assert [r.axes for r in tr.records] == [
+            ("mn_inter",), ("mn_intra",),
+        ]
+        enforce("bcast_tree", tr)
+
+    def test_flat_mesh_bcast_keeps_single_psum(self, flat_comm):
+        x = np.arange(16, dtype=np.float32).reshape(8, 2)
+        out = np.asarray(flat_comm.bcast(x, root=5))
+        np.testing.assert_array_equal(
+            out, np.broadcast_to(x[5], (8, 2))
+        )
+        tr = trace_collectives(
+            lambda a, r: flat_comm._bcast_fn(a, r),
+            jnp.asarray(x), jnp.int32(5),
+        )
+        assert tr.count("all_reduce") == 1
+
+    def test_eager_allreduce_grad_stages_large_buckets(self, hier_comm):
+        """Cost-model-qualified buckets ride the staged rs→ar→ag eager
+        program; the mean oracle holds within roundoff."""
+        grads = {"w": jnp.ones((8, 300_000), jnp.float32)
+                 * jnp.arange(8.0)[:, None]}
+        out = hier_comm.allreduce_grad(grads)
+        expect = np.asarray(grads["w"]).mean(0)
+        for r in range(8):
+            np.testing.assert_allclose(
+                np.asarray(out["w"])[r], expect, rtol=1e-5
+            )
+        # the staged program really is rs -> ar -> ag
+        tr = trace_collectives(
+            lambda g: hier_comm._allreduce_grad_hier_fns["mean"](g),
+            grads["w"],
+        )
+        assert tr.census() == {
+            "reduce_scatter": 1, "all_reduce": 1, "all_gather": 1,
+        }
+
+    def test_eager_wire_schedule_knob(self, devices8):
+        """The eager tier's opt-out: ``wire_schedule="flat"`` pins the
+        single-psum baseline even for cost-model-qualified buckets
+        (bit-compat with pre-schedule releases), ``"hier_rs_ag"``
+        forces staging below the threshold, and junk is rejected."""
+        orig = _topology._node_key
+        _topology._node_key = lambda d: ("slice", d.id // 4)
+        try:
+            flat_pinned = cmn.create_communicator(
+                "hierarchical", devices=devices8, wire_schedule="flat"
+            )
+            forced = cmn.create_communicator(
+                "hierarchical", devices=devices8,
+                wire_schedule="hier_rs_ag",
+            )
+        finally:
+            _topology._node_key = orig
+        big = {"w": jnp.ones((8, 300_000), jnp.float32)}
+        small = {"w": jnp.ones((8, 16), jnp.float32)}
+        # flat-pinned: the qualifying bucket still rides ONE flat psum
+        tr = trace_collectives(flat_pinned.allreduce_grad, big)
+        assert tr.count("reduce_scatter") == 0
+        out = flat_pinned.allreduce_grad(big)
+        np.testing.assert_allclose(
+            np.asarray(out["w"])[0], np.ones((300_000,)), rtol=1e-6
+        )
+        # forced: even a tiny bucket stages
+        tr = trace_collectives(forced.allreduce_grad, small)
+        assert tr.count("reduce_scatter") == 1
+        assert tr.count("all_gather") == 1
+        out = forced.allreduce_grad(small)
+        np.testing.assert_allclose(
+            np.asarray(out["w"])[0], np.ones((16,)), rtol=1e-6
+        )
+        with pytest.raises(ValueError, match="wire_schedule"):
+            cmn.create_communicator("tpu", devices=devices8,
+                                    wire_schedule="spray")
+
+    def test_eager_small_buckets_stay_flat(self, hier_comm):
+        """Below the decision threshold the eager wire keeps the flat
+        single-psum program (launch-latency-bound regime)."""
+        grads = {"w": jnp.ones((8, 16), jnp.float32)}
+        from chainermn_tpu.comm_wire import make_plan
+
+        plan = make_plan([np.zeros((16,), np.float32)])
+        b = plan.buckets[0]
+        assert cw.schedule_for_bucket(
+            b.size * 4, hier_comm.mesh
+        ) == "flat"
+        out = hier_comm.allreduce_grad(grads)
+        np.testing.assert_allclose(
+            np.asarray(out["w"])[0], np.ones((16,)), rtol=1e-6
+        )
+
+
+# ----------------------------------------------------------------------
+# tuner consumption (satellite) + plan_for_trace growth
+# ----------------------------------------------------------------------
+class TestTunerConsumption:
+    def _trace(self, comm):
+        params = {"w": jnp.zeros((128,))}
+
+        def loss(p, b):
+            return 0.5 * jnp.sum((p["w"] - b.mean(axis=0)) ** 2)
+
+        opt = cmn.create_multi_node_optimizer(optax.sgd(0.1), comm)
+        step = build_train_step(comm, loss, opt, donate=False)
+        p, o = step.place(params, opt.init(params))
+        bx = jax.device_put(
+            jnp.zeros((8, 128)), step.batch_sharding
+        )
+        return step.collective_trace(p, o, bx), params
+
+    def test_wire_auto_consults_tuner_with_trace(self, hier_comm):
+        """Satellite: wire="auto" + a trace in hand consults
+        tune_wire_for_trace instead of the fixed 4 MiB/6-bucket
+        constants — the hierarchical world's inter hop scales the byte
+        target 4x and the small total collapses the slot budget to 1."""
+        tr, params = self._trace(hier_comm)
+        assert any(r.hop in ("inter", "mixed") for r in tr.records)
+        opt = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), hier_comm, wire="auto", tune_trace=tr
+        )
+        want_bytes, want_slots = cw.tune_wire_for_trace(tr.records)
+        # the hierarchical step's reductions cross slice boundaries
+        # (hop "mixed" on the flat psum): the byte target scales >= 2x
+        # and the tiny total collapses the slot budget to 1
+        assert want_bytes >= 2 * cw.DEFAULT_BUCKET_BYTES
+        assert want_slots == 1
+        assert opt.wire.bucket_bytes == want_bytes
+        assert opt.wire.max_buckets == want_slots
+        # untuned control: the fixed constants
+        base = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), hier_comm, wire="auto"
+        )
+        assert base.wire.bucket_bytes == cw.DEFAULT_BUCKET_BYTES
+        # explicit wires are never silently retuned
+        explicit = cmn.create_multi_node_optimizer(
+            optax.sgd(0.1), hier_comm, wire=cw.WireConfig(codec="bf16"),
+            tune_trace=tr,
+        )
+        assert explicit.wire.bucket_bytes == cw.DEFAULT_BUCKET_BYTES
+
+    def test_plan_for_trace_returns_wire_plan_with_mesh(self, hier_comm):
+        tr, params = self._trace(hier_comm)
+        tree = {"w": jnp.zeros((2048, 512))}
+        wplan = cw.plan_for_trace(tr, tree, mesh=hier_comm.mesh)
+        assert isinstance(wplan, cw.WirePlan)
+        assert set(wplan.schedules) <= {"flat", "hier_rs_ag"}
+        # without a mesh the legacy BucketPlan contract holds
+        plan = cw.plan_for_trace(tr, tree)
+        assert isinstance(plan, cw.BucketPlan)
+
+
+# ----------------------------------------------------------------------
+# wire_* bench rungs: CI smoke is folded into test_comm_wire.py's
+# TestWireBenchRungsCI (one subprocess amortizes jax startup); the mp
+# multihop_fault scenario lives in test_multiprocess.py.
+# ----------------------------------------------------------------------

@@ -53,6 +53,19 @@ fingerprint (``wire_schedules`` census + ``wire_plan_hash``) so a
 capture pins WHICH program it measured; perf_history gates the rows
 direction-aware like every variant row.
 
+wire_tuned_* rungs (ISSUE 12): the measured-feedback autotune A/B —
+``wire_tuned_base`` (fixed 4 MiB/6-slot constants) vs ``wire_tuned``
+(BandwidthProfile -> trace-driven bucket sizing + profile-driven
+schedule choice), on the flat CPU mesh and
+(``wire_tuned_hier_base``/``wire_tuned_hier``) the synthetic 2-slice
+hierarchical mesh.  The tuned legs prefer a PINNED profile
+(``CHAINERMN_TPU_WIRE_PROFILE`` whose mesh signature matches — stable
+hash, so perf_history gates the rows) and calibrate in-process only
+without one (fresh hash every capture — perf_history discloses it as
+a retune).  Tuned rows carry ``profile_hash`` /
+``tuned_bucket_bytes`` / ``tuned_max_buckets`` /
+``predicted_sync_ms`` beside the plan fingerprints.
+
 telemetry_overhead (ISSUE 10): the observability layer's enabled-vs-
 disabled A/B on the host-driven Updater path (span sites live on the
 host; the fori_loop harness would measure nothing), min-of-N fields
@@ -119,32 +132,108 @@ def _emit(name, dt, dts, batch, **extra):
     print(json.dumps(rec), flush=True)
 
 
+def _pinned_profile(mesh):
+    """The committed-beside-the-capture BandwidthProfile named by
+    ``CHAINERMN_TPU_WIRE_PROFILE``, or ``None`` when the tuned rung
+    should calibrate in-process.  A pinned path that no longer resolves
+    would otherwise silently demote every capture to in-process
+    calibration — fresh hash each run, so perf_history annotates tuned
+    rows as RETUNED forever and the gate the pin exists for never
+    fires — so a MISSING file is disclosed on stderr (rows go to
+    stdout).  A mesh-signature mismatch stays silent by design: one
+    pinned file can only match one rung's mesh, and the other rungs
+    falling back fresh is the documented normal capture shape."""
+    from chainermn_tpu.comm_wire.autotune import (
+        PROFILE_ENV, BandwidthProfile,
+    )
+
+    pinned = os.environ.get(PROFILE_ENV)
+    if not pinned:
+        return None
+    if not os.path.exists(pinned):
+        print(
+            f"comm_overlap_bench: {PROFILE_ENV}={pinned!r} does not "
+            "exist — falling back to in-process calibration (tuned "
+            "rows get a fresh profile_hash; perf_history will "
+            "disclose them as retuned instead of gating)",
+            file=sys.stderr,
+        )
+        return None
+    cand = BandwidthProfile.load(pinned)
+    return cand if cand.matches_mesh(mesh) else None
+
+
 def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
               double_buffering=False, comm_name="tpu", wire="auto",
-              overlap="none", **extra):
+              overlap="none", profile=None, tune_self=False, **extra):
     """Multi-node tier: build_train_step over the communicator's mesh —
     grad psum + update in one program (k of them in one fori_loop).
     ``wire`` selects the gradient wire (per_leaf / auto-bucketed /
     codec name / WireConfig) — the wire_* rung axis.  ``overlap``
     selects the bucket-granularity overlap engine — the overlap_*
     rung axis (bit-identical program, reordered so each bucket's psum
-    issues under the remaining backward)."""
+    issues under the remaining backward).  ``profile`` (ISSUE 12)
+    feeds the measured-feedback autotuner — the sentinel
+    ``"calibrate"`` runs a short in-process calibration sweep on the
+    rung's own communicator (sizes via ``HUNT_CAL_SIZES``, bytes,
+    comma-separated); ``tune_self=True`` additionally traces the
+    step once and rebuilds the optimizer with ``tune_trace=`` so the
+    bucket sizing comes from the tuner, not the constants — the
+    wire_tuned_* rung axis."""
     import chainermn_tpu as cmn
 
     comm = cmn.create_communicator(comm_name)
+    if profile == "calibrate":
+        from chainermn_tpu.comm_wire.autotune import calibrate
+
+        # a PINNED profile (the env path, committed beside the capture)
+        # takes precedence when it matches this rung's mesh: its hash
+        # is then stable across captures, so perf_history GATES the
+        # tuned rows.  Only without one does the rung calibrate
+        # in-process — a fresh hash every capture, which perf_history
+        # honestly discloses as a retune instead of gating.
+        profile = _pinned_profile(comm.mesh)
+        if profile is None:
+            sizes = tuple(int(s) for s in os.environ.get(
+                "HUNT_CAL_SIZES", "16384,262144,1048576"
+            ).split(","))
+            profile = calibrate(comm, sizes=sizes, repeats=1,
+                                label=f"bench:{name}")
     model = model_ctor()
     x, y, init_arg = batch_fn(comm)
-    params = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
-    opt = cmn.create_multi_node_optimizer(
-        tx, comm, double_buffering=double_buffering, wire=wire,
-        overlap=overlap,
-    )
-    step = cmn.build_train_step(
-        comm, lambda p, b: loss_of(model, p, b), opt, donate=False
-    )
-    params, opt_state = step.place(params, opt.init(params))
+    params0 = comm.bcast_data(model.init(jax.random.PRNGKey(0), init_arg))
+
+    def build(tune_trace=None):
+        opt = cmn.create_multi_node_optimizer(
+            tx, comm, double_buffering=double_buffering, wire=wire,
+            overlap=overlap, profile=profile, tune_trace=tune_trace,
+        )
+        step = cmn.build_train_step(
+            comm, lambda p, b: loss_of(model, p, b), opt, donate=False
+        )
+        return opt, step
+
+    opt, step = build()
+    params, opt_state = step.place(params0, opt.init(params0))
     bx = jax.device_put(x, step.batch_sharding)
     by = jax.device_put(y, step.batch_sharding)
+    if tune_self:
+        # the tuned leg: trace the baseline-built step (free — nothing
+        # runs), hand the trace's cost records + the profile to the
+        # factory, rebuild.  The rebuilt plan is what the fingerprint
+        # fields below disclose.
+        tr = step.collective_trace(params, opt_state, (bx, by))
+        opt, step = build(tr)
+        params, opt_state = step.place(params0, opt.init(params0))
+        # what the measured model PREDICTS for the tuned program's
+        # reductions — held beside the measured step time on the row,
+        # so a capture shows prediction quality, not just the verdict
+        from chainermn_tpu.comm_wire.autotune import predict_sync_time
+
+        tuned_tr = step.collective_trace(params, opt_state, (bx, by))
+        pred = predict_sync_time(tuned_tr.records, profile)
+        if pred is not None:
+            extra.setdefault("predicted_sync_ms", round(pred * 1e3, 4))
     inner = step.get_jitted(params, opt_state)
 
     @jax.jit
@@ -159,13 +248,13 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
     extra = dict(extra)
     extra.setdefault("overlap", getattr(opt, "overlap", "none"))
     if getattr(opt, "wire", None) is not None:
-        from chainermn_tpu import comm_wire as _cw
-
         # schedule-aware fingerprint (ISSUE 11): the per-bucket
         # schedule census + agreed plan hash identify WHAT program a
         # wire_* row measured, so a capture where the planner silently
-        # collapsed hier to flat reads as a config change, not noise
-        wplan = _cw.plan_wire(params, opt.wire, comm.mesh)
+        # collapsed hier to flat reads as a config change, not noise.
+        # opt.wire_plan folds the profile in (ISSUE 12), so the hash
+        # here IS the one plan_agreement would exchange.
+        wplan = opt.wire_plan(params)
         plan = wplan.plan
         extra.setdefault("wire_codec", opt.wire.codec)
         extra.setdefault("wire_buckets", plan.n_buckets)
@@ -173,6 +262,15 @@ def _run_sync(name, model_ctor, batch_fn, loss_of, tx, *,
         extra.setdefault("wire_schedules", wplan.schedule_census())
         extra.setdefault("wire_plan_hash", wplan.plan_hash()[:12])
         extra.setdefault("mesh_shape", dict(comm.mesh.shape))
+        if getattr(opt, "profile", None) is not None:
+            # tuned-row provenance (ISSUE 12): the profile content
+            # hash makes a retune read as a DISCLOSED config change in
+            # perf_history (annotate, not gate), and the tuned knobs
+            # show what the tuner actually chose
+            extra.setdefault("profile_hash",
+                             opt.profile.profile_hash()[:12])
+            extra.setdefault("tuned_bucket_bytes", opt.wire.bucket_bytes)
+            extra.setdefault("tuned_max_buckets", opt.wire.max_buckets)
     else:
         extra.setdefault("wire_codec", "per_leaf")
         extra.setdefault(
@@ -525,6 +623,38 @@ def _variants():
         variants[rung] = (
             lambda rung=rung, kw=kw: _run_hier_rung(rung, kw)
         )
+    # wire_tuned_* rungs (ISSUE 12): the measured-feedback autotune
+    # A/B.  *_base is the fixed-constant wire (identical machinery to
+    # wire_bucketed_sync but its own rung name so the off/on pair reads
+    # as one A/B and survives rung-list edits together); the tuned leg
+    # calibrates a BandwidthProfile on the rung's own mesh, traces the
+    # step, and rebuilds with profile+tune_trace — bucket sizing and
+    # flat-vs-hier both measured.  Runs on the flat 8-dev CPU mesh AND
+    # the CHAINERMN_TPU_FAKE_SLICE_SIZE hierarchical mesh (2 synthetic
+    # slices of 4); every tuned row carries profile_hash /
+    # wire_plan_hash / wire_schedules provenance.  On the CPU mesh the
+    # profile measures dispatch latency, not interconnect — the A/B
+    # bounds tuning machinery cost; the real curves need the TPU
+    # capture (docs/performance.md "Measured-feedback autotuning").
+    for rung, kw in {
+        "wire_tuned_base": dict(wire="auto"),
+        "wire_tuned": dict(wire="auto", profile="calibrate",
+                           tune_self=True),
+    }.items():
+        variants[rung] = (
+            lambda rung=rung, kw=kw: _run_sync(
+                rung, ml_ctor, ml_batch, ml_loss_of, ml_tx, **kw
+            )
+        )
+    for rung, kw in {
+        "wire_tuned_hier_base": dict(wire="auto",
+                                     comm_name="hierarchical"),
+        "wire_tuned_hier": dict(wire="auto", comm_name="hierarchical",
+                                profile="calibrate", tune_self=True),
+    }.items():
+        variants[rung] = (
+            lambda rung=rung, kw=kw: _run_hier_rung(rung, kw)
+        )
     # telemetry overhead A/B (ISSUE 10): host-driven step path,
     # enabled vs disabled, min-of-N fields from the shared Histogram
     variants["telemetry_overhead"] = lambda: _run_telemetry_overhead(
@@ -554,6 +684,8 @@ def main():
          "wire_perleaf_sync", "wire_perleaf_dummy", "wire_bucketed_sync",
          "wire_bucketed_dummy", "wire_int8_sync", "wire_int8_dummy",
          "wire_flat", "wire_hier", "wire_hier_int8",
+         "wire_tuned_base", "wire_tuned",
+         "wire_tuned_hier_base", "wire_tuned_hier",
          "overlap_off", "overlap_on", "overlap_int8_on",
          "overlap_resnet_off", "overlap_resnet_on",
          "telemetry_overhead"]

@@ -27,6 +27,13 @@ from .checks import assert_within_budget
 # one ceiling notch of slack (= 8) so a bucket-count change inside the
 # promised <= 6 never trips the pin.  Numbers are literal (not imported)
 # so a planner default drift FAILS the pin instead of moving it.
+#
+# Measured-feedback tuning (ISSUE 12) does not get its own pins: these
+# ceilings are CONTRACTS a tuned plan must still satisfy, and the tuner
+# guarantees it structurally — candidate slot budgets never exceed
+# max_buckets, so tuning may only REDUCE collective counts (pinned by
+# tests/test_autotune.py enforcing mlp_train_step on a profile-tuned
+# compiled step).
 BUDGETS = {
     # ISSUE 5 acceptance: the ResNet-50 train step stays <= 8 all-reduce
     # (267 leaves -> 4 default buckets + 1 loss pmean measured; 8 is the

@@ -26,6 +26,15 @@ Two pieces:
   the eager bcast.  The chosen schedule lands in the :class:`WirePlan`
   whose hash ``plan_agreement`` exchanges, so ranks cannot schedule
   apart.
+* :mod:`.autotune` — the measured-feedback autotuner (ISSUE 12): a
+  :class:`BandwidthProfile` artifact (per hop/class achieved-bandwidth
+  curves + launch latencies, from ``profile_from_attribution`` over
+  any telemetry export or a short ``calibrate`` sweep) that replaces
+  the fixed 4 MiB/6-slot constants and the analytic flat-vs-hier byte
+  rule with measured predictions; the profile's content hash is folded
+  into ``WirePlan.plan_hash()`` so ``plan_agreement`` keeps ranks from
+  tuning apart, and a rank missing the profile file raises
+  :class:`ProfileMissingError` before the first collective.
 
 Threaded through ``optimizers._sync_grads`` (compiled tier), the
 double-buffering and ZeRO optimizers, and the eager
@@ -70,6 +79,21 @@ from .schedules import (  # noqa: F401
     reduce_wire,
     schedule_for_bucket,
     zero_residuals_wire,
+)
+from .autotune import (  # noqa: F401
+    DEFAULT_CALIBRATION_SIZES,
+    PROFILE_ENV,
+    BandwidthProfile,
+    ProfileMissingError,
+    calibrate,
+    is_wire_record,
+    predict_bucket_sync,
+    predict_collective,
+    predict_cost,
+    predict_hier_triple,
+    predict_sync_time,
+    profile_from_attribution,
+    resolve_profile,
 )
 from .overlap import (  # noqa: F401
     OVERLAP_MODES,
@@ -119,8 +143,11 @@ def plan_agreement(comm, plan, *, max_attempts: int = 4):
     )
     if any(h != mine for h in hashes):
         raise WirePlanMismatchError(
-            f"bucket-plan hash mismatch across processes: {hashes} "
-            "(plans are pure functions of gradient shapes — a mismatch "
-            "means the processes built different models)"
+            f"wire-plan hash mismatch across processes: {hashes} "
+            "(the hash covers bucket layout, per-bucket schedule, mesh "
+            "signature, and — when measured tuning is active — the "
+            "BandwidthProfile content hash: a mismatch means the "
+            "processes built different models, see different meshes, "
+            "or loaded different wire profiles)"
         )
     return mine

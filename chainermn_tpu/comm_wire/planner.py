@@ -201,13 +201,16 @@ def tune_wire_for_trace(
     records,
     base_bytes: int = DEFAULT_BUCKET_BYTES,
     max_buckets: int = DEFAULT_MAX_BUCKETS,
+    profile=None,
+    schedule: str = "auto",
+    shape: str = "allreduce",
 ):
     """``(bucket_bytes, max_buckets)`` tuned from a program's
     :class:`~chainermn_tpu.analysis.trace.CollectiveRecord` cost fields
     — the decision path that consumes ``bytes_on_wire`` + ``hop``.
 
-    Two rules, both derived from the byte/latency accounting the
-    records carry:
+    With ``profile=None`` (default) the analytic rules apply, both
+    derived from the byte/latency accounting the records carry:
 
     * the byte target scales with the worst hop class any *reduction*
       record crosses (``_HOP_LATENCY_SCALE``): an inter-slice launch
@@ -217,24 +220,143 @@ def tune_wire_for_trace(
       bucket, the slot budget collapses to 1 — a small model gains
       nothing from splitting, and every extra bucket is a pure launch
       latency loss.
+
+    With a :class:`~chainermn_tpu.comm_wire.autotune.BandwidthProfile`,
+    the analytic scaling is replaced by MEASURED minimization: for each
+    candidate slot budget ``B`` in ``1..max_buckets`` the total
+    gradient payload is split into ``B`` buckets and the synchronous
+    wire time is predicted — each candidate priced as what the wire
+    would ACTUALLY issue for it under ``schedule`` (the flat psum, or
+    the staged triple; a pinned schedule is priced as pinned —
+    :func:`~chainermn_tpu.comm_wire.autotune.predict_bucket_sync`);
+    the cheapest ``B`` wins (ties to the smaller count).
+    Candidates never exceed ``max_buckets``, so a tuned plan can only
+    REDUCE collective counts — every ``analysis.budgets`` ceiling that
+    held for the constants holds for any tune.  Falls back to the
+    analytic rules when the profile cannot price the trace (unknown
+    axis sizes, no curve for the hop).
+
+    Records whose ``bytes_on_wire`` is ``None`` (meshless traces — axis
+    sizes unknown at trace time) fall back to their ``payload_bytes``
+    with ONE warning per call: silently dropping them let a
+    partially-seeded trace under-count its traffic and tune toward a
+    1-bucket plan sized for a fraction of the real payload.
     """
     reductions = [
         r for r in records
         if getattr(r, "cls", None) in ("all_reduce", "reduce_scatter")
     ]
+    if profile is not None:
+        tuned = _tune_with_profile(reductions, max_buckets, profile,
+                                   schedule, shape)
+        if tuned is not None:
+            return tuned
+    # analytic rules — also the fallback when the profile cannot price
+    # the trace.  The meshless-payload warning lives HERE, after the
+    # profile branch: a successful measured tune consults payload_bytes
+    # directly, so warning about an analytic fallback it never took
+    # would be a false diagnostic.
     scale = max(
         (_HOP_LATENCY_SCALE.get(getattr(r, "hop", "flat"), 2)
          for r in reductions),
         default=1,
     )
     bucket_bytes = int(base_bytes) * scale
-    total = sum(
-        r.bytes_on_wire for r in reductions
-        if getattr(r, "bytes_on_wire", None)
-    )
+    total = 0
+    unpriced = 0
+    for r in reductions:
+        bow = getattr(r, "bytes_on_wire", None)
+        if bow is not None:
+            # 0 is a PRICED value (a world-1 axis ships nothing), not a
+            # missing one — only None means the trace couldn't price it
+            total += int(bow)
+        else:
+            unpriced += int(getattr(r, "payload_bytes", 0) or 0)
+    if unpriced:
+        import warnings
+
+        warnings.warn(
+            "tune_wire_for_trace: reduction record(s) carry no "
+            "bytes_on_wire (meshless trace — seed axis_sizes= at trace "
+            "time to price them); falling back to their payload bytes "
+            f"({unpriced} B) so the tune cannot under-count traffic",
+            stacklevel=2,
+        )
+        total += unpriced
     if total and total <= bucket_bytes:
         return bucket_bytes, 1
     return bucket_bytes, max_buckets
+
+
+def _tune_with_profile(reductions, max_buckets, profile,
+                       schedule: str = "auto",
+                       shape: str = "allreduce"):
+    """Measured bucket sizing: minimize predicted synchronous wire time
+    over candidate slot budgets.  ``None`` when the profile cannot
+    price the trace — the caller then applies the analytic rules —
+    and when ``max_buckets`` is the falsy no-cap sentinel: the caller
+    explicitly asked for an UNBOUNDED plan, and "tune within the cap"
+    has no cap to tune within (the analytic path preserves the
+    sentinel; silently substituting the default 6 would make the same
+    arguments plan differently with and without a profile).
+
+    The gradient payload is the LARGEST per-class total, not the sum
+    over all reduction records: a trace of an already-hier-staged step
+    carries each bucket twice (a full-payload intra reduce_scatter AND
+    a shard-payload inter all_reduce), and summing both legs would
+    tune for ~1.25x the real traffic.  Candidates are priced by
+    :func:`~chainermn_tpu.comm_wire.autotune.predict_bucket_sync` over
+    the UNION of the trace's sync axes — what the wire would actually
+    issue for that bucket (the flat psum, or the staged triple with
+    the slow inter hop priced on its own curve) — not by a flat
+    all_reduce over whichever single record happened to be largest
+    (which, on a staged trace, was the intra-only reduce_scatter and
+    silently dropped the inter bottleneck from the minimization)."""
+    from .autotune import is_wire_record, predict_bucket_sync
+
+    slots = int(max_buckets or 0)
+    if slots < 1:
+        return None
+    per_cls: dict = {}
+    sizes_env: dict = {}
+    for r in reductions:
+        if not is_wire_record(r):
+            # activation-shaped (>=2-D operand) all_reduce: a forward
+            # TP/MoE psum, not wire traffic — the gradient wire ships
+            # FLAT buckets (1-D; the loss pmean is 0-D, ZeRO's blocked
+            # (n, k) reduce_scatters keep their own class).  Counting
+            # activations would size buckets for bytes the wire never
+            # carries and union in tensor-parallel axes the sync never
+            # crosses.
+            continue
+        pb = int(getattr(r, "payload_bytes", 0) or 0)
+        cls = getattr(r, "cls", "all_reduce")
+        per_cls[cls] = per_cls.get(cls, 0) + pb
+        for a, s in zip(getattr(r, "axes", ()),
+                        getattr(r, "axis_sizes", ())):
+            if int(s) > 0:
+                sizes_env[str(a)] = int(s)
+    payload_total = max(per_cls.values(), default=0)
+    if not payload_total or not sizes_env:
+        return None
+    axes = tuple(sorted(sizes_env))
+    sizes = tuple(sizes_env[a] for a in axes)
+    best = None  # (predicted seconds, B)
+    for b in range(1, slots + 1):
+        per = -(-payload_total // b)
+        t_one = predict_bucket_sync(profile, per, axes, sizes,
+                                    schedule=schedule, shape=shape)
+        if t_one is None:
+            return None
+        t = b * t_one
+        # ties go to FEWER buckets, robustly: the ring formula's
+        # per-bucket int() truncation can make a larger B "win" by
+        # nanoseconds on a genuine tie, so a larger B must beat the
+        # incumbent by a real relative margin to displace it
+        if best is None or t < best[0] * (1 - 1e-6):
+            best = (t, b)
+    _, b = best
+    return max(-(-payload_total // b), 1), b
 
 
 def plan_for_trace(
@@ -244,6 +366,8 @@ def plan_for_trace(
     max_buckets: int = DEFAULT_MAX_BUCKETS,
     mesh=None,
     schedule: str = "auto",
+    profile=None,
+    shape: str = "allreduce",
 ):
     """Plan buckets for ``tree`` with the byte target / slot budget
     tuned by a :class:`CollectiveTrace`'s cost records (typically the
@@ -255,10 +379,14 @@ def plan_for_trace(
     flat psum vs the hier rs→ar→ag triple) and returns a
     :class:`~chainermn_tpu.comm_wire.schedules.WirePlan` whose hash
     covers layout AND schedule; without it the bare
-    :class:`BucketPlan` is returned as before.
+    :class:`BucketPlan` is returned as before.  ``profile`` (a
+    ``comm_wire.autotune.BandwidthProfile``) switches both the bucket
+    sizing and the schedule decision onto the measured cost model and
+    folds its content hash into the plan hash.
     """
     bucket_bytes, slots = tune_wire_for_trace(
-        trace.records, base_bytes, max_buckets
+        trace.records, base_bytes, max_buckets, profile=profile,
+        schedule=schedule, shape=shape,
     )
     if mesh is None:
         return plan_of_tree(tree, bucket_bytes, slots)
@@ -270,6 +398,8 @@ def plan_for_trace(
         WireConfig(bucket_bytes=bucket_bytes, max_buckets=slots,
                    schedule=schedule),
         mesh,
+        profile=profile,
+        shape=shape,
     )
 
 

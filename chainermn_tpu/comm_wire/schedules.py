@@ -189,8 +189,47 @@ def hier_inter_savings(payload_bytes: int, split: AxisSplit) -> int:
     return flat_inter - hier_inter
 
 
+def _predicted_prefers_hier(payload: int, split: AxisSplit,
+                            axes: Tuple[str, ...], profile,
+                            shape: str = "allreduce") -> Optional[bool]:
+    """Measured flat-vs-hier decision (ISSUE 12): predicted time of the
+    bucket's FLAT program vs its STAGED one, each leg priced by the
+    profile's interpolated achieved bandwidth with the per-hop launch
+    floor (``autotune.predict_collective``).  ``shape`` names what the
+    caller actually issues: ``"allreduce"`` (the gradient wire — flat
+    psum vs the rs→ar→ag triple) or ``"zero"`` (the blocked ZeRO path
+    — rs+ag vs the staged 2rs+2ag), so the minimization models the
+    real program, not an all-reduce-shaped proxy.  ``None`` when any
+    leg is unpriceable — the caller then falls back to the analytic
+    byte heuristic rather than guessing.  Pure function of (payload,
+    split, shape, profile content), so ranks holding the same profile
+    decide alike."""
+    from .autotune import (
+        predict_collective,
+        predict_hier_triple,
+        predict_zero_flat,
+        predict_zero_hier,
+    )
+
+    sizes = (split.inter_size, split.intra_size)
+    order = {a: s for a, s in zip((split.inter, split.intra), sizes)}
+    flat_sizes = tuple(order.get(a, 0) for a in axes)
+    if shape == "zero":
+        flat_t = predict_zero_flat(profile, payload, axes, flat_sizes)
+        hier_t = predict_zero_hier(profile, payload, split)
+    else:
+        flat_t = predict_collective(
+            profile, "all_reduce", payload, axes, flat_sizes
+        )
+        hier_t = predict_hier_triple(profile, payload, split)
+    if flat_t is None or hier_t is None:
+        return None
+    return hier_t < flat_t
+
+
 def schedule_for_bucket(record, mesh, axes: Optional[Sequence[str]] = None,
-                        requested: str = "auto") -> str:
+                        requested: str = "auto", profile=None,
+                        shape: str = "allreduce") -> str:
     """Pick the collective schedule for one bucket — the planner-side
     decision the ISSUE's cost-model fields exist to drive.
 
@@ -200,15 +239,20 @@ def schedule_for_bucket(record, mesh, axes: Optional[Sequence[str]] = None,
     to the record's own axes, else every mesh axis).  ``requested``:
     the ``WireConfig.schedule`` knob — ``"flat"`` pins flat,
     ``"hier_rs_ag"`` forces the multi-hop schedule wherever the mesh
-    supports it, ``"auto"`` applies the decision rule: stage when the
-    ring-formula inter-hop savings clear
-    :data:`MIN_HIER_INTER_SAVINGS` (small payloads are launch-latency-
-    bound — three collectives lose to one).
+    supports it, ``"auto"`` applies the decision rule: with
+    ``profile=None``, stage when the ring-formula inter-hop savings
+    clear :data:`MIN_HIER_INTER_SAVINGS` (small payloads are
+    launch-latency-bound — three collectives lose to one); with a
+    ``comm_wire.autotune.BandwidthProfile``, stage when the MEASURED
+    cost model predicts the staged triple beats the flat psum
+    (:func:`_predicted_prefers_hier` — falling back to the analytic
+    byte rule when the profile cannot price a leg).
 
     Pure function of (payload bytes, axis names, axis sizes,
-    ``requested``): every rank computes the identical schedule from its
-    local view, which is what lets the choice live in the agreed
-    :class:`WirePlan` hash.
+    ``requested``, profile content): every rank computes the identical
+    schedule from its local view, which is what lets the choice live in
+    the agreed :class:`WirePlan` hash — and why the profile's content
+    hash must be IN that hash when one is used.
     """
     if requested not in ("auto",) + GRAD_SCHEDULES:
         raise ValueError(
@@ -226,6 +270,15 @@ def schedule_for_bucket(record, mesh, axes: Optional[Sequence[str]] = None,
     if requested == "hier_rs_ag":
         return "hier_rs_ag"
     payload = _payload_bytes_of(record)
+    if profile is not None:
+        verdict = _predicted_prefers_hier(payload, split, axes, profile,
+                                          shape=shape)
+        if verdict is not None:
+            return "hier_rs_ag" if verdict else "flat"
+    # analytic fallback: the ring-formula inter-byte rule.  Shared by
+    # both shapes as an approximation — ZeRO's rs/ag programs save
+    # inter bytes by roughly the same ratio as the all-reduce, and the
+    # shape-exact comparison is what the measured path above provides.
     if hier_inter_savings(payload, split) >= MIN_HIER_INTER_SAVINGS:
         return "hier_rs_ag"
     return "flat"
@@ -246,6 +299,12 @@ class WirePlan(NamedTuple):
     schedules: Tuple[str, ...]  # one of GRAD_SCHEDULES per bucket
     axes: Tuple[str, ...]       # sync axes the schedules stage over
     axis_sizes: Tuple[int, ...]
+    # content hash of the BandwidthProfile the schedules/sizing were
+    # decided against (ISSUE 12), None for analytic plans.  Part of
+    # plan_hash(): two ranks tuning from different profiles MUST
+    # mismatch at plan agreement even when their decisions happen to
+    # coincide on this model — the next model would diverge silently.
+    profile_hash: Optional[str] = None
 
     @property
     def buckets(self):
@@ -296,6 +355,11 @@ class WirePlan(NamedTuple):
         h.update(("|axes=" + ",".join(
             f"{a}:{s}" for a, s in zip(self.axes, self.axis_sizes)
         )).encode())
+        # profile material enters the hash ONLY when a profile was
+        # used: a profile-less plan hashes byte-identically to the
+        # pre-autotuner layer (pinned by regression test)
+        if self.profile_hash is not None:
+            h.update(f"|profile={self.profile_hash}".encode())
         return h.hexdigest()
 
     def describe(self) -> str:
@@ -307,13 +371,21 @@ class WirePlan(NamedTuple):
 
 
 def plan_wire(tree, wire: WireConfig, mesh,
-              axes: Optional[Sequence[str]] = None) -> WirePlan:
+              axes: Optional[Sequence[str]] = None,
+              profile=None, shape: str = "allreduce") -> WirePlan:
     """Plan buckets AND per-bucket schedules for ``tree``'s gradient
     wire over ``mesh``'s ``axes`` — the schedule-aware successor of
     :func:`~chainermn_tpu.comm_wire.planner.plan_of_tree` the optimizer
     tiers call.  Pure function of (leaf shapes/dtypes, wire knobs, axis
-    names+sizes): the returned plan's hash is the cross-process
-    agreement token.
+    names+sizes, profile content): the returned plan's hash is the
+    cross-process agreement token.
+
+    ``profile`` (a ``comm_wire.autotune.BandwidthProfile``) switches
+    every ``schedule="auto"`` bucket decision onto the measured cost
+    model and stamps the profile's content hash into the plan
+    (:attr:`WirePlan.profile_hash` — covered by ``plan_hash()``).  With
+    ``profile=None`` the plan is byte-identical to the pre-autotuner
+    layer.
 
     An explicit ``wire.schedule="hier_rs_ag"`` on a mesh with no
     genuine split — notably the width-1 ``mn_inter`` ragged-topology
@@ -337,11 +409,16 @@ def plan_wire(tree, wire: WireConfig, mesh,
         )
     scheds = tuple(
         schedule_for_bucket(b, dict(zip(axes, sizes)), axes=axes,
-                            requested=requested)
+                            requested=requested, profile=profile,
+                            shape=shape)
         for b in plan.buckets
     )
-    return WirePlan(plan=plan, schedules=scheds, axes=axes,
-                    axis_sizes=sizes)
+    return WirePlan(
+        plan=plan, schedules=scheds, axes=axes, axis_sizes=sizes,
+        profile_hash=(
+            profile.profile_hash() if profile is not None else None
+        ),
+    )
 
 
 # ----------------------------------------------------------------------

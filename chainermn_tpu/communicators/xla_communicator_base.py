@@ -476,20 +476,23 @@ class XlaCommunicatorBase(CommunicatorBase):
         split = self._hier_split
 
         def bucket_fn(b):
-            """The compiled program for one bucket — flat psum, or the
-            staged hier program when the communicator's ``wire_schedule``
-            knob (default "auto": the cost model) schedules it — a pure
-            function of bucket bytes + mesh + knob, so every process
-            picks the same program."""
+            """``(compiled program, schedule)`` for one bucket — flat
+            psum, or the staged hier program when the communicator's
+            ``wire_schedule`` knob (default "auto": the cost model)
+            schedules it — a pure function of bucket bytes + mesh +
+            knob, so every process picks the same program.  The
+            schedule rides the telemetry span so ``attribute()`` can
+            pair a staged bucket's span with its rs→ar→ag record
+            TRIPLE instead of mis-pairing it as one all_reduce."""
             if split is None or self._wire_schedule == "flat":
-                return fn
+                return fn, "flat"
             payload = int(b.size) * np.dtype(b.dtype).itemsize
             if _cw.schedule_for_bucket(
                 payload, self._mesh, axes=self.axis_names,
                 requested=self._wire_schedule,
             ) == "hier_rs_ag":
-                return self._allreduce_grad_hier_fns[op]
-            return fn
+                return self._allreduce_grad_hier_fns[op], "hier_rs_ag"
+            return fn, "flat"
 
         def run():
             # telemetry: per-bucket wire.ship / collective.psum spans
@@ -513,7 +516,7 @@ class XlaCommunicatorBase(CommunicatorBase):
                 # bit-identical to the serial schedule.
                 staged = [self._put(cat) for cat in packed]
                 red = [
-                    bucket_fn(plan.buckets[k])(s)
+                    bucket_fn(plan.buckets[k])[0](s)
                     for k, s in enumerate(staged)
                 ]
             else:
@@ -528,11 +531,37 @@ class XlaCommunicatorBase(CommunicatorBase):
                     red = []
                     for k, s in enumerate(staged):
                         b = plan.buckets[k]
-                        with _obs.span(
-                            "collective.psum", bucket=k,
+                        f, sched = bucket_fn(b)
+                        args = dict(
+                            bucket=k,
                             bytes=b.size * np.dtype(b.dtype).itemsize,
-                        ):
-                            r = bucket_fn(b)(s)
+                        )
+                        if sched == "hier_rs_ag":
+                            # the span covers the WHOLE staged triple:
+                            # disclose the schedule + each leg's EXACT
+                            # operand bytes as the hier program issues
+                            # them — rs on the intra-padded native
+                            # bucket, ar on the wire-dtype-cast shard,
+                            # ag on the native shard — so attribute()
+                            # pairs the span with the bucket's
+                            # rs->ar->ag records byte-exactly instead
+                            # of mis-pricing it as one psum
+                            native = np.dtype(b.dtype).itemsize
+                            wire_i = (
+                                native
+                                if self._allreduce_grad_dtype is None
+                                else np.dtype(
+                                    self._allreduce_grad_dtype
+                                ).itemsize
+                            )
+                            shard = -(-int(b.size) // split.intra_size)
+                            padded = shard * split.intra_size
+                            args["schedule"] = sched
+                            args["rs_bytes"] = padded * native
+                            args["ar_bytes"] = shard * wire_i
+                            args["ag_bytes"] = shard * native
+                        with _obs.span("collective.psum", **args):
+                            r = f(s)
                             jax.block_until_ready(r)
                         red.append(r)
             out = _cw.unpack_stacked(

@@ -15,6 +15,13 @@ first unmatched ``all_reduce`` record whose per-shard payload bytes
 equal the span's ``bytes`` arg; when no byte-exact record exists the
 first unmatched record of the class is taken in program order (the
 wire's buckets are deterministic, so program order IS bucket order).
+Staged buckets are triple-aware (ISSUE 12): the eager hier wire times
+one compiled program that executes a whole rs→ar→ag triple, and marks
+its span ``schedule="hier_rs_ag"`` with the shard payload — the span
+then consumes the bucket's reduce_scatter record (byte-exact on the
+full bucket) plus the shard-payload all_reduce and all_gather legs as
+ONE attribution whose wire bytes are the triple's total, instead of
+mis-pairing with a lone all_reduce and stranding the rs/ag records.
 Unmatched records and spans are reported, not silently dropped —
 attribution that quietly loses a collective would hide exactly the
 discrepancies it exists to surface.
@@ -89,6 +96,35 @@ class AttributionReport:
                 tot_t += a.duration_s
         return tot_b / tot_t if tot_t > 0 else None
 
+    def bandwidth_points(self) -> List[tuple]:
+        """``(hop, cls, payload_bytes, achieved_bytes_per_sec,
+        duration_s)`` per byte-priced match — the curve export the
+        measured-feedback autotuner bins into a ``BandwidthProfile``
+        (``comm_wire.autotune.profile_from_attribution`` consumes
+        either this report or the raw timeline+trace pair).
+
+        Staged-bucket matches (a span covering a whole hier rs→ar→ag
+        triple, marked ``schedule="hier_rs_ag"``) are EXCLUDED: the
+        composite duration spans three collectives over two hop
+        classes, so it belongs to no single (hop, class) curve —
+        binning it under the head record's (intra, reduce_scatter)
+        would poison the intra curve with inter-bound timings."""
+        out = []
+        for a in self.matched:
+            if not a.achieved_bytes_per_sec:
+                continue
+            if a.span_args.get("schedule") == "hier_rs_ag":
+                continue
+            rec = a.record
+            out.append((
+                getattr(rec, "hop", "flat"),
+                getattr(rec, "cls", "all_reduce"),
+                int(getattr(rec, "payload_bytes", 0) or 0),
+                float(a.achieved_bytes_per_sec),
+                float(a.duration_s),
+            ))
+        return out
+
 
 def _collective_spans(timeline) -> List[dict]:
     return [
@@ -119,7 +155,52 @@ def attribute(timeline, trace) -> AttributionReport:
     # (in program order) the record a later span matches exactly,
     # mispricing both
     picks: Dict[int, Tuple[int, bool]] = {}  # span idx -> (rec idx, exact)
+    extras: Dict[int, List[int]] = {}  # span idx -> extra record idxs
+
+    def take_exact(cls, nb, hop=None):
+        for i, r in enumerate(records):
+            if taken[i] or r.cls != cls or \
+                    int(r.payload_bytes) != int(nb):
+                continue
+            if hop is not None and getattr(r, "hop", None) != hop:
+                # triple legs are HOP-pinned: a tiny staged bucket's
+                # 4-byte ar leg must not consume the 4-byte loss pmean
+                # (a mixed-hop record) just because the bytes collide
+                continue
+            taken[i] = True
+            return i
+        return None
+
+    # pass 1a: staged-bucket spans (the eager hier wire marks them with
+    # schedule="hier_rs_ag" + per-leg operand bytes) consume their
+    # whole rs->ar->ag record TRIPLE: the span times ONE compiled
+    # program that executes three collectives, so pairing it with a
+    # single all_reduce record — the shard-payload inter hop, or worse
+    # the loss pmean — would misprice both sides and leave the rs/ag
+    # records spuriously unmatched.  Each leg matches on ITS OWN
+    # disclosed bytes (rs: intra-padded native bucket; ar: wire-cast
+    # shard; ag: native shard), so padding and cast codecs cannot
+    # defeat the byte-exact pairing.
     for si, sp in enumerate(spans):
+        args = sp["args"]
+        if args.get("schedule") != "hier_rs_ag":
+            continue
+        leg_bytes = [args.get(k) for k in
+                     ("rs_bytes", "ar_bytes", "ag_bytes")]
+        if any(b is None for b in leg_bytes):
+            continue
+        head = take_exact("reduce_scatter", leg_bytes[0], "intra")
+        if head is None:
+            continue  # no staged record: the generic passes handle it
+        legs = [
+            take_exact("all_reduce", leg_bytes[1], "inter"),
+            take_exact("all_gather", leg_bytes[2], "intra"),
+        ]
+        picks[si] = (head, True)
+        extras[si] = [i for i in legs if i is not None]
+    for si, sp in enumerate(spans):
+        if si in picks:
+            continue
         nb = span_bytes(sp)
         if nb is None:
             continue
@@ -150,6 +231,12 @@ def attribute(timeline, trace) -> AttributionReport:
         rec = records[i]
         dur = float(sp["dur"])
         bow = rec.bytes_on_wire
+        for j in extras.get(si, ()):
+            # a staged span's wire bytes are the TRIPLE's total — the
+            # head rs record plus its consumed ar/ag legs
+            leg = records[j].bytes_on_wire
+            if bow is not None and leg is not None:
+                bow += leg
         report.matched.append(Attribution(
             record=rec,
             span_name=sp["name"],

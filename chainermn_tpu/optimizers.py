@@ -98,7 +98,8 @@ def _sync_grads_per_leaf(grads, comm, comm_dtype=None, axes=None):
     return jax.tree_util.tree_map(one, grads)
 
 
-def _sync_grads_wire(grads, comm, wire, axes=None, residuals=None):
+def _sync_grads_wire(grads, comm, wire, axes=None, residuals=None,
+                     profile=None):
     """Bucketed wire gradient sync: flatten the grad pytree into the
     deterministic bucket plan, reduce each bucket under its planner-
     chosen collective schedule (``comm_wire.schedules`` — ONE flat psum
@@ -119,7 +120,7 @@ def _sync_grads_wire(grads, comm, wire, axes=None, residuals=None):
 
     axes = comm.axis_names if axes is None else tuple(axes)
     n = _axis_size(comm, axes)
-    wplan = _cw.plan_wire(grads, wire, comm.mesh, axes)
+    wplan = _cw.plan_wire(grads, wire, comm.mesh, axes, profile=profile)
     buckets = _cw.flatten_to_buckets(wplan.plan, grads)
     means, new_res = _cw.reduce_wire(
         buckets, wplan, n, wire, residuals if residuals else None
@@ -172,6 +173,25 @@ def _tree_all_finite(grads):
     return out
 
 
+class _ProfiledPlanToken(NamedTuple):
+    """Agreement token for the mesh-less comm path: a bare
+    ``BucketPlan`` plus the bandwidth-profile content hash, combined
+    with the same ``|profile=`` folding as ``WirePlan.plan_hash`` (the
+    mesh path) — one spelling of "the plan AND what tuned it" for
+    ``plan_agreement`` to exchange."""
+
+    plan: Any
+    profile_hash: str
+
+    def plan_hash(self) -> str:
+        import hashlib
+
+        h = hashlib.sha256()
+        h.update(self.plan.plan_hash().encode())
+        h.update(f"|profile={self.profile_hash}".encode())
+        return h.hexdigest()
+
+
 class MultiNodeOptimizerState(NamedTuple):
     inner_state: Any
     step: jnp.ndarray
@@ -203,14 +223,67 @@ class _MultiNodeOptimizer:
     explicitly.
     """
 
+    # the program SHAPE the measured tuner prices candidates as (ISSUE
+    # 12): the plain wrapper syncs with the flat psum / hier triple;
+    # ZeRO overrides to "zero" (rs+ag down/up) so its bucket sizing is
+    # minimized against the collectives it actually issues
+    _wire_shape = "allreduce"
+
     def __init__(self, actual_optimizer: optax.GradientTransformation,
-                 comm, wire="auto", overlap="none", tune_trace=None):
+                 comm, wire="auto", overlap="none", tune_trace=None,
+                 profile=None):
         from .comm_wire import resolve_overlap, resolve_wire
+        from .comm_wire.autotune import resolve_profile
         from .comm_wire.planner import tune_wire_for_trace
 
         self._opt = actual_optimizer
         self._comm = comm
         self._wire = resolve_wire(wire, comm)  # None => per-leaf legacy
+        # ISSUE 12: resolve the profile HERE, at construction — a rank
+        # whose launch env lost the profile file raises
+        # ProfileMissingError before any collective (or plan exchange)
+        # runs, instead of silently planning with the constants while
+        # its peers tune
+        self._profile = resolve_profile(profile)
+        if self._profile is not None and self._wire is None:
+            # the legacy per-leaf path has no plan to tune and no
+            # WirePlan hash to disclose the profile through — accepting
+            # it would be untracked analytic behavior the user believes
+            # is measured-tuned (same fail-at-the-cause contract as
+            # ProfileMissingError)
+            raise ValueError(
+                "profile= requires the bucketed wire: "
+                f"wire={wire!r} resolved to the legacy per-leaf path, "
+                "which consults no plan the profile could tune (and no "
+                "plan hash that would disclose it); drop the profile "
+                "or select a bucketed wire"
+            )
+        if self._profile is not None:
+            mesh = getattr(comm, "mesh", None)
+            if mesh is not None and not self._profile.matches_mesh(mesh):
+                # the documented guarantee: a wrong-topology profile can
+                # NEVER silently tune a mesh.  Every rank loading the
+                # same stale capture would pass plan agreement (hashes
+                # identical) while pricing this mesh's hops through
+                # foreign curves — so the signature check must live
+                # HERE, at construction, not only in the hash.
+                from .comm_wire.autotune import BandwidthProfile
+
+                raise ValueError(
+                    "wire profile was captured on mesh "
+                    f"{self._profile.mesh_axes} but this "
+                    "communicator's mesh is "
+                    f"{BandwidthProfile.mesh_signature(mesh)}: a "
+                    "wrong-topology profile would silently tune with "
+                    "foreign curves on every rank at once — "
+                    "recalibrate on this topology (python -m "
+                    "chainermn_tpu.comm_wire.autotune --calibrate); "
+                    "for a telemetry-scraped profile of THIS mesh, "
+                    "note profile_from_attribution defaults its "
+                    "signature to the axes the trace's collectives "
+                    "crossed — on a hybrid (e.g. DP x TP) mesh pass "
+                    "mesh= explicitly so the full topology is stamped"
+                )
         if (
             self._wire is not None
             and tune_trace is not None
@@ -222,9 +295,15 @@ class _MultiNodeOptimizer:
             # until now) instead of the fixed 4 MiB/6-bucket constants:
             # the byte target scales with the worst hop class the
             # trace's reductions cross, and a small total collapses the
-            # slot budget to 1.
+            # slot budget to 1.  With a profile (ISSUE 12) the sizing
+            # is measured instead: predicted sync time minimized over
+            # candidate slot budgets.
             records = getattr(tune_trace, "records", tune_trace)
-            bucket_bytes, max_buckets = tune_wire_for_trace(records)
+            bucket_bytes, max_buckets = tune_wire_for_trace(
+                records, profile=self._profile,
+                schedule=getattr(self._wire, "schedule", "auto"),
+                shape=self._wire_shape,
+            )
             self._wire = self._wire._replace(
                 bucket_bytes=bucket_bytes, max_buckets=max_buckets
             )
@@ -238,6 +317,38 @@ class _MultiNodeOptimizer:
     def wire(self):
         """Resolved ``comm_wire.WireConfig`` (None on the legacy path)."""
         return self._wire
+
+    @property
+    def profile(self):
+        """Resolved ``comm_wire.autotune.BandwidthProfile`` driving the
+        measured bucket sizing + schedule decisions (None = analytic)."""
+        return self._profile
+
+    def wire_plan(self, tree, axes=None):
+        """The schedule-aware :class:`~chainermn_tpu.comm_wire.
+        WirePlan` this optimizer's sync derives for ``tree`` — profile
+        included, so its ``plan_hash()`` is exactly what
+        ``plan_agreement`` exchanges (bench fingerprints and tests read
+        the wire through this one path)."""
+        from . import comm_wire as _cw
+
+        if self._wire is None:
+            raise ValueError("the legacy per-leaf wire has no plan")
+        mesh = getattr(self._comm, "mesh", None)
+        if mesh is None:
+            # mesh-less comms sync through plan_of_tree (see
+            # _check_plan_agreement / _zero_residuals) — there is no
+            # schedule-aware plan to hand back, and plan_wire would
+            # die deep in schedules.py on dict(None)
+            raise ValueError(
+                "wire_plan needs the communicator's mesh to derive "
+                "schedules, and this communicator has none; the "
+                "mesh-less layout is comm_wire.plan_of_tree(tree)"
+            )
+        return _cw.plan_wire(
+            tree, self._wire, mesh, axes,
+            profile=self._profile, shape=self._wire_shape,
+        )
 
     @property
     def overlap(self) -> str:
@@ -257,10 +368,18 @@ class _MultiNodeOptimizer:
         w = self._wire
         if w is None or not w.error_feedback:
             return ()
+        if getattr(self._comm, "mesh", None) is None:
+            # mesh-less comms have nothing to stage: residuals at full
+            # bucket width, exactly the pre-schedule shapes (the same
+            # comm shape _check_plan_agreement's plan_of_tree branch
+            # serves)
+            plan = _cw.plan_of_tree(params, w.bucket_bytes,
+                                    w.max_buckets)
+            return _cw.zero_residuals(plan, params)
         # schedule-aware shapes: a hier bucket's residual lives at the
         # compression point (the inter hop's scattered shard), not at
         # full bucket width
-        wplan = _cw.plan_wire(params, w, self._comm.mesh)
+        wplan = self.wire_plan(params)
         return _cw.zero_residuals_wire(wplan)
 
     def _check_plan_agreement(self, params):
@@ -280,13 +399,24 @@ class _MultiNodeOptimizer:
         if any(isinstance(l, jax.core.Tracer) for l in leaves):
             return
         # the exchanged hash covers bucket layout AND the per-bucket
-        # collective schedule (WirePlan.plan_hash): ranks scheduling
+        # collective schedule AND (ISSUE 12) the bandwidth-profile
+        # content hash (WirePlan.plan_hash): ranks scheduling or TUNING
         # apart would mis-pair collectives exactly like a layout split
         mesh = getattr(comm, "mesh", None)
         if mesh is not None:
-            plan = _cw.plan_wire(params, w, mesh)
+            plan = self.wire_plan(params)
         else:
             plan = _cw.plan_of_tree(params, w.bucket_bytes, w.max_buckets)
+            if self._profile is not None:
+                # mesh-less comms must not tune apart either: fold the
+                # profile content hash into the exchanged token exactly
+                # as WirePlan.plan_hash does, so two ranks whose
+                # analytic layouts coincide but whose profiles differ
+                # still mismatch here instead of diverging on the next
+                # profile-sensitive decision
+                plan = _ProfiledPlanToken(
+                    plan, self._profile.profile_hash()
+                )
         _cw.plan_agreement(comm, plan)
 
     def init(self, params):
@@ -316,15 +446,13 @@ class _MultiNodeOptimizer:
                 # the check lives INSIDE the sync branch: a skipped
                 # sync (no-exchange A/B, eager path) never touches the
                 # residual, so it must not raise (trace-time cost only).
-                from . import comm_wire as _cw
-
                 def res_shapes(wp):
                     return tuple(
                         wp.shard_size(i) for i in range(wp.n_buckets)
                     )
 
-                full = _cw.plan_wire(grads, self._wire, comm.mesh)
-                sub = _cw.plan_wire(grads, self._wire, comm.mesh, axes)
+                full = self.wire_plan(grads)
+                sub = self.wire_plan(grads, axes)
                 if res_shapes(full) != res_shapes(sub):
                     raise ValueError(
                         "error_feedback cannot sync over the axis "
@@ -341,7 +469,8 @@ class _MultiNodeOptimizer:
                 )
             else:
                 grads, residual = _sync_grads_wire(
-                    grads, comm, self._wire, axes=axes, residuals=residual
+                    grads, comm, self._wire, axes=axes,
+                    residuals=residual, profile=self._profile,
                 )
         updates, inner = self._opt.update(grads, state.inner_state, params)
         return updates, MultiNodeOptimizerState(
@@ -374,9 +503,7 @@ class _DoubleBufferingOptimizer(_MultiNodeOptimizer):
         are stored flat either way, but the SYNC of the previous step's
         buckets follows the planner-chosen schedule like the plain
         wrapper's."""
-        from . import comm_wire as _cw
-
-        return _cw.plan_wire(tree, self._wire, self._comm.mesh, axes)
+        return self.wire_plan(tree, axes)
 
     def _store(self, wplan, tree):
         """Flatten grads into the stale-grad buffer: flat buckets in the
@@ -473,6 +600,8 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
     State sharding is declared via :meth:`state_partition_spec`, which
     ``build_train_step`` consumes to lay the state out over the mesh.
     """
+
+    _wire_shape = "zero"  # measured tuning prices rs+ag, not one psum
 
     def _blocks(self, tree):
         n = self._comm.size
@@ -640,9 +769,13 @@ class _ZeroRedundancyOptimizer(_MultiNodeOptimizer):
             def _hier(payload_bytes: int) -> bool:
                 if self._wire is None or split is None:
                     return False
+                # shape="zero": the measured comparison prices the
+                # rs+ag-down/up programs this path actually issues,
+                # not the gradient wire's psum-vs-triple
                 return _sched_for(
                     payload_bytes, sizes_env, axes=axes,
-                    requested=requested,
+                    requested=requested, profile=self._profile,
+                    shape="zero",
                 ) == "hier_rs_ag"
 
             def _y_order(g):
@@ -778,6 +911,7 @@ def create_multi_node_optimizer(
     wire="auto",
     overlap="none",
     tune_trace=None,
+    profile=None,
 ) -> _MultiNodeOptimizer:
     """Wrap an optax optimizer for multi-chip training.
 
@@ -830,6 +964,24 @@ def create_multi_node_optimizer(
     ``tr = step.collective_trace(p, o, batch)``, then rebuild the
     optimizer with ``tune_trace=tr``.
 
+    ``profile``: a measured :class:`~chainermn_tpu.comm_wire.autotune.
+    BandwidthProfile` — or a path to one, or ``"auto"`` to load the
+    path named by ``CHAINERMN_TPU_WIRE_PROFILE`` — that closes the
+    telemetry→planner loop (ISSUE 12).  Every wire plan's
+    ``schedule="auto"`` flat-vs-hier decision is then made by
+    *predicted time* (interpolated achieved bandwidth + per-hop launch
+    latency) instead of the analytic byte heuristic, and with
+    ``tune_trace`` the bucket byte target / slot budget minimize
+    predicted sync time.  The profile's content hash is folded into
+    the ``WirePlan.plan_hash()`` exchanged by ``plan_agreement``, so
+    two ranks holding different profiles raise
+    ``WirePlanMismatchError`` before the first collective — and a rank
+    that cannot load the named profile raises
+    ``comm_wire.ProfileMissingError`` at construction rather than
+    silently planning with the constants.  Tuned plans only ever
+    REDUCE collective counts (candidates stay under ``max_buckets``),
+    so every ``analysis.budgets`` ceiling holds for any tune.
+
     ``overlap`` (``"none"``/``"bucket"``): the bucket-granularity
     comm/compute overlap engine (``comm_wire.overlap``).  With
     ``"bucket"``, ``build_train_step`` reschedules the compiled step so
@@ -877,7 +1029,7 @@ def create_multi_node_optimizer(
     else:
         cls = _MultiNodeOptimizer
     opt = cls(actual_optimizer, communicator, wire=wire, overlap=overlap,
-              tune_trace=tune_trace)
+              tune_trace=tune_trace, profile=profile)
     cfg = opt.wire  # resolved + validated ONCE, by the constructor
     if cfg is not None and cfg.error_feedback:
         if double_buffering:

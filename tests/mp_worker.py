@@ -974,6 +974,179 @@ def scenario_multihop_fault(pid, nproc, scratch):
     }
 
 
+def scenario_tuned_wire_fault(pid, nproc, scratch):
+    """ISSUE 12 satellite: the measured-feedback autotuner in a REAL
+    2-proc hierarchical world (2 processes x 2 local CPU devices —
+    process grouping = slice grouping, mesh (2, 2)).
+
+    Phase A — shared profile under faults: rank 0 writes ONE
+    BandwidthProfile file (atomic rename) into the shared scratch, both
+    ranks load it through ``create_multi_node_optimizer(profile=path)``.
+    The spawning test truncates obj-store exchanges #1 and #3 (the
+    standalone plan agreement below and the one ``opt.init`` re-runs):
+    each torn payload surfaces on every rank in lockstep, is retried,
+    and the tuned plan comes through with the profile hash folded into
+    the agreed ``WirePlan.plan_hash()`` — identical on every rank.  The
+    profile's slow-inter/fast-intra curves stage every bucket, so the
+    trace must carry the rs→ar→ag triple per bucket, and a short
+    training run completes with bit-identical digests across ranks.
+
+    Phase B — mismatched profile: rank 1 swaps in a perturbed profile
+    (one bandwidth point changed -> different content hash).  A fresh
+    optimizer's ``init`` must raise ``WirePlanMismatchError`` on BOTH
+    ranks BEFORE any collective — the schedules may even coincide on
+    this model; the hash-folded profile is what guarantees the
+    divergence is caught now rather than on the first model where the
+    decisions split.
+    """
+    import hashlib
+
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.comm_wire import (
+        BandwidthProfile, WireConfig, WirePlanMismatchError,
+        plan_agreement,
+    )
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = _comm("hierarchical")
+    assert dict(comm.mesh.shape) == {"mn_inter": nproc,
+                                     "mn_intra": comm.size // nproc}, (
+        dict(comm.mesh.shape)
+    )
+
+    def make_profile(inter_bw):
+        # slow inter, fast intra: the measured decision stages every
+        # bucket (predicted hier time beats the flat psum for any
+        # payload on these curves)
+        return BandwidthProfile(
+            mesh_axes=tuple(dict(comm.mesh.shape).items()),
+            curves={
+                ("inter", "all_reduce"): [(64, inter_bw),
+                                          (1 << 22, inter_bw)],
+                ("intra", "all_reduce"): [(64, 1e12), (1 << 22, 1e12)],
+                ("intra", "reduce_scatter"): [(64, 1e12),
+                                              (1 << 22, 1e12)],
+                ("intra", "all_gather"): [(64, 1e12), (1 << 22, 1e12)],
+                ("mixed", "all_reduce"): [(64, inter_bw),
+                                          (1 << 22, inter_bw)],
+            },
+            latency={"inter": 1e-9, "intra": 1e-9, "mixed": 1e-9},
+            label="tuned_wire_fault",
+        )
+
+    profile_path = os.path.join(scratch, "wire_profile.json")
+    if pid == 0:
+        tmp = profile_path + ".tmp"
+        make_profile(1e6).save(tmp)
+        os.replace(tmp, profile_path)  # readers never see a torn file
+    deadline = time.time() + 60
+    while not os.path.exists(profile_path):
+        if time.time() > deadline:
+            raise RuntimeError("rank 0 never published the profile")
+        time.sleep(0.05)
+
+    rng = np.random.RandomState(0)  # same seed -> same model everywhere
+    params = {
+        "w1": jnp.asarray(rng.randn(8, 16) * 0.3, jnp.float32),
+        "w2": jnp.asarray(rng.randn(16, 4) * 0.3, jnp.float32),
+        "w3": jnp.asarray(rng.randn(4, 4) * 0.3, jnp.float32),
+    }
+    # tiny buckets -> one per leaf: a genuinely multi-bucket tuned
+    # program; schedule="auto" so the PROFILE (not a forced knob) is
+    # what stages the buckets
+    wire = WireConfig(bucket_bytes=64, max_buckets=0)
+
+    opt0 = cmn.create_multi_node_optimizer(
+        optax.sgd(0.05), comm, wire=wire, profile=profile_path
+    )
+    wplan = opt0.wire_plan(params)
+    assert set(wplan.schedules) == {"hier_rs_ag"}, wplan.schedules
+    assert wplan.profile_hash == opt0.profile.profile_hash()
+
+    # exchange #1 (truncated -> lockstep retry): the agreed hash covers
+    # layout AND schedules AND the profile content hash
+    agreed = plan_agreement(comm, wplan)
+    assert agreed == wplan.plan_hash()
+    inj = fi.active()
+    assert inj is not None, "fault injector must be env-activated"
+    assert inj.log.counts.get("fault_injected", 0) >= 1, (
+        "the truncate fault must have fired before the retry succeeded"
+    )
+
+    w_true = rng.randn(8, 4).astype(np.float32)
+    x_all = rng.randn(16, 8).astype(np.float32)
+    y_all = x_all @ w_true
+
+    def loss_fn(p, b):
+        bx, by = b
+        h = jnp.tanh(bx @ p["w1"])
+        return jnp.mean(((h @ p["w2"]) @ p["w3"] - by) ** 2)
+
+    lo = pid * (16 // nproc)
+    hi = lo + 16 // nproc
+    batch = (x_all[lo:hi], y_all[lo:hi])
+
+    # the training run: opt.init's plan-agreement exchange is obj-store
+    # call #3 and absorbs the second injected truncation
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(0.05), comm, wire=wire, profile=profile_path
+    )
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    p, o = step.place(params, opt.init(params))
+    assert inj.log.counts.get("fault_injected", 0) >= 2, (
+        "both injected truncations must have fired",
+        dict(inj.log.counts),
+    )
+    losses = []
+    for _ in range(5):
+        p, o, m = step(p, o, batch)
+        losses.append(float(m["loss"]))
+    tr = step.collective_trace(p, o, batch)
+    census = tr.census()
+    n_buckets = wplan.n_buckets
+    assert n_buckets >= 3
+    assert census.get("reduce_scatter", 0) == n_buckets, census
+    assert census.get("all_gather", 0) == n_buckets, census
+    assert census.get("all_reduce", 0) == n_buckets + 1, census
+    hashes = comm.allgather_obj(tr.trace_hash())
+    assert all(h == hashes[0] for h in hashes), hashes
+    digests = comm.allgather_obj(hashlib.sha256(
+        b"".join(np.asarray(p[k]).tobytes() for k in sorted(p))
+    ).hexdigest())
+    assert all(d == digests[0] for d in digests), digests
+
+    # phase B: rank 1 tunes from a PERTURBED profile — both ranks must
+    # raise WirePlanMismatchError at init, before any collective
+    my_profile = (
+        make_profile(2e6) if pid == 1
+        else BandwidthProfile.load(profile_path)
+    )
+    opt_bad = cmn.create_multi_node_optimizer(
+        optax.sgd(0.05), comm, wire=wire, profile=my_profile
+    )
+    mismatch_raised = False
+    try:
+        opt_bad.init(params)
+    except WirePlanMismatchError:
+        mismatch_raised = True
+    assert mismatch_raised, (
+        "mismatched profiles must fail plan agreement on every rank"
+    )
+    return {
+        "faults": inj.log.counts.get("fault_injected", 0),
+        "final_loss": losses[-1],
+        "buckets": n_buckets,
+        "mesh": dict(comm.mesh.shape),
+        "profile_hash": wplan.profile_hash,
+        "plan_hash": agreed,
+        "mismatch_raised": mismatch_raised,
+    }
+
+
 def scenario_trace_divergence(pid, nproc, scratch):
     """ISSUE 5 satellite: two processes build INTENTIONALLY divergent
     train steps (the rank named by CHAINERMN_TPU_DIVERGE_RANK adds one

@@ -750,6 +750,80 @@ class TestEagerBucketedAllreduce:
 
 
 # ----------------------------------------------------------------------
+# the bench's pinned-profile resolution
+# ----------------------------------------------------------------------
+class TestPinnedProfileResolution:
+    """``_pinned_profile``: the tuned rungs' pin-vs-calibrate decision.
+    Review regression: a pinned path that stopped resolving silently
+    demoted every capture to in-process calibration — fresh hash each
+    run, every regression disclosed as RETUNED, the gate permanently
+    off — so the MISSING-file case must say so on stderr.  A
+    mesh-signature mismatch stays silent by design (one pinned file can
+    only match one rung's mesh)."""
+
+    @pytest.fixture()
+    def bench(self):
+        import os
+        import sys
+
+        repo = os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+        sys.path.insert(0, os.path.join(repo, "benchmarks"))
+        try:
+            import comm_overlap_bench as cob
+        finally:
+            sys.path.pop(0)
+        return cob
+
+    def _profile(self, mesh_axes):
+        from chainermn_tpu.comm_wire import BandwidthProfile
+
+        return BandwidthProfile(
+            mesh_axes=mesh_axes,
+            curves={("flat", "all_reduce"): ((1024, 1e9),
+                                             (1 << 22, 1e9))},
+            latency={"flat": 1e-4},
+        )
+
+    def test_unset_env_is_silent_none(self, bench, comm, monkeypatch,
+                                      capsys):
+        from chainermn_tpu.comm_wire import PROFILE_ENV
+
+        monkeypatch.delenv(PROFILE_ENV, raising=False)
+        assert bench._pinned_profile(comm.mesh) is None
+        assert capsys.readouterr().err == ""
+
+    def test_missing_pinned_path_discloses_on_stderr(self, bench, comm,
+                                                     monkeypatch,
+                                                     capsys):
+        from chainermn_tpu.comm_wire import PROFILE_ENV
+
+        monkeypatch.setenv(PROFILE_ENV, "/nonexistent/profile.json")
+        assert bench._pinned_profile(comm.mesh) is None
+        err = capsys.readouterr().err
+        assert "does not exist" in err
+        assert "retuned" in err
+
+    def test_matching_pin_loads_and_mismatch_is_silent_none(
+            self, bench, comm, monkeypatch, capsys, tmp_path):
+        from chainermn_tpu.comm_wire import PROFILE_ENV
+
+        good = self._profile((("mn", 8),))
+        path = str(tmp_path / "pin.json")
+        good.save(path)
+        monkeypatch.setenv(PROFILE_ENV, path)
+        got = bench._pinned_profile(comm.mesh)
+        assert got is not None
+        assert got.profile_hash() == good.profile_hash()
+        # a pin for some OTHER mesh: fresh-calibration fallback, silent
+        other = self._profile((("mn_inter", 2), ("mn_intra", 4)))
+        other.save(path)
+        assert bench._pinned_profile(comm.mesh) is None
+        assert capsys.readouterr().err == ""
+
+
+# ----------------------------------------------------------------------
 # wire_* bench rungs: CI smoke on the CPU mesh
 # ----------------------------------------------------------------------
 class TestWireBenchRungsCI:
@@ -768,22 +842,45 @@ class TestWireBenchRungsCI:
 
         from conftest import subprocess_env
 
+        from chainermn_tpu.comm_wire import BandwidthProfile, PROFILE_ENV
+
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        # a PINNED profile for the flat (mn, 8) mesh: the wire_tuned
+        # rung must prefer it (stable hash -> perf_history can GATE the
+        # row), while the hier rung's mesh signature mismatches and
+        # falls back to in-process calibration (fresh hash -> disclosed
+        # retune)
+        pinned = BandwidthProfile(
+            mesh_axes=(("mn", 8),),
+            curves={("flat", "all_reduce"): ((1024, 1e8), (1 << 22, 1e9)),
+                    ("flat", "reduce_scatter"): ((1024, 1e8),
+                                                 (1 << 22, 1e9)),
+                    ("flat", "all_gather"): ((1024, 1e8), (1 << 22, 1e9))},
+            latency={"flat": 1e-4}, label="ci_pinned",
+        )
+        pinned_path = str(tmp_path / "pinned_profile.json")
+        pinned.save(pinned_path)
         env = subprocess_env(8)
         env.update({"HUNT_MLP_UNITS": "32", "HUNT_MLP_BATCH": "8",
-                    "HUNT_K": "4", "HUNT_REPEATS": "2"})
-        # one subprocess covers the PR 3 wire ladder AND the ISSUE 11
+                    "HUNT_K": "4", "HUNT_REPEATS": "2",
+                    "HUNT_CAL_SIZES": "4096,65536",
+                    PROFILE_ENV: pinned_path})
+        # one subprocess covers the PR 3 wire ladder, the ISSUE 11
         # multi-hop schedule rungs (wire_flat/wire_hier/wire_hier_int8
         # run on a hierarchical mesh of 2 synthetic slices — the bench
         # sets CHAINERMN_TPU_FAKE_SLICE_SIZE itself under --cpu-mesh)
+        # AND the ISSUE 12 measured-autotune rungs (wire_tuned runs an
+        # in-process calibration sweep, sizes kept tiny via
+        # HUNT_CAL_SIZES)
         rungs = ["wire_perleaf_sync", "wire_bucketed_sync",
                  "wire_int8_sync",
-                 "wire_flat", "wire_hier", "wire_hier_int8"]
+                 "wire_flat", "wire_hier", "wire_hier_int8",
+                 "wire_tuned_base", "wire_tuned", "wire_tuned_hier"]
         proc = subprocess.run(
             [sys.executable,
              os.path.join(repo, "benchmarks", "comm_overlap_bench.py"),
              "--cpu-mesh", *rungs],
-            env=env, capture_output=True, text=True, timeout=420,
+            env=env, capture_output=True, text=True, timeout=560,
             cwd=tmp_path,
         )
         assert proc.returncode == 0, (
@@ -835,6 +932,31 @@ class TestWireBenchRungsCI:
         # same layout, different schedule => different agreed plan hash
         assert (recs["wire_flat"]["wire_plan_hash"]
                 != recs["wire_hier"]["wire_plan_hash"])
+        # ISSUE 12 rungs: the tuned legs carry full provenance — the
+        # profile content hash, the tuner's chosen knobs, and a plan
+        # hash that differs from the untuned leg's (the profile hash
+        # is folded in); the fixed-constant base leg carries none
+        assert "profile_hash" not in recs["wire_tuned_base"]
+        for name in ("wire_tuned", "wire_tuned_hier"):
+            r = recs[name]
+            assert r["profile_hash"], r
+            assert r["tuned_max_buckets"] >= 1, r
+            assert r["tuned_bucket_bytes"] >= 1, r
+            assert r["wire_schedules"], r
+            assert r["predicted_sync_ms"] > 0, r
+        assert (recs["wire_tuned"]["wire_plan_hash"]
+                != recs["wire_tuned_base"]["wire_plan_hash"])
+        assert recs["wire_tuned_hier"]["mesh_shape"] == {
+            "mn_inter": 2, "mn_intra": 4,
+        }
+        # pinned-vs-fresh provenance: the flat rung used the env
+        # profile (hash stable -> gateable), the hier rung's mesh
+        # mismatched it and calibrated fresh (hash differs -> retune
+        # disclosure path)
+        assert recs["wire_tuned"]["profile_hash"] \
+            == pinned.profile_hash()[:12]
+        assert recs["wire_tuned_hier"]["profile_hash"] \
+            != pinned.profile_hash()[:12]
 
 
 # ----------------------------------------------------------------------

@@ -302,6 +302,36 @@ class TestWireInt8:
         )
         assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
 
+    def test_tuned_wire_under_fault_and_profile_mismatch(self, tmp_path):
+        """ISSUE 12 satellite: both ranks load ONE BandwidthProfile
+        from the shared scratch and tune through it — truncate faults
+        on the plan-agreement exchanges are retried in lockstep and the
+        agreed WirePlan hash (which now folds in the profile content
+        hash) matches across ranks, with the profile-staged rs→ar→ag
+        triple in the trace; then a deliberately perturbed profile on
+        rank 1 makes a fresh optimizer's init raise
+        WirePlanMismatchError on BOTH ranks before any collective (all
+        asserted inside the scenario)."""
+        import json as _json
+
+        faults = _json.dumps([
+            {"site": "obj_store.exchange", "kind": "truncate",
+             "at": [1, 3], "truncate_to": 4},
+        ])
+        res = run_world(
+            "tuned_wire_fault", n_procs=2, local_devices=2,
+            tmpdir=tmp_path, timeout=420,
+            extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        payloads = _assert_ok(res, "tuned_wire_fault")
+        assert all(p["faults"] >= 2 for p in payloads)
+        assert all(p["buckets"] >= 3 for p in payloads)
+        assert all(p["mismatch_raised"] for p in payloads)
+        # one profile, one plan: every rank agreed on both hashes
+        assert payloads[0]["profile_hash"] == payloads[1]["profile_hash"]
+        assert payloads[0]["plan_hash"] == payloads[1]["plan_hash"]
+        assert payloads[0]["final_loss"] == payloads[1]["final_loss"]
+
 
 class TestTelemetry:
     def test_straggler_flagged_and_timeline_exported_both_ranks(

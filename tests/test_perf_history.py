@@ -348,6 +348,79 @@ class TestDiffRules:
 
 
 # ----------------------------------------------------------------------
+# profile provenance: annotate vs gate (ISSUE 12 satellite)
+# ----------------------------------------------------------------------
+class TestProfileProvenance:
+    """A tuned row's regression gates when its profile hash is
+    UNCHANGED (that is drift) and is annotated-but-not-gated when the
+    hash moved (a retune is a disclosed config change)."""
+
+    @staticmethod
+    def _rung(value, profile_hash=None):
+        row = {"variant": "wire_tuned", "step_time_ms": value,
+               "n_measurements": 2, "spread_max_over_min": 1.1}
+        if profile_hash is not None:
+            row["profile_hash"] = profile_hash
+        return row
+
+    def test_same_profile_regression_gates(self, tmp_path):
+        old = _capture(tmp_path, "a.json", [self._rung(10.0, "aaaa")])
+        new = _capture(tmp_path, "b.json", [self._rung(20.0, "aaaa")])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        assert len(regs) == 1 and not regs[0].disclosed
+        assert main([old, new]) == 1
+
+    def test_retuned_regression_annotated_not_gated(self, tmp_path,
+                                                    capsys):
+        old = _capture(tmp_path, "a.json", [self._rung(10.0, "aaaa")])
+        new = _capture(tmp_path, "b.json", [self._rung(20.0, "bbbb")])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        # still COMPARED — the delta is reported, just not gated
+        assert len(regs) == 1 and regs[0].disclosed
+        assert main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "RETUNED" in out
+        assert "RETUNE NOTE" in out
+        assert "REGRESSION" not in out
+
+    def test_profile_appearing_counts_as_retune(self, tmp_path):
+        """fixed-constant -> tuned (or back) is a config change too:
+        the profile hash present on only one side discloses it."""
+        old = _capture(tmp_path, "a.json", [self._rung(10.0)])
+        new = _capture(tmp_path, "b.json", [self._rung(20.0, "bbbb")])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        assert len(regs) == 1 and regs[0].disclosed
+        assert main([old, new]) == 0
+
+    def test_retune_note_emitted_without_regression(self, tmp_path,
+                                                    capsys):
+        """Every retuned shared row is listed even when nothing
+        regressed — a capture diff always shows what was re-tuned."""
+        old = _capture(tmp_path, "a.json", [self._rung(10.0, "aaaa")])
+        new = _capture(tmp_path, "b.json", [self._rung(10.1, "bbbb")])
+        assert main([old, new]) == 0
+        out = capsys.readouterr().out
+        assert "RETUNE NOTE wire_tuned: profile aaaa -> bbbb" in out
+
+    def test_unrelated_rows_unaffected_by_retune(self, tmp_path):
+        """A retune on one row never launders a regression on another
+        (profile provenance is per-row, not per-capture)."""
+        old = _capture(tmp_path, "a.json", [
+            self._rung(10.0, "aaaa"),
+            {"metric": "step_time_ms", "value": 100.0},
+        ])
+        new = _capture(tmp_path, "b.json", [
+            self._rung(10.0, "bbbb"),
+            {"metric": "step_time_ms", "value": 200.0},
+        ])
+        regs = diff_rows(load_rows(old), load_rows(new))
+        assert [r.metric for r in regs if not r.disclosed] == [
+            "step_time_ms"
+        ]
+        assert main([old, new]) == 1
+
+
+# ----------------------------------------------------------------------
 # MetricsReport phase-summary rows (ISSUE 10 satellite)
 # ----------------------------------------------------------------------
 class TestPhaseSummaryRows:

@@ -1,0 +1,194 @@
+#!/usr/bin/env python
+"""Serving-tier decode throughput: tokens/sec/chip, batch 1 vs saturated.
+
+Two rungs over the continuous-batching engine (ISSUE 13):
+
+  decode_bs1        capacity 1, one request — the latency-bound floor
+                    (every decoded token pays the full step dispatch +
+                    the TP collectives; "Understanding and Improving
+                    Communication Performance in Multi-node LLM
+                    Inference" (PAPERS.md): decode is collective-
+                    latency-bound, so this rung moves with launch
+                    latency, not bandwidth).
+  decode_saturated  capacity C, 2C queued requests — continuous
+                    batching keeps every slot busy; throughput per chip
+                    is the capacity-bound ceiling the batcher exists
+                    to reach.
+
+Protocol: the serving loop is HOST-driven (admission, argmax, page
+bookkeeping between compiled steps), so each rung times paired
+k / 2k-token serves and reports the min positive paired difference —
+prefill and compile cost cancel in the difference exactly like the
+k-loop harness's paired dispatches.  Every row carries the min-of-N
+disclosure plus the serving fingerprints: the decode program's
+authored collective census and trace hash (what the ``decode_step``
+budget pin enforces), capacity/page geometry, and the batcher's
+p50/p99 token latency.
+
+``tokens_per_sec_per_chip`` is HIGHER-better: ``perf_history`` keys on
+the ``_per_sec``/``per_chip`` spellings (the ``sec_per`` substring
+trap is pinned by tests/test_perf_history.py for this exact unit).
+
+Usage:
+    python benchmarks/decode_bench.py                  # real chip
+    python benchmarks/decode_bench.py --cpu-mesh       # 8 virt devices
+    python benchmarks/decode_bench.py decode_bs1
+Env: HUNT_DECODE_TOKENS (k, default 32), HUNT_DECODE_CAPACITY (8),
+HUNT_SERVE_DMODEL/LAYERS/HEADS/VOCAB/PROMPT for the model fixture.
+"""
+
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+if "--cpu-mesh" in sys.argv:
+    sys.argv.remove("--cpu-mesh")
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    os.environ["XLA_FLAGS"] = (
+        os.environ.get("XLA_FLAGS", "")
+        + " --xla_force_host_platform_device_count=8"
+    )
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from chainermn_tpu.utils.benchmarking import min_positive, protocol_fields
+
+K = int(os.environ.get("HUNT_DECODE_TOKENS", "32"))
+REPEATS = int(os.environ.get("HUNT_REPEATS", "2"))
+CAPACITY = int(os.environ.get("HUNT_DECODE_CAPACITY", "8"))
+D_MODEL = int(os.environ.get("HUNT_SERVE_DMODEL", "256"))
+LAYERS = int(os.environ.get("HUNT_SERVE_LAYERS", "4"))
+HEADS = int(os.environ.get("HUNT_SERVE_HEADS", "8"))
+VOCAB = int(os.environ.get("HUNT_SERVE_VOCAB", "512"))
+PROMPT = int(os.environ.get("HUNT_SERVE_PROMPT", "16"))
+PAGE = int(os.environ.get("HUNT_SERVE_PAGE", "16"))
+
+
+def _fixture():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    max_len = PROMPT + 2 * K + PAGE
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D_MODEL, n_heads=HEADS,
+        n_layers=LAYERS, max_len=max_len,
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    return model, params
+
+
+def _engine(model, params, capacity):
+    from chainermn_tpu.serving.decode import DecodeEngine
+
+    return DecodeEngine(model, params, capacity=capacity,
+                        page_size=PAGE)
+
+
+def _serve_tokens(model, params, capacity, n_requests, max_new):
+    """One timed leg: a fresh engine+batcher serves ``n_requests`` of
+    ``max_new`` tokens each; returns (wall_seconds, tokens, report)."""
+    from chainermn_tpu.serving.batcher import ContinuousBatcher, Request
+
+    eng = _engine(model, params, capacity)
+    b = ContinuousBatcher(eng)
+    rng = np.random.RandomState(0)
+    reqs = [
+        Request(rng.randint(0, VOCAB, PROMPT).tolist(), max_new)
+        for _ in range(n_requests)
+    ]
+    t0 = time.monotonic()
+    b.serve(reqs)
+    dt = time.monotonic() - t0
+    assert b.latency_report()["failed"] == 0
+    return dt, b.tokens_generated, b.latency_report()
+
+
+def _fingerprints(model, params, capacity):
+    """The plan/budget fingerprint fields every decode row carries: the
+    authored collective census + trace hash of the decode program (the
+    ``decode_step`` pin's subject) — a capture where the program grew a
+    collective reads as a config change, not noise."""
+    from chainermn_tpu.analysis import budget_for
+
+    eng = _engine(model, params, capacity)
+    tr = eng.collective_trace("decode")
+    census = tr.census()
+    ceiling = budget_for("decode_step")
+    within = all(census.get(c, 0) <= n for c, n in ceiling.items())
+    return {
+        "decode_census": census,
+        "decode_trace_hash": tr.trace_hash()[:12],
+        "budget": "decode_step",
+        "budget_within": bool(within),
+        "capacity": capacity,
+        "page_size": PAGE,
+        "prompt_len": PROMPT,
+        "model": f"lm{LAYERS}x{D_MODEL}",
+    }
+
+
+def _run_rung(name, capacity, n_requests):
+    model, params = _fixture()
+    samples, reports = [], []
+    for _ in range(max(REPEATS, 1)):
+        t1, n1, _ = _serve_tokens(model, params, capacity, n_requests, K)
+        t2, n2, rep2 = _serve_tokens(
+            model, params, capacity, n_requests, 2 * K
+        )
+        samples.append(t2 - t1)           # seconds for n2 - n1 tokens
+        reports.append((n2 - n1, rep2))
+    dt = min_positive(samples)
+    tokens = reports[0][0]
+    n_chips = len(jax.devices())
+    rep = reports[-1][1]
+    # every paired difference non-positive = the serve wall is inside
+    # host jitter (noise floor).  A negative tokens/sec is nonsense
+    # and a committed one would gate forever: report a DISCLOSED null
+    # (perf_history skips null rows by design) instead.
+    value = round(tokens / dt / n_chips, 3) if dt > 0 else None
+    row = {
+        "metric": f"{name}_tokens_per_sec_per_chip",
+        "value": value,
+        "noise_floor": dt <= 0,
+        "unit": "tokens_per_sec_per_chip",
+        "tokens_per_leg": tokens,
+        "n_chips": n_chips,
+        "samples_s": [round(s, 4) for s in samples],
+        **protocol_fields(samples),
+        **_fingerprints(model, params, capacity),
+    }
+    lat = rep.get("serving.token_latency")
+    if lat:
+        row["token_latency_p50_ms"] = lat["p50_ms"]
+        row["token_latency_p99_ms"] = lat["p99_ms"]
+    print(json.dumps(row), flush=True)
+
+
+def main():
+    rungs = {
+        "decode_bs1": lambda: _run_rung("decode_bs1", 1, 1),
+        "decode_saturated": lambda: _run_rung(
+            "decode_saturated", CAPACITY, 2 * CAPACITY
+        ),
+    }
+    for name in (sys.argv[1:] or list(rungs)):
+        try:
+            rungs[name]()
+        except Exception as e:
+            print(json.dumps({"metric": name,
+                              "error": f"{type(e).__name__}: {e}"}),
+                  flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -94,6 +94,19 @@ BUDGETS = {
     # root->leaders, intra leaders->slices) — vs 1 for the flat
     # spelling; a regression to per-stage-per-rank storms trips this.
     "bcast_tree": {"all_reduce": 2},
+    # ISSUE 13: the serving tier's tensor-parallel single-token decode
+    # step (serving.decode, 2-layer pinned fixture).  Decode is
+    # collective-LATENCY-bound ("Understanding and Improving
+    # Communication Performance in Multi-node LLM Inference",
+    # PAPERS.md), so the count per token IS the latency floor: exactly
+    # 2 row-parallel psums per layer (attention out-proj + MLP
+    # down-proj) and nothing else — the replicated embedding, paged
+    # cache write, and tied head cost zero collectives.  The ceiling
+    # is EXACT (no slack notch): any extra collective per token is a
+    # regression the latency budget cannot absorb.  The prefill
+    # program has the identical census (the pin is enforced on both
+    # traces in tests/test_serving.py).
+    "decode_step": {"all_reduce": 4},
 }
 
 # ----------------------------------------------------------------------

@@ -8,14 +8,16 @@ from .chunked_ce import (  # noqa: F401
 __all__ = ["softmax_cross_entropy", "accuracy", "multi_head_attention",
            "chunked_softmax_cross_entropy", "chunked_lm_loss",
            "flash_attention", "flash_attention_with_lse",
-           "flash_attention_fn", "fused_cast_scale", "block_census"]
+           "flash_attention_fn", "fused_cast_scale", "block_census",
+           "flash_decode", "paged_decode_reference"]
 
 
 def __getattr__(name):
     # Pallas kernels load lazily (experimental namespace).
     if name in ("flash_attention", "flash_attention_with_lse",
                 "flash_attention_fn", "fused_cast_scale",
-                "block_census"):
+                "block_census", "flash_decode",
+                "paged_decode_reference"):
         from . import pallas_attention
 
         return getattr(pallas_attention, name)

@@ -1266,6 +1266,198 @@ def flash_attention_fn(block_q: Optional[int] = None,
 
 
 # ----------------------------------------------------------------------
+# Flash decode — q_len=1 against a PAGED KV cache (serving tier)
+# ----------------------------------------------------------------------
+def _flash_decode_kernel(bt_ref, len_ref, q_ref, k_ref, v_ref, o_ref,
+                         acc_ref, m_ref, l_ref, *, n_heads: int,
+                         page_size: int, scale: float):
+    """Decode-geometry flash kernel: ONE query row per (batch, head)
+    program against that request's pages, walked page-by-page through
+    the block table (scalar-prefetched — the index map reads it, so
+    only the request's OWN pages are ever fetched into VMEM).
+
+    Grid (batch*heads, pages_per_slot); the page dimension is innermost
+    and sequential, so the online-softmax running state lives in VMEM
+    scratch exactly like the training kernel's k loop.  The query is
+    pre-broadcast to 8 sublanes (TPU tile floor — same trick as the
+    forward kernel's lse layout); row 0 of the output block is the
+    answer.  Pages past the request's length are dead (skipped
+    entirely); the partial tail page masks by position.  A length-0
+    slot (padded batch slot) has no live pages — finalize writes zeros.
+    """
+    i = pl.program_id(0)
+    j = pl.program_id(1)
+    n_j = pl.num_programs(1)
+    b = i // n_heads
+
+    @pl.when(j == 0)
+    def _init():
+        acc_ref[:] = jnp.zeros_like(acc_ref)
+        m_ref[:] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[:] = jnp.zeros_like(l_ref)
+
+    length = len_ref[b]
+    live = j * page_size < length
+
+    @pl.when(live)
+    def _attend():
+        q = q_ref[0].astype(jnp.float32) * scale      # (8, d)
+        k_blk = k_ref[0, 0].astype(jnp.float32)        # (bs, d)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        s = lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # (8, bs)
+        pos = j * page_size + lax.broadcasted_iota(
+            jnp.int32, s.shape, 1
+        )
+        s = jnp.where(pos < length, s, _NEG_INF)
+        m_old = m_ref[:, 0:1]
+        m_new = jnp.maximum(m_old, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m_old - m_new)
+        l_new = alpha * l_ref[:, 0:1] + jnp.sum(p, axis=-1, keepdims=True)
+        l_ref[:] = jnp.broadcast_to(l_new, l_ref.shape)
+        m_ref[:] = jnp.broadcast_to(m_new, m_ref.shape)
+        acc_ref[:] = alpha * acc_ref[:] + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    @pl.when(j == n_j - 1)
+    def _finalize():
+        o_ref[0] = (
+            acc_ref[:] / jnp.maximum(l_ref[:, 0:1], 1e-30)
+        ).astype(o_ref.dtype)
+
+
+@functools.partial(jax.jit, static_argnames=("scale", "interpret"))
+def _flash_decode(q, k_pages, v_pages, block_tables, lengths, scale,
+                  interpret):
+    b, h, d = q.shape
+    n_pages, page_size = k_pages.shape[0], k_pages.shape[1]
+    pages_per_slot = block_tables.shape[1]
+    # head-major page layout so the kernel block's trailing dims are
+    # (page_size, d) — the sublane/lane tile the hardware wants
+    kh = jnp.moveaxis(k_pages, 2, 0)  # (h, n_pages, bs, d)
+    vh = jnp.moveaxis(v_pages, 2, 0)
+    # 8-sublane broadcast of the single query row (TPU tile floor)
+    q8 = jnp.broadcast_to(
+        q.reshape(b * h, 1, d), (b * h, 8, d)
+    )
+    grid = (b * h, pages_per_slot)
+    kernel = functools.partial(
+        _flash_decode_kernel, n_heads=h, page_size=page_size,
+        scale=scale,
+    )
+    out = pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=2,
+            grid=grid,
+            in_specs=[
+                pl.BlockSpec((1, 8, d), lambda i, j, bt, ln: (i, 0, 0)),
+                pl.BlockSpec(
+                    (1, 1, page_size, d),
+                    lambda i, j, bt, ln, h=h: (i % h, bt[i // h, j], 0, 0),
+                ),
+                pl.BlockSpec(
+                    (1, 1, page_size, d),
+                    lambda i, j, bt, ln, h=h: (i % h, bt[i // h, j], 0, 0),
+                ),
+            ],
+            out_specs=pl.BlockSpec(
+                (1, 8, d), lambda i, j, bt, ln: (i, 0, 0)
+            ),
+            scratch_shapes=[
+                pltpu.VMEM((8, d), jnp.float32),    # acc
+                pltpu.VMEM((8, 128), jnp.float32),  # running max (col 0)
+                pltpu.VMEM((8, 128), jnp.float32),  # running denom
+            ],
+        ),
+        out_shape=jax.ShapeDtypeStruct((b * h, 8, d), q.dtype),
+        interpret=interpret,
+    )(block_tables.astype(jnp.int32), lengths.astype(jnp.int32),
+      q8, kh, vh)
+    return out[:, 0].reshape(b, h, d)
+
+
+def flash_decode(q, k_pages, v_pages, block_tables, lengths,
+                 scale: Optional[float] = None,
+                 interpret: Optional[bool] = None) -> jnp.ndarray:
+    """Single-token paged-cache attention (the serving tier's decode
+    geometry): each batch slot's one query attends against the pages
+    its block table names.
+
+    Args:
+      q: (batch, heads, d) — one query per decode slot.
+      k_pages / v_pages: (num_pages, page_size, heads, d) — the shared
+        page pool (``serving.kv_cache.PagedKVCache`` layout for one
+        layer).
+      block_tables: (batch, pages_per_slot) int32 page ids per slot.
+      lengths: (batch,) int32 — live cache positions per slot (the new
+        token's k/v already written, so a decoding slot passes
+        ``cached + 1``).  Length-0 slots (padding) return zeros.
+    Returns:
+      (batch, heads, d) in ``q.dtype``.
+
+    Numerics: fp32 online softmax over pages, like the training
+    kernel — agrees with :func:`paged_decode_reference` (one exact fp32
+    softmax over the gathered cache) to float roundoff, and exactly
+    when a request fits one page (single-block online softmax is the
+    dense computation).  The serving decode *step* uses the dense
+    paged attend for its bit-exactness contract; this kernel is the
+    TPU fast path (``DecodeEngine(attention_impl="flash")``).
+    """
+    if not PALLAS_AVAILABLE:
+        return paged_decode_reference(
+            q, k_pages, v_pages, block_tables, lengths, scale
+        )
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    return _flash_decode(q, k_pages, v_pages, block_tables, lengths,
+                         float(scale), _should_interpret(interpret))
+
+
+def paged_decode_reference(q, k_pages, v_pages, block_tables, lengths,
+                           scale: Optional[float] = None) -> jnp.ndarray:
+    """Dense oracle for :func:`flash_decode`: gather every slot's pages
+    into a contiguous buffer and run one exact fp32 softmax.  Same
+    masking contract (positions >= length dead; length 0 -> zeros)."""
+    if scale is None:
+        scale = q.shape[-1] ** -0.5
+    b = q.shape[0]
+    page_size = k_pages.shape[1]
+    kg = k_pages[block_tables]  # (b, n, bs, h, d)
+    vg = v_pages[block_tables]
+    n_tot = kg.shape[1] * page_size
+    kg = kg.reshape(b, n_tot, *kg.shape[3:])
+    vg = vg.reshape(b, n_tot, *vg.shape[3:])
+    s = jnp.einsum(
+        "bhd,bkhd->bhk", q.astype(jnp.float32) * scale,
+        kg.astype(jnp.float32),
+    )
+    pos = jnp.arange(n_tot)[None, None, :]
+    mask = pos < lengths[:, None, None]
+    s = jnp.where(mask, s, _NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    den = jnp.maximum(jnp.sum(p, axis=-1, keepdims=True), 1e-30)
+    # divide AFTER the PV product — the kernel's finalize order, so a
+    # single-page request (where online softmax IS the dense softmax)
+    # matches bit for bit (pinned by test)
+    out = jnp.einsum(
+        "bhk,bkhd->bhd", p, vg.astype(jnp.float32)
+    ) / den
+    # length-0 (padded) slots: with EVERY position masked the max IS
+    # the mask value, so exp(s - m) == 1 everywhere and the softmax
+    # degenerates to a mean of garbage — zero them explicitly, matching
+    # the kernel (whose pages are all dead there, acc == 0)
+    out = jnp.where(lengths[:, None, None] > 0, out, 0.0)
+    return out.astype(q.dtype)
+
+
+# ----------------------------------------------------------------------
 # Fused cast + scale (the reference's PureNccl fp16 kernels, #11)
 # ----------------------------------------------------------------------
 def _cast_scale_kernel(x_ref, o_ref, *, scale: float):

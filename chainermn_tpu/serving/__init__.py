@@ -1,0 +1,50 @@
+"""Serving tier: continuous-batching LLM decode over a paged KV cache.
+
+The first subsystem built on all four prior tentpoles at once: the
+decode step's collective count is pinned by the static analyzer
+(``decode_step`` in ``analysis.budgets``) and attributed by shardlint;
+its latency is measured by the ``observability`` span timeline and
+priced per collective via ``attribute()``; request-level failures ride
+the ``resilience`` taxonomy (retry/timeout/preemption); and replica
+worlds re-form through ``resilience.elastic``.
+
+* :mod:`.kv_cache` — the paged KV cache: fixed-size pages from one
+  pool, per-slot block tables, a deterministic reserve-at-admit
+  allocator, checkpoint round-trip, TP heads resharding.
+* :mod:`.decode` — :class:`DecodeEngine`: the single-token decode /
+  prompt-prefill programs over the paged cache, consuming a trained
+  ``TransformerLM``'s parameters verbatim; a dense contiguous-cache
+  oracle layout the paged step is bit-identical to; a decode-geometry
+  Pallas fast path (``ops.flash_decode``).
+* :mod:`.batcher` — :class:`ContinuousBatcher`: the request queue and
+  the padded-slot iteration loop (join/leave between decode steps,
+  request retry/timeout, per-token latency histograms).
+* :mod:`.replica` — elastic decode replicas over a shared-FS request
+  journal: deterministic request claiming, drain on preemption,
+  ``serve_elastic`` world re-formation, KV-page warm start.
+
+See docs/serving.md for the architecture and the latency-attribution
+recipe.
+"""
+
+from .kv_cache import (  # noqa: F401
+    CacheAdmissionError,
+    NULL_PAGE,
+    PagedKVCache,
+    pages_needed,
+    reshard_kv_state,
+)
+from .decode import (  # noqa: F401
+    DecodeEngine,
+    PagedLM,
+    engine_from_trained,
+)
+from .batcher import (  # noqa: F401
+    ContinuousBatcher,
+    Request,
+)
+from .replica import (  # noqa: F401
+    DecodeReplica,
+    RequestJournal,
+    serve_elastic,
+)

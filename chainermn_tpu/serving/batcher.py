@@ -1,0 +1,340 @@
+"""Continuous batching over the paged decode engine.
+
+The serving loop: a request queue feeding a fixed set of decode slots
+(the **padded slot model** — the compiled decode program always runs
+the full capacity; empty slots decode the null page and their logits
+are ignored), with requests joining and leaving **between** decode
+iterations.  One compiled program per (capacity, prompt bucket) —
+membership churn never retraces.
+
+Resilience semantics (the request-level slice of the taxonomy):
+
+* A *recoverable* :class:`~chainermn_tpu.resilience.errors.
+  ResilienceError` escaping a prefill/decode step (injected transient,
+  exhausted obj-store retries under a TP world, a preemption notice)
+  evicts the in-flight slots and **re-queues** their requests — greedy
+  decode replays bit-identically from the prompt, so a retried request
+  returns the same tokens it would have (pinned by test).  Per-request
+  ``retries`` are bounded by ``max_retries``; exhaustion fails the
+  request (recorded, never raised) while the batch keeps serving.
+* A per-request ``timeout_s`` deadline (monotonic clock) fails
+  overdue requests between iterations, recorded as a
+  ``request_timeout`` resilience event.  Replica-local only: a
+  multi-process TP world rejects ``timeout_s`` at construction (the
+  clock is rank-local — ranks straddling the deadline would diverge
+  their admission schedules and deadlock the decode psums).
+* Non-recoverable errors propagate — they are program bugs, not load.
+
+Instrumentation: ``serving.step`` / ``serving.prefill`` /
+``serving.decode`` spans land in the active telemetry timeline (the
+engine emits the inner two), and the batcher always keeps its own
+:class:`~chainermn_tpu.observability.metrics.MetricsRegistry` —
+``serving.token_latency`` (one sample per decode iteration: every
+active request got one token), ``serving.ttft`` (submit -> first
+token), ``serving.prefill_latency`` — so p50/p99 exist even with
+telemetry off.  ``latency_report()`` summarizes;
+``DecodeEngine.attribution(timeline)`` joins a telemetry export to the
+decode trace per collective (docs/serving.md has the recipe).
+"""
+
+from __future__ import annotations
+
+import itertools
+import time
+from collections import deque
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+
+from ..observability import timeline as _obs
+from ..observability.metrics import MetricsRegistry
+from ..resilience.errors import PreemptionError, ResilienceError
+from ..resilience.log import emit
+
+_ids = itertools.count()
+
+QUEUED, RUNNING, DONE, FAILED = "queued", "running", "done", "failed"
+
+
+class Request:
+    """One generation request and its runtime state."""
+
+    def __init__(self, prompt: Sequence[int], max_new_tokens: int, *,
+                 id: Optional[str] = None, eos_id: Optional[int] = None):
+        if max_new_tokens < 1:
+            raise ValueError("max_new_tokens must be >= 1")
+        self.prompt = [int(t) for t in prompt]
+        if not self.prompt:
+            raise ValueError("empty prompt")
+        self.max_new_tokens = int(max_new_tokens)
+        self.eos_id = eos_id
+        self.id = id if id is not None else f"req{next(_ids)}"
+        self.state = QUEUED
+        self.tokens: List[int] = []
+        self.slot: Optional[int] = None
+        self.retries = 0
+        self.error: Optional[str] = None
+        self.submitted_at: Optional[float] = None
+        self.first_token_at: Optional[float] = None
+        self.done_at: Optional[float] = None
+
+    @property
+    def total_tokens(self) -> int:
+        return len(self.prompt) + self.max_new_tokens
+
+    @property
+    def output(self) -> List[int]:
+        return self.prompt + self.tokens
+
+    def _finished(self) -> bool:
+        if len(self.tokens) >= self.max_new_tokens:
+            return True
+        return (self.eos_id is not None and self.tokens
+                and self.tokens[-1] == self.eos_id)
+
+    def __repr__(self):
+        return (f"<Request {self.id} {self.state} prompt={len(self.prompt)}"
+                f" generated={len(self.tokens)}/{self.max_new_tokens}>")
+
+
+class ContinuousBatcher:
+    """The iteration loop: admit joins, one decode step for the whole
+    slot set, retire leaves — repeat."""
+
+    def __init__(self, engine, *, max_retries: int = 1,
+                 timeout_s: Optional[float] = None):
+        comm = getattr(engine, "comm", None)
+        if (timeout_s is not None and comm is not None
+                and getattr(comm, "process_count", 1) > 1):
+            # the deadline reads each process's LOCAL monotonic clock:
+            # two ranks straddling it would time out a request
+            # differently, diverge their admission schedules, and
+            # deadlock the decode step's psums.  Every admission
+            # decision must stay a deterministic function of shared
+            # state — enforce deadlines at the journal/client layer
+            # instead.
+            raise ValueError(
+                "timeout_s is wall-clock-local and cannot be used in a "
+                "multi-process TP world (ranks could time out a "
+                "request differently and desynchronize the admission "
+                "schedule); enforce request deadlines outside the "
+                "batcher"
+            )
+        self.engine = engine
+        self.max_retries = int(max_retries)
+        self.timeout_s = timeout_s
+        self.queue: deque = deque()
+        self.active: Dict[int, Request] = {}
+        self.finished: Dict[str, Request] = {}
+        self.registry = MetricsRegistry()
+        self.steps = 0
+        self.tokens_generated = 0
+
+    # -- submission -----------------------------------------------------
+    def submit(self, request: Request) -> Request:
+        if request.total_tokens > self.engine.max_total:
+            raise ValueError(
+                f"{request.id}: needs {request.total_tokens} cache "
+                f"positions > engine max_total={self.engine.max_total}"
+            )
+        request.state = QUEUED
+        request.submitted_at = time.monotonic()
+        self.queue.append(request)
+        return request
+
+    def _sync_submissions(self) -> None:
+        """Multi-process TP world: every rank must run the same
+        admission schedule.  The chief's queue is broadcast once (the
+        per-request state rides the obj store); after that every
+        decision is a deterministic function of shared state."""
+        comm = getattr(self.engine, "comm", None)
+        if comm is None or comm.process_count <= 1:
+            return
+        payload = [
+            (r.id, r.prompt, r.max_new_tokens, r.eos_id)
+            for r in self.queue
+        ]
+        payload = comm.bcast_obj(payload)
+        if comm.process_index != 0:
+            self.queue = deque(
+                Request(p, m, id=i, eos_id=e) for i, p, m, e in payload
+            )
+            now = time.monotonic()
+            for r in self.queue:
+                r.submitted_at = now
+
+    # -- one iteration --------------------------------------------------
+    def _admit_joins(self) -> List[Request]:
+        joins = []
+        while self.queue:
+            r = self.queue[0]
+            if not self.engine.cache.can_admit(r.total_tokens):
+                break
+            self.queue.popleft()
+            r.slot = self.engine.admit(r.total_tokens)
+            r.state = RUNNING
+            self.active[r.slot] = r
+            joins.append(r)
+        return joins
+
+    def _retire(self, r: Request) -> None:
+        self.engine.release(r.slot)
+        del self.active[r.slot]
+        r.slot = None
+        r.state = DONE
+        r.done_at = time.monotonic()
+        self.finished[r.id] = r
+
+    def _fail(self, r: Request, why: str) -> None:
+        if r.slot is not None and r.slot in self.active:
+            self.engine.cache.evict(r.slot)
+            del self.active[r.slot]
+            r.slot = None
+        r.state = FAILED
+        r.error = why
+        r.done_at = time.monotonic()
+        self.finished[r.id] = r
+        emit("request_failed", "serving.batcher", request=r.id, why=why)
+
+    def _requeue(self, r: Request, why: str) -> None:
+        """Retry path: evict, reset generated tokens (greedy decode
+        replays bit-identically from the prompt) and re-queue at the
+        front — bounded by ``max_retries``."""
+        if r.slot is not None and r.slot in self.active:
+            self.engine.cache.evict(r.slot)
+            del self.active[r.slot]
+            r.slot = None
+        r.retries += 1
+        if r.retries > self.max_retries:
+            self._fail(r, f"retries exhausted after: {why}")
+            return
+        r.tokens = []
+        r.state = QUEUED
+        self.queue.appendleft(r)
+        emit("request_retry", "serving.batcher", request=r.id,
+             attempt=r.retries, why=why)
+
+    def _check_timeouts(self) -> None:
+        if self.timeout_s is None:
+            return
+        now = time.monotonic()
+        overdue = [
+            r for r in list(self.active.values()) + list(self.queue)
+            if r.submitted_at is not None
+            and now - r.submitted_at > self.timeout_s
+        ]
+        for r in overdue:
+            if r in self.queue:
+                self.queue.remove(r)
+            emit("request_timeout", "serving.batcher", request=r.id,
+                 waited=round(now - r.submitted_at, 3))
+            self._fail(r, f"timeout after {self.timeout_s}s")
+
+    def _append_token(self, r: Request, tok: int, t_now: float) -> None:
+        r.tokens.append(int(tok))
+        self.tokens_generated += 1
+        if r.first_token_at is None:
+            r.first_token_at = t_now
+            if r.submitted_at is not None:
+                self.registry.histogram("serving.ttft").observe(
+                    t_now - r.submitted_at
+                )
+
+    def step(self) -> bool:
+        """One serving iteration; returns True while work remains."""
+        if not self.queue and not self.active:
+            return False
+        with _obs.span("serving.step", queued=len(self.queue),
+                       active=len(self.active)):
+            self._check_timeouts()
+            joins = self._admit_joins()
+            try:
+                for r in joins:
+                    t0 = time.monotonic()
+                    logits = self.engine.prefill(r.slot, r.prompt)
+                    t1 = time.monotonic()
+                    self.registry.histogram(
+                        "serving.prefill_latency").observe(t1 - t0)
+                    self._append_token(r, int(np.argmax(logits)), t1)
+                for r in [r for r in joins if r._finished()]:
+                    self._retire(r)
+                if self.active:
+                    toks = np.zeros((self.engine.capacity,), np.int32)
+                    for slot, r in self.active.items():
+                        toks[slot] = r.tokens[-1] if r.tokens else 0
+                    t0 = time.monotonic()
+                    logits = self.engine.decode_step(toks)
+                    t1 = time.monotonic()
+                    # every active request received one token this
+                    # iteration: the iteration wall IS the per-token
+                    # latency sample
+                    for slot, r in list(self.active.items()):
+                        self.registry.histogram(
+                            "serving.token_latency").observe(t1 - t0)
+                        self._append_token(
+                            r, int(np.argmax(logits[slot])), t1
+                        )
+                        if r._finished():
+                            self._retire(r)
+                    self.steps += 1
+            except PreemptionError:
+                # a preemption NOTICE is not a retryable fault — it is
+                # the replica's drain signal.  In-flight slots stay
+                # allocated (the drain snapshot wants the warm pages);
+                # their requests stay unserved in the journal, so the
+                # surviving world's next claim covers them.
+                raise
+            except ResilienceError as err:
+                if not err.recoverable:
+                    raise
+                for r in list(self.active.values()):
+                    self._requeue(r, f"{type(err).__name__}: {err}")
+        return bool(self.queue or self.active)
+
+    # -- driving --------------------------------------------------------
+    def run(self, max_steps: Optional[int] = None) -> Dict[str, Request]:
+        """Drive :meth:`step` until the queue drains (or ``max_steps``
+        iterations); returns finished requests by id."""
+        n = 0
+        self._sync_submissions()
+        while self.step():
+            n += 1
+            if max_steps is not None and n >= max_steps:
+                break
+        return dict(self.finished)
+
+    def serve(self, requests: Sequence[Request]) -> List[Request]:
+        """Submit-and-run convenience; results in submission order."""
+        reqs = list(requests)
+        for r in reqs:
+            self.submit(r)
+        self.run()
+        return [self.finished.get(r.id, r) for r in reqs]
+
+    # -- reporting ------------------------------------------------------
+    def latency_report(self) -> dict:
+        """p50/p99 per serving phase from the batcher's own registry
+        (present regardless of telemetry), plus the token/request
+        counters — the fields decode_bench's rows and docs/serving.md's
+        recipe read."""
+        out = {
+            "steps": self.steps,
+            "tokens_generated": self.tokens_generated,
+            "done": sum(1 for r in self.finished.values()
+                        if r.state == DONE),
+            "failed": sum(1 for r in self.finished.values()
+                          if r.state == FAILED),
+        }
+        for name in ("serving.token_latency", "serving.ttft",
+                     "serving.prefill_latency"):
+            if not self.registry.has_histogram(name):
+                continue
+            h = self.registry.histogram(name)
+            if len(h) == 0:
+                continue
+            out[name] = {
+                "p50_ms": round(h.percentile(50) * 1e3, 4),
+                "p99_ms": round(h.percentile(99) * 1e3, 4),
+                "n": len(h),
+            }
+        return out

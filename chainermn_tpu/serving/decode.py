@@ -1,0 +1,561 @@
+"""Single-token decode step over the paged KV cache.
+
+The serving twin of ``models.transformer``'s KV-cache decode mode: the
+same TransformerLM architecture, the same parameter tree (module names
+match, so a trained checkpoint loads verbatim), but the cache is the
+shared page pool of :mod:`serving.kv_cache` instead of a per-call flax
+variable — which is what lets continuous batching share one compiled
+program across requests of different lengths.
+
+Two cache layouts, one math:
+
+* ``layout="paged"`` — production: pages gathered through the block
+  table.  The attend is the exact fp32-softmax flow of
+  ``SelfAttention._decode_attend`` (compute-dtype QK einsum, fp32
+  softmax, compute-dtype PV), so greedy tokens agree with
+  ``transformer.generate``'s decode tier.
+* ``layout="dense"`` — the test oracle: a contiguous per-slot cache
+  written positionally, no block table anywhere.  Same contraction
+  length (``pages_per_slot * page_size``), same masking — the paged
+  step is **bit-identical** to it (the acceptance pin: only the
+  block-table plumbing differs).
+
+``attention_impl="flash"`` swaps the decode-geometry Pallas kernel
+(:func:`~chainermn_tpu.ops.pallas_attention.flash_decode`) into the
+paged attend for single-token steps — fp32 online softmax over pages,
+agreeing with the dense attend to float roundoff (the kernel is the
+TPU fast path; the dense attend is the bit-exactness contract).
+
+Tensor parallelism reuses the audited ``parallel`` layers
+(ColumnParallel/RowParallel — heads shard, the row-parallel psum per
+projection is the only collective), so the whole decode step costs
+exactly 2 all-reduces per layer: pinned as the ``decode_step`` budget
+in ``analysis.budgets`` and attributed by shardlint with zero
+partitioner insertions (tests/test_serving.py).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Any, Callable, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import linen as nn
+from jax import lax
+
+from ..models.transformer import MlpBlock, TpMlpBlock, TransformerLM
+from ..observability import timeline as _obs
+from ..resilience import fault_injection as _fi
+from .kv_cache import PagedKVCache, pages_needed
+
+_LAYOUTS = ("paged", "dense")
+_ATTENTION_IMPLS = ("dense", "flash")
+
+
+def _write_paged(kl, vl, k, v, tables, lengths, page_size):
+    """Scatter this call's k/v rows into the page pool.  Cache position
+    for (row b, step j) is ``lengths[b] + j``; its page comes from the
+    row's block table.  Inactive slots (length 0, table all null) write
+    the null page — in-bounds garbage nothing ever reads."""
+    b, s = k.shape[0], k.shape[1]
+    pos = lengths[:, None] + jnp.arange(s)[None, :]          # (b, s)
+    page = jnp.take_along_axis(tables, pos // page_size, axis=1)
+    off = pos % page_size
+    flat = lambda a: a.reshape(b * s, *a.shape[2:])
+    kl = kl.at[flat(page), flat(off)].set(flat(k))
+    vl = vl.at[flat(page), flat(off)].set(flat(v))
+    return kl, vl
+
+
+def _write_dense(kl, vl, k, v, lengths):
+    """The oracle's write: position-indexed into a contiguous per-slot
+    cache — no block table anywhere."""
+    b, s = k.shape[0], k.shape[1]
+    rows = jnp.arange(b)[:, None]
+    pos = lengths[:, None] + jnp.arange(s)[None, :]
+    kl = kl.at[rows, pos].set(k)
+    vl = vl.at[rows, pos].set(v)
+    return kl, vl
+
+
+def _attend_cached(q, kg, vg, lengths, scale):
+    """The decode attend on a gathered/contiguous cache view
+    ``(b, K, heads, dh)`` — the exact dtype flow of
+    ``SelfAttention._decode_attend`` (compute-dtype QK, fp32 softmax,
+    compute-dtype PV), shared by the paged and dense layouts so their
+    bit-identity is a property of the plumbing, not luck."""
+    b, s = q.shape[0], q.shape[1]
+    k_tot = kg.shape[1]
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q, kg
+    ).astype(jnp.float32) * scale
+    kpos = jnp.arange(k_tot)[None, :]
+    # causal-within-cache mask: query row j (cache position
+    # lengths[b]+j) sees positions <= its own — including the k/v this
+    # call just wrote
+    qpos = lengths[:, None] + jnp.arange(s)[None, :]          # (b, s)
+    mask = kpos[None, :, :] <= qpos[:, :, None]               # (b, s, K)
+    scores = jnp.where(
+        mask[:, None], scores, jnp.finfo(jnp.float32).min
+    )
+    p = jnp.exp(scores - jnp.max(scores, axis=-1, keepdims=True))
+    p = p / jnp.sum(p, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bkhd->bqhd", p.astype(q.dtype), vg)
+
+
+class _PagedAttention(nn.Module):
+    """SelfAttention's projections (same submodule names, so trained
+    params load verbatim) around the paged/dense cache attend."""
+
+    n_heads: int
+    dtype: Any
+    tp_axis: Optional[str]
+    layout: str
+    attention_impl: str
+    page_size: int
+
+    @nn.compact
+    def __call__(self, x, kl, vl, tables, lengths):
+        b, s, d = x.shape
+        heads = self.n_heads
+        dh = d // heads
+        if self.tp_axis is not None:
+            from ..parallel import ColumnParallelDense, RowParallelDense
+
+            ntp = lax.axis_size(self.tp_axis)
+            heads = heads // ntp
+            col = functools.partial(
+                ColumnParallelDense, axis_name=self.tp_axis,
+                use_bias=False, dtype=self.dtype,
+            )
+            q, k, v = col(d)(x), col(d)(x), col(d)(x)
+        else:
+            qkv = nn.Dense(3 * d, use_bias=False, dtype=self.dtype)(x)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+        q = q.reshape(b, s, heads, dh)
+        k = k.reshape(b, s, heads, dh).astype(q.dtype)
+        v = v.reshape(b, s, heads, dh).astype(q.dtype)
+        if self.layout == "paged":
+            kl, vl = _write_paged(kl, vl, k, v, tables, lengths,
+                                  self.page_size)
+            if self.attention_impl == "flash" and s == 1:
+                from ..ops.pallas_attention import flash_decode
+
+                out = flash_decode(
+                    q[:, 0], kl, vl, tables, lengths + 1,
+                    scale=dh ** -0.5,
+                )[:, None]
+            else:
+                kg = kl[tables].reshape(b, -1, heads, dh)
+                vg = vl[tables].reshape(b, -1, heads, dh)
+                out = _attend_cached(q, kg, vg, lengths, dh ** -0.5)
+        else:
+            kl, vl = _write_dense(kl, vl, k, v, lengths)
+            out = _attend_cached(q, kl, vl, lengths, dh ** -0.5)
+        out = out.reshape(b, s, heads * dh)
+        if self.tp_axis is not None:
+            out = RowParallelDense(
+                d, axis_name=self.tp_axis, use_bias=False,
+                dtype=self.dtype,
+            )(out)
+        else:
+            out = nn.Dense(d, use_bias=False, dtype=self.dtype)(out)
+        return out, kl, vl
+
+
+class _PagedBlock(nn.Module):
+    """TransformerBlock's pre-LN residual wiring with the paged
+    attention; submodule names match the training block's."""
+
+    n_heads: int
+    d_ff: int
+    dtype: Any
+    ln_dtype: Any
+    tp_axis: Optional[str]
+    layout: str
+    attention_impl: str
+    page_size: int
+
+    @nn.compact
+    def __call__(self, x, kl, vl, tables, lengths):
+        h = nn.LayerNorm(dtype=self.ln_dtype, name="LayerNorm_0")(x)
+        h, kl, vl = _PagedAttention(
+            self.n_heads, dtype=self.dtype, tp_axis=self.tp_axis,
+            layout=self.layout, attention_impl=self.attention_impl,
+            page_size=self.page_size, name="SelfAttention_0",
+        )(h.astype(self.dtype), kl, vl, tables, lengths)
+        x = x + h
+        h = nn.LayerNorm(dtype=self.ln_dtype, name="LayerNorm_1")(x)
+        if self.tp_axis is not None:
+            mlp = TpMlpBlock(self.d_ff, tp_axis=self.tp_axis,
+                             dtype=self.dtype, name="TpMlpBlock_0")
+        else:
+            mlp = MlpBlock(self.d_ff, dtype=self.dtype,
+                           name="MlpBlock_0")
+        return x + mlp(h.astype(self.dtype)), kl, vl
+
+
+class PagedLM(nn.Module):
+    """TransformerLM's decode forward against an external paged cache.
+
+    Parameter tree is identical to :class:`~chainermn_tpu.models.
+    transformer.TransformerLM`'s (explicit submodule names), so trained
+    checkpoints apply verbatim.  The cache arrays ride the call
+    functionally — `(logits, k_pages, v_pages)` out — so the compiled
+    step donates and returns them instead of mutating flax variables.
+    """
+
+    vocab_size: int
+    d_model: int
+    n_heads: int
+    n_layers: int
+    d_ff: int
+    max_len: int
+    dtype: Any = jnp.bfloat16
+    ln_dtype: Any = jnp.float32
+    tp_axis: Optional[str] = None
+    layout: str = "paged"
+    attention_impl: str = "dense"
+    page_size: int = 16
+
+    @nn.compact
+    def __call__(self, tokens, k_pages, v_pages, tables, lengths):
+        b, s = tokens.shape
+        embed = nn.Embed(
+            self.vocab_size, self.d_model,
+            embedding_init=nn.initializers.normal(0.02),
+            dtype=jnp.float32, name="embed",
+        )
+        pos_table = self.param(
+            "pos_embed", nn.initializers.normal(0.02),
+            (self.max_len, self.d_model), jnp.float32,
+        )
+        positions = lengths[:, None] + jnp.arange(s)[None, :]
+        pos = jnp.take(
+            pos_table, jnp.clip(positions, 0, self.max_len - 1), axis=0
+        )  # (b, s, d)
+        x = (embed(tokens) + pos).astype(self.dtype)
+        for i in range(self.n_layers):
+            x, kl, vl = _PagedBlock(
+                self.n_heads, self.d_ff, dtype=self.dtype,
+                ln_dtype=self.ln_dtype, tp_axis=self.tp_axis,
+                layout=self.layout, attention_impl=self.attention_impl,
+                page_size=self.page_size, name=f"TransformerBlock_{i}",
+            )(x, k_pages[i], v_pages[i], tables, lengths)
+            k_pages = k_pages.at[i].set(kl)
+            v_pages = v_pages.at[i].set(vl)
+        x = nn.LayerNorm(dtype=self.ln_dtype, name="LayerNorm_0")(x)
+        logits = x.astype(jnp.float32) @ embed.embedding.T
+        return logits, k_pages, v_pages
+
+
+class DecodeEngine:
+    """Owns the compiled decode/prefill programs and the page pool for
+    one replica.
+
+    ``model``: the (trained) :class:`TransformerLM` whose architecture
+    and params to serve — ``seq_axis``/``vocab_parallel`` models are
+    rejected (training-only shardings; materialize the dense twin,
+    same param tree).  ``capacity`` fixed decode slots (the padded slot
+    model: one compiled decode program per capacity, prompts padded to
+    ``page_size`` buckets — join/leave between iterations never
+    retraces).  Tensor-parallel models pass ``comm`` (mesh binding
+    ``model.tp_axis``) and ``param_specs`` exactly like
+    ``transformer.generate``.
+    """
+
+    def __init__(self, model: TransformerLM, params, *,
+                 capacity: int = 4, page_size: int = 16,
+                 pages_per_slot: Optional[int] = None,
+                 num_pages: Optional[int] = None,
+                 comm=None, param_specs=None,
+                 layout: str = "paged",
+                 attention_impl: str = "dense"):
+        if layout not in _LAYOUTS:
+            raise ValueError(f"layout must be one of {_LAYOUTS}")
+        if attention_impl not in _ATTENTION_IMPLS:
+            raise ValueError(
+                f"attention_impl must be one of {_ATTENTION_IMPLS}"
+            )
+        if getattr(model, "seq_axis", None) is not None:
+            raise ValueError(
+                "serving decodes dense (optionally tensor-parallel) "
+                "models; construct the seq_axis=None twin (the param "
+                "tree is identical)"
+            )
+        if getattr(model, "vocab_parallel", False):
+            raise ValueError(
+                "vocab_parallel serving is not implemented; serve the "
+                "dense-head twin"
+            )
+        self.tp_axis = getattr(model, "tp_axis", None)
+        if self.tp_axis is not None and (
+            comm is None or param_specs is None
+        ):
+            raise ValueError(
+                "a tensor-parallel model serves under its mesh: pass "
+                "comm= and param_specs= (e.g. megatron_param_specs)"
+            )
+        self.model = model
+        self.params = params
+        self.comm = comm
+        self.param_specs = param_specs
+        self.capacity = int(capacity)
+        self.page_size = int(page_size)
+        if pages_per_slot is None:
+            pages_per_slot = pages_needed(model.max_len, page_size)
+        self.pages_per_slot = int(pages_per_slot)
+        self.max_total = min(
+            self.pages_per_slot * self.page_size, model.max_len
+        )
+        self.layout = layout
+        self.attention_impl = attention_impl
+        self.module = PagedLM(
+            vocab_size=model.vocab_size, d_model=model.d_model,
+            n_heads=model.n_heads, n_layers=model.n_layers,
+            d_ff=model.d_ff or 4 * model.d_model,
+            max_len=model.max_len, dtype=model.dtype,
+            ln_dtype=getattr(model, "ln_dtype", jnp.float32),
+            tp_axis=self.tp_axis, layout=layout,
+            attention_impl=attention_impl, page_size=self.page_size,
+        )
+        self.cache = PagedKVCache(
+            n_layers=model.n_layers, n_heads=model.n_heads,
+            d_head=model.d_model // model.n_heads,
+            capacity=self.capacity, page_size=self.page_size,
+            num_pages=num_pages, pages_per_slot=self.pages_per_slot,
+            dtype=model.dtype,
+        )
+        # an explicit (small) num_pages also bounds the admissible
+        # request: one needing more pages than the whole pool passes
+        # the slot-width check but can NEVER be admitted — submit()
+        # must reject it up front or the batcher loops on it forever
+        self.max_total = min(
+            self.max_total, (self.cache.num_pages - 1) * self.page_size
+        )
+        if layout == "dense":
+            # the oracle's contiguous per-slot cache, sized to the SAME
+            # contraction length as the paged pool so the two layouts'
+            # reductions are shape-identical (bit-exactness contract)
+            shape = (model.n_layers, self.capacity, self.max_pages_tokens,
+                     model.n_heads, model.d_model // model.n_heads)
+            self.cache.k_pages = jnp.zeros(shape, model.dtype)
+            self.cache.v_pages = jnp.zeros(shape, model.dtype)
+        self._fn = self._build()
+        self.steps = 0
+
+    @property
+    def max_pages_tokens(self) -> int:
+        return self.pages_per_slot * self.page_size
+
+    # -- compiled step --------------------------------------------------
+    def _raw_fn(self) -> Callable:
+        module = self.module
+
+        def fn(params, tokens, k_pages, v_pages, tables, lengths):
+            return module.apply(
+                params, tokens, k_pages, v_pages, tables, lengths
+            )
+
+        return fn
+
+    def _shard_mapped(self, fn) -> Callable:
+        """The one place the TP program's specs live: pages shard by
+        heads (axis 3, both layouts), everything else replicated —
+        shared by the compiled step, the collective trace, and the
+        shardlint HLO so they can never diverge."""
+        from jax.sharding import PartitionSpec as P
+
+        pages = P(None, None, None, self.tp_axis, None)
+        return jax.shard_map(
+            fn, mesh=self.comm.mesh,
+            in_specs=(self.param_specs, P(), pages, pages, P(), P()),
+            out_specs=(P(), pages, pages),
+            check_vma=False,
+        )
+
+    def _build(self) -> Callable:
+        fn = self._raw_fn()
+        if self.tp_axis is None:
+            return jax.jit(fn, donate_argnums=(2, 3))
+        return jax.jit(self._shard_mapped(fn), donate_argnums=(2, 3))
+
+    # -- serving ops ----------------------------------------------------
+    def prompt_bucket(self, prompt_len: int) -> int:
+        """Prompts pad to page_size multiples — one compiled prefill
+        program per bucket, stable under continuous joins."""
+        return max(pages_needed(prompt_len, self.page_size)
+                   * self.page_size, self.page_size)
+
+    def admit(self, total_tokens: int) -> int:
+        if total_tokens > self.max_total:
+            raise ValueError(
+                f"request needs {total_tokens} cache positions > "
+                f"max_total={self.max_total} (pages_per_slot * "
+                "page_size, capped by model.max_len)"
+            )
+        return self.cache.admit(total_tokens)
+
+    def release(self, slot: int) -> None:
+        self.cache.release(slot)
+
+    def _tables_for(self, rows) -> jnp.ndarray:
+        if self.layout == "dense":
+            # the oracle has no tables; pass the slot ids (unused by
+            # the dense write/attend, but keeps one call signature)
+            return jnp.asarray(np.asarray(rows, np.int32)).reshape(
+                len(rows), 1
+            )
+        return jnp.asarray(self.cache.block_tables[rows])
+
+    def prefill(self, slot: int, prompt: Sequence[int]) -> np.ndarray:
+        """Run the prompt through the model, writing its k/v into the
+        slot's pages; returns the next-token logits row (vocab,).
+        The prompt is padded to its page bucket — padded positions hold
+        garbage k/v that the masked attend never reads and the next
+        writes overwrite."""
+        prompt = np.asarray(prompt, np.int32)
+        n = int(prompt.shape[0])
+        if n < 1:
+            raise ValueError("empty prompt")
+        _fi.fire("serving.prefill")
+        with _obs.span("serving.prefill", slot=slot, prompt=n):
+            bucket = self.prompt_bucket(n)
+            toks = np.zeros((1, bucket), np.int32)
+            toks[0, :n] = prompt
+            if self.layout == "dense":
+                k_in = self.cache.k_pages[:, slot: slot + 1]
+                v_in = self.cache.v_pages[:, slot: slot + 1]
+            else:
+                k_in, v_in = self.cache.k_pages, self.cache.v_pages
+            logits, k_out, v_out = self._fn(
+                self.params, jnp.asarray(toks), k_in, v_in,
+                self._tables_for([slot]), jnp.zeros((1,), jnp.int32),
+            )
+            if self.layout == "dense":
+                self.cache.k_pages = self.cache.k_pages.at[
+                    :, slot: slot + 1].set(k_out)
+                self.cache.v_pages = self.cache.v_pages.at[
+                    :, slot: slot + 1].set(v_out)
+            else:
+                self.cache.set_pages(k_out, v_out)
+            self.cache.advance(slot, n)
+            return np.asarray(logits[0, n - 1])
+
+    def decode_step(self, tokens: np.ndarray) -> np.ndarray:
+        """One token for every slot (the padded slot model: inactive
+        slots run too, on the null page, and their logits are garbage
+        the batcher ignores).  ``tokens``: (capacity,) int32 — each
+        active slot's pending token.  Returns (capacity, vocab) logits;
+        active slots' cache lengths advance by one."""
+        _fi.fire("serving.decode_step")
+        active = [s for s in range(self.capacity) if self.cache.active[s]]
+        with _obs.span("serving.decode", active=len(active)):
+            toks = jnp.asarray(
+                np.asarray(tokens, np.int32).reshape(self.capacity, 1)
+            )
+            if self.layout == "dense":
+                tables = self._tables_for(list(range(self.capacity)))
+            else:
+                tables = self.cache.tables_array()
+            logits, k_out, v_out = self._fn(
+                self.params, toks, self.cache.k_pages,
+                self.cache.v_pages, tables,
+                self.cache.lengths_array(),
+            )
+            self.cache.set_pages(k_out, v_out)
+            for s in active:
+                self.cache.advance(s, 1)
+            self.steps += 1
+            return np.asarray(logits[:, 0])
+
+    def generate(self, prompt: Sequence[int], max_new_tokens: int,
+                 eos_id: Optional[int] = None) -> list:
+        """Single-request greedy decode (admit -> prefill -> decode
+        loop -> release) — the oracle path tests and the replica's
+        drain replay use.  Returns prompt + generated tokens."""
+        prompt = list(int(t) for t in prompt)
+        slot = self.admit(len(prompt) + max_new_tokens)
+        try:
+            logits = self.prefill(slot, prompt)
+            out = list(prompt)
+            tok = int(np.argmax(logits))
+            out.append(tok)
+            for _ in range(max_new_tokens - 1):
+                if eos_id is not None and tok == eos_id:
+                    break
+                toks = np.zeros((self.capacity,), np.int32)
+                toks[slot] = tok
+                step_logits = self.decode_step(toks)
+                tok = int(np.argmax(step_logits[slot]))
+                out.append(tok)
+        finally:
+            self.release(slot)
+        return out
+
+    # -- analysis hooks -------------------------------------------------
+    def _example_args(self, phase: str = "decode", bucket: int = 0):
+        s = 1 if phase == "decode" else (bucket or self.page_size)
+        b = self.capacity if phase == "decode" else 1
+        toks = jnp.zeros((b, s), jnp.int32)
+        if self.layout == "dense":
+            tables = jnp.zeros((b, 1), jnp.int32)
+            k = self.cache.k_pages[:, :b] if phase != "decode" else \
+                self.cache.k_pages
+            v = self.cache.v_pages[:, :b] if phase != "decode" else \
+                self.cache.v_pages
+        else:
+            tables = jnp.zeros((b, self.pages_per_slot), jnp.int32)
+            k, v = self.cache.k_pages, self.cache.v_pages
+        lengths = jnp.zeros((b,), jnp.int32)
+        return (self.params, toks, k, v, tables, lengths)
+
+    def collective_trace(self, phase: str = "decode", bucket: int = 0):
+        """The authored :class:`~chainermn_tpu.analysis.trace.
+        CollectiveTrace` of the compiled decode (or prefill) program —
+        what the ``decode_step`` budget pin enforces and the bench
+        fingerprints disclose."""
+        from ..analysis import trace_collectives
+
+        fn = self._raw_fn()
+        args = self._example_args(phase, bucket)
+        if self.tp_axis is None:
+            return trace_collectives(fn, *args)
+        return trace_collectives(self._shard_mapped(fn), *args)
+
+    def compiled_text(self, phase: str = "decode", bucket: int = 0) -> str:
+        """Compiled HLO of the decode/prefill program (undonated twin)
+        for the shardlint attribution check."""
+        fn = self._raw_fn()
+        if self.tp_axis is not None:
+            fn = self._shard_mapped(fn)
+        args = self._example_args(phase, bucket)
+        return jax.jit(fn).lower(*args).compile().as_text()
+
+    def attribution(self, timeline_or_report):
+        """Join a telemetry export's measured collective spans to this
+        engine's decode trace (``observability.attribute``) — the
+        per-token latency-attribution recipe of docs/serving.md.
+        Never drops: spans and records that don't pair are listed."""
+        from ..observability import attribute
+
+        return attribute(timeline_or_report, self.collective_trace())
+
+
+def engine_from_trained(model: TransformerLM, params, **kw) -> DecodeEngine:
+    """Engine over a model possibly trained with training-only sharding
+    (sequence parallelism): materialize the dense twin — identical
+    param tree — then serve it."""
+    if getattr(model, "seq_axis", None) is not None:
+        import dataclasses
+
+        fields = {
+            f.name: getattr(model, f.name)
+            for f in dataclasses.fields(model)
+            if f.name not in ("parent", "name")
+        }
+        fields["seq_axis"] = None
+        model = type(model)(**fields)
+    return DecodeEngine(model, params, **kw)

@@ -1,0 +1,327 @@
+"""Paged KV cache for the serving tier.
+
+vLLM-style paged attention state, TPU-shaped: the per-request KV cache
+is not a contiguous ``(max_len, heads, d)`` buffer but a set of
+fixed-size **pages** drawn from one shared pool, addressed through a
+per-slot **block table**.  Continuous batching (``serving.batcher``)
+needs exactly this: requests of wildly different lengths share one
+compiled decode program (fixed slot count, fixed page pool) and memory
+is bounded by the pool, not by ``capacity * max_len``.
+
+Design points:
+
+* **One stacked array per tensor.**  ``k_pages`` / ``v_pages`` are
+  ``(n_layers, num_pages, page_size, n_heads, d_head)`` — a single
+  pytree leaf, so the compiled decode step takes the whole cache as one
+  donated operand and the checkpoint layer sees plain arrays.
+* **Page 0 is the null page.**  Never allocated; inactive slots' block
+  tables point at it, so the padded-slot decode program always reads
+  and writes in-bounds (garbage it never uses) instead of branching.
+* **Deterministic allocator.**  The free list is kept sorted ascending
+  and admission reserves ``ceil(total_tokens / page_size)`` pages up
+  front — the same request stream produces the same tables on every
+  rank and every run (the block tables ride the compiled program's
+  inputs, so nondeterminism here would desynchronize SPMD replicas).
+  Reservation at admit also means a running request can never hit a
+  mid-stream out-of-pages condition; the only failure point is
+  admission, where the batcher can queue.  Pages are unit-granularity,
+  so the pool cannot fragment: ``can_admit`` is exactly "enough free
+  pages and a free slot" (pinned by test).
+* **Deterministic eviction.**  ``choose_victim()`` names the most
+  recently admitted active slot (LIFO — the request that joined last
+  has done the least work).  ``evict()`` releases a slot's pages and
+  returns them to the sorted free list; the batcher re-queues the
+  request (greedy decode replays bit-identically from the prompt).
+* **Checkpoint round-trip.**  ``state_dict()`` is a flat dict of
+  arrays that the existing checkpoint layer
+  (``extensions.checkpoint``) snapshots as-is; ``load_state_dict``
+  reconstructs the allocator's host state (free list, per-slot page
+  ownership) from the saved tables — a replica warm-starts with its
+  pages and in-flight lengths intact.
+* **TP resharding.**  Pages shard over the tensor-parallel axis by
+  heads (dimension 3).  :func:`reshard_kv_state` re-splits a saved
+  N-shard cache onto M shards bit-identically to a fresh split of the
+  concatenated global cache — the serving analogue of
+  ``resilience.elastic.reshard_state``'s ZeRO block rule.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional, Sequence
+
+import jax.numpy as jnp
+import numpy as np
+
+NULL_PAGE = 0
+
+
+class CacheAdmissionError(RuntimeError):
+    """A request was admitted past ``can_admit`` — pool or slots
+    exhausted.  The batcher never triggers this (it checks first); a
+    direct caller sees a loud error instead of a corrupted table."""
+
+
+def pages_needed(total_tokens: int, page_size: int) -> int:
+    """Pages a request occupying ``total_tokens`` cache positions needs
+    (its prompt plus every generated token except the last, which is
+    sampled but never written — callers pass prompt + max_new_tokens
+    and over-reserve by at most one token's worth)."""
+    return max(1, math.ceil(total_tokens / page_size))
+
+
+class PagedKVCache:
+    """The page pool, block tables, and allocator for one replica.
+
+    ``capacity`` decode slots share ``num_pages`` pages of
+    ``page_size`` tokens each (page 0 reserved as the null page).
+    ``pages_per_slot`` bounds one request's table row — the static
+    width of the compiled program's table operand.
+    """
+
+    def __init__(self, *, n_layers: int, n_heads: int, d_head: int,
+                 capacity: int, page_size: int = 16,
+                 num_pages: Optional[int] = None,
+                 pages_per_slot: Optional[int] = None,
+                 dtype=jnp.bfloat16):
+        if capacity < 1:
+            raise ValueError(f"capacity must be >= 1, got {capacity}")
+        if page_size < 1:
+            raise ValueError(f"page_size must be >= 1, got {page_size}")
+        self.n_layers = int(n_layers)
+        self.n_heads = int(n_heads)
+        self.d_head = int(d_head)
+        self.capacity = int(capacity)
+        self.page_size = int(page_size)
+        if pages_per_slot is None:
+            pages_per_slot = 8
+        self.pages_per_slot = int(pages_per_slot)
+        if num_pages is None:
+            # enough for every slot to hold a full-length request, + null
+            num_pages = capacity * self.pages_per_slot + 1
+        if num_pages < 2:
+            raise ValueError("num_pages must be >= 2 (page 0 is null)")
+        self.num_pages = int(num_pages)
+        self.dtype = dtype
+        shape = (self.n_layers, self.num_pages, self.page_size,
+                 self.n_heads, self.d_head)
+        self.k_pages = jnp.zeros(shape, dtype)
+        self.v_pages = jnp.zeros(shape, dtype)
+        # host-side allocator state (numpy: tables ship as step inputs)
+        self.block_tables = np.full(
+            (self.capacity, self.pages_per_slot), NULL_PAGE, np.int32
+        )
+        self.lengths = np.zeros((self.capacity,), np.int32)
+        self.active = np.zeros((self.capacity,), bool)
+        self._free_pages: List[int] = list(range(1, self.num_pages))
+        self._slot_pages: Dict[int, List[int]] = {}
+        # admission order (slot ids, oldest first) — the deterministic
+        # eviction victim is the tail
+        self._admit_order: List[int] = []
+
+    # -- pool accounting ------------------------------------------------
+    @property
+    def free_pages(self) -> int:
+        return len(self._free_pages)
+
+    @property
+    def used_pages(self) -> int:
+        return sum(len(p) for p in self._slot_pages.values())
+
+    @property
+    def free_slots(self) -> List[int]:
+        return [s for s in range(self.capacity) if not self.active[s]]
+
+    def utilization(self) -> float:
+        """Fraction of allocatable pages currently owned by a slot."""
+        return self.used_pages / max(self.num_pages - 1, 1)
+
+    def check_invariants(self) -> None:
+        """Allocator invariants, asserted by tests after every op mix:
+        page sets disjoint, null page never owned, conservation (free +
+        used == pool), free list sorted (determinism), tables consistent
+        with ownership."""
+        owned: List[int] = []
+        for slot, pages in self._slot_pages.items():
+            assert self.active[slot], f"slot {slot} owns pages inactive"
+            assert NULL_PAGE not in pages, "null page allocated"
+            assert list(self.block_tables[slot][: len(pages)]) == pages
+            owned += pages
+        assert len(set(owned)) == len(owned), "page double-owned"
+        assert not set(owned) & set(self._free_pages), "free page owned"
+        assert len(owned) + len(self._free_pages) == self.num_pages - 1
+        assert self._free_pages == sorted(self._free_pages)
+        assert sorted(self._admit_order) == sorted(self._slot_pages)
+
+    # -- admission ------------------------------------------------------
+    def can_admit(self, total_tokens: int) -> bool:
+        need = pages_needed(total_tokens, self.page_size)
+        if need > self.pages_per_slot:
+            return False
+        return bool(self.free_slots) and need <= len(self._free_pages)
+
+    def admit(self, total_tokens: int) -> int:
+        """Reserve a slot and its pages; returns the slot id.  The
+        lowest free slot and the lowest free pages are taken (sorted
+        free list), so admission is a pure function of allocator
+        state."""
+        need = pages_needed(total_tokens, self.page_size)
+        if need > self.pages_per_slot:
+            raise CacheAdmissionError(
+                f"request needs {need} pages > pages_per_slot="
+                f"{self.pages_per_slot} (total_tokens={total_tokens})"
+            )
+        free = self.free_slots
+        if not free:
+            raise CacheAdmissionError("no free decode slot")
+        if need > len(self._free_pages):
+            raise CacheAdmissionError(
+                f"need {need} pages, {len(self._free_pages)} free"
+            )
+        slot = free[0]
+        pages, self._free_pages = (
+            self._free_pages[:need], self._free_pages[need:]
+        )
+        self._slot_pages[slot] = pages
+        self.block_tables[slot, :] = NULL_PAGE
+        self.block_tables[slot, : len(pages)] = pages
+        self.lengths[slot] = 0
+        self.active[slot] = True
+        self._admit_order.append(slot)
+        return slot
+
+    def release(self, slot: int) -> None:
+        """Return a slot's pages to the pool (request finished)."""
+        if not self.active[slot]:
+            raise KeyError(f"slot {slot} is not active")
+        pages = self._slot_pages.pop(slot)
+        self._free_pages = sorted(self._free_pages + pages)
+        self.block_tables[slot, :] = NULL_PAGE
+        self.lengths[slot] = 0
+        self.active[slot] = False
+        self._admit_order.remove(slot)
+
+    def choose_victim(self) -> Optional[int]:
+        """Deterministic eviction victim: the most recently admitted
+        active slot (least progress lost on replay)."""
+        return self._admit_order[-1] if self._admit_order else None
+
+    def evict(self, slot: int) -> None:
+        """Same pool effect as :meth:`release`; named separately so the
+        batcher's logs distinguish retire from preempt."""
+        self.release(slot)
+
+    def advance(self, slot: int, n: int = 1) -> None:
+        """Account ``n`` more cache positions written for ``slot``."""
+        if not self.active[slot]:
+            raise KeyError(f"slot {slot} is not active")
+        new = int(self.lengths[slot]) + n
+        if new > len(self._slot_pages[slot]) * self.page_size:
+            raise CacheAdmissionError(
+                f"slot {slot} advanced past its {len(self._slot_pages[slot])}"
+                f"-page reservation ({new} tokens)"
+            )
+        self.lengths[slot] = new
+
+    # -- arrays for the compiled step ----------------------------------
+    def tables_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.block_tables)
+
+    def lengths_array(self) -> jnp.ndarray:
+        return jnp.asarray(self.lengths)
+
+    def set_pages(self, k_pages, v_pages) -> None:
+        """Install the decode step's updated page arrays (functional
+        update — the step returns fresh arrays)."""
+        self.k_pages, self.v_pages = k_pages, v_pages
+
+    # -- checkpoint round-trip -----------------------------------------
+    def state_dict(self) -> dict:
+        """Flat array dict the checkpoint layer snapshots as-is.  Slot
+        page counts make the table rows reconstructible (a table row is
+        padded with the null page, which a real reservation never
+        contains)."""
+        counts = np.array(
+            [len(self._slot_pages.get(s, ())) for s in range(self.capacity)],
+            np.int32,
+        )
+        order = np.array(self._admit_order, np.int32)
+        return {
+            "k_pages": self.k_pages,
+            "v_pages": self.v_pages,
+            "block_tables": self.block_tables.copy(),
+            "lengths": self.lengths.copy(),
+            "active": self.active.astype(np.int8),
+            "slot_page_counts": counts,
+            "admit_order": order,
+        }
+
+    def load_state_dict(self, state: dict) -> None:
+        """Rebuild pool + allocator from a snapshot (warm start)."""
+        k = state["k_pages"]
+        # validate against the CURRENT pool arrays, not the configured
+        # paged geometry — the dense-oracle engine replaces the pool
+        # with its contiguous per-slot layout, and its own snapshot
+        # must round-trip too
+        want = tuple(np.shape(self.k_pages))
+        if tuple(np.shape(k)) != want:
+            raise ValueError(
+                f"cache shape mismatch: snapshot {tuple(np.shape(k))} "
+                f"vs this cache's {want}"
+            )
+        self.k_pages = jnp.asarray(k, self.dtype)
+        self.v_pages = jnp.asarray(state["v_pages"], self.dtype)
+        self.block_tables = np.asarray(
+            state["block_tables"], np.int32
+        ).reshape(self.capacity, self.pages_per_slot).copy()
+        self.lengths = np.asarray(
+            state["lengths"], np.int32).reshape(self.capacity).copy()
+        self.active = np.asarray(
+            state["active"]).reshape(self.capacity).astype(bool)
+        counts = np.asarray(state["slot_page_counts"], np.int32)
+        self._slot_pages = {
+            s: [int(p) for p in self.block_tables[s, : int(counts[s])]]
+            for s in range(self.capacity) if self.active[s]
+        }
+        used = {p for pages in self._slot_pages.values() for p in pages}
+        self._free_pages = sorted(
+            set(range(1, self.num_pages)) - used
+        )
+        self._admit_order = [
+            int(s) for s in np.asarray(state["admit_order"], np.int32)
+        ]
+        self.check_invariants()
+
+
+def reshard_kv_state(states: Sequence[dict], new_world: int) -> List[dict]:
+    """Re-split an N-shard paged cache (heads axis) onto M shards.
+
+    ``states``: one :meth:`PagedKVCache.state_dict` per old TP rank, in
+    rank order (each holding ``H/N`` heads of the same pool).  The host
+    allocator state (tables, lengths, free list) is replicated across
+    TP ranks by construction, so rank 0's is kept.  The result is
+    bit-identical to splitting the concatenated global cache fresh —
+    pages are re-cut on the heads dimension only, block tables never
+    move (pinned by test)."""
+    if not states:
+        raise ValueError("reshard_kv_state needs at least one shard")
+    new_world = int(new_world)
+    k_full = np.concatenate(
+        [np.asarray(s["k_pages"]) for s in states], axis=3
+    )
+    v_full = np.concatenate(
+        [np.asarray(s["v_pages"]) for s in states], axis=3
+    )
+    heads = k_full.shape[3]
+    if heads % new_world:
+        raise ValueError(
+            f"{heads} global heads do not split over {new_world} shards"
+        )
+    out = []
+    for r in range(new_world):
+        sl = slice(r * heads // new_world, (r + 1) * heads // new_world)
+        shard = dict(states[0])
+        shard["k_pages"] = k_full[:, :, :, sl]
+        shard["v_pages"] = v_full[:, :, :, sl]
+        out.append(shard)
+    return out

@@ -1,0 +1,327 @@
+"""Elastic decode replicas over a shared-FS request journal.
+
+The serving analogue of ``Trainer.run_elastic``: N replicas (each a
+communicator world of its own — typically one process or one TP group)
+serve one request stream.  The stream lives in a **journal directory**
+on the shared filesystem: requests are submitted as atomic JSON files,
+results written the same way — so a replica's death loses *no queued
+request*, only its in-flight progress, and greedy decode replays that
+bit-identically from the prompt.
+
+Claiming is deterministic: request ``seq % n_replicas == replica_index``
+(the submission sequence number, not a hash — any world agrees on the
+partition without communicating).  After a world resize the survivors
+re-derive the partition over the *remaining* unserved requests, so a
+dead replica's share migrates without coordination.
+
+Drain semantics: a :class:`~chainermn_tpu.resilience.errors.
+PreemptionError` surfacing inside :meth:`DecodeReplica.serve` (the
+injector's ``preempt`` kind, or a real reclaim notice) stops the loop
+cleanly — in-flight requests stay unserved in the journal, the KV
+cache snapshots through the checkpoint layer
+(:meth:`DecodeReplica.drain`), and the replica reports itself drained.
+A hard kill (``die``) is the same minus the snapshot.  Either way
+:func:`serve_elastic` on the surviving world re-forms the communicator
+(``resilience.elastic.reform_world``), re-claims, and completes the
+stream; a rejoining replica warm-starts from the drain snapshot —
+pages AND the in-flight request state (slot, tokens so far), so
+drained requests resume decoding mid-stream from their restored pages
+(``PagedKVCache.load_state_dict``) instead of replaying the prompt;
+across a TP resize the pages re-split by heads via
+:func:`~chainermn_tpu.serving.kv_cache.reshard_kv_state`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+from typing import Callable, List, Optional, Sequence
+
+from ..observability import timeline as _obs
+from ..resilience import fault_injection as _fi
+from ..resilience.elastic import write_manifest as _atomic_write
+from ..resilience.errors import PreemptionError
+from ..resilience.log import emit
+from .batcher import FAILED, RUNNING, ContinuousBatcher, Request
+
+
+class RequestJournal:
+    """The shared-FS request/result exchange.
+
+    ``req_<seq>_<id>.json`` files are the queue (seq = submission
+    order, zero-padded so lexicographic order IS submission order);
+    ``res_<id>.json`` files are the results.  Writes are tmp+rename
+    atomic, so a reader never sees a torn request — the same contract
+    as the checkpoint manifests."""
+
+    def __init__(self, root: str):
+        self.root = root
+        os.makedirs(root, exist_ok=True)
+
+    def submit(self, request: Request) -> None:
+        # next seq = max existing + 1, parsed from the COMMITTED
+        # request files only — a counting scheme would also count a
+        # crashed submitter's leftover .tmp and skip seqs forever
+        seqs = [int(n.split("_")[1]) for n in self._request_files()]
+        seq = max(seqs) + 1 if seqs else 0
+        _atomic_write(
+            {"id": request.id, "seq": seq, "prompt": request.prompt,
+             "max_new_tokens": request.max_new_tokens,
+             "eos_id": request.eos_id},
+            os.path.join(self.root, f"req_{seq:06d}_{request.id}.json"),
+        )
+
+    def submit_all(self, requests: Sequence[Request]) -> None:
+        for r in requests:
+            self.submit(r)
+
+    def _request_files(self) -> List[str]:
+        return sorted(
+            n for n in os.listdir(self.root)
+            if n.startswith("req_") and n.endswith(".json")
+        )
+
+    def requests(self) -> List[dict]:
+        """All journaled requests, submission order."""
+        out = []
+        for name in self._request_files():
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    out.append(json.load(f))
+            except (OSError, ValueError):
+                continue  # torn write in progress; next pass sees it
+        return out
+
+    def write_result(self, request: Request) -> None:
+        _atomic_write(
+            {"id": request.id, "state": request.state,
+             "tokens": request.output, "error": request.error},
+            os.path.join(self.root, f"res_{request.id}.json"),
+        )
+
+    def results(self) -> dict:
+        out = {}
+        for name in os.listdir(self.root):
+            if not (name.startswith("res_") and name.endswith(".json")):
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    doc = json.load(f)
+                out[doc["id"]] = doc
+            except (OSError, ValueError, KeyError):
+                continue
+        return out
+
+    def pending(self) -> List[dict]:
+        """Journaled requests with no result yet — what the surviving
+        world still owes, submission order."""
+        done = self.results()
+        return [r for r in self.requests() if r["id"] not in done]
+
+
+def claim(requests: Sequence[dict], replica_index: int,
+          n_replicas: int) -> List[dict]:
+    """Deterministic share of ``requests`` for one replica: the
+    journaled submission sequence number modulo the replica count.
+    The seq is STABLE (stamped at submit), so concurrent replicas
+    partition disjointly no matter when each one looks at the journal;
+    after a world resize the survivors re-derive the partition of the
+    still-pending seqs under the new count — a dead replica's share
+    migrates without coordination."""
+    out = []
+    for i, r in enumerate(requests):
+        seq = r.get("seq", i) if isinstance(r, dict) else i
+        if int(seq) % n_replicas == replica_index:
+            out.append(r)
+    return out
+
+
+class DecodeReplica:
+    """One replica: a batcher bound to a journal share.
+
+    ``checkpointer``: optional ``create_multi_node_checkpointer``
+    instance for the drain snapshot (the KV cache state rides the
+    existing checkpoint layer — warm restart loads pages + lengths
+    back instead of re-prefilling)."""
+
+    def __init__(self, engine, journal: RequestJournal, *,
+                 replica_index: int = 0, n_replicas: int = 1,
+                 checkpointer=None, max_retries: int = 1,
+                 timeout_s: Optional[float] = None):
+        self.engine = engine
+        self.journal = journal
+        self.replica_index = int(replica_index)
+        self.n_replicas = int(n_replicas)
+        self.checkpointer = checkpointer
+        self.batcher = ContinuousBatcher(
+            engine, max_retries=max_retries, timeout_s=timeout_s
+        )
+        self.drained = False
+
+    def _claimed(self) -> List[dict]:
+        return claim(self.journal.pending(), self.replica_index,
+                     self.n_replicas)
+
+    def _inflight_path(self) -> str:
+        return os.path.join(
+            self.journal.root, f"inflight_{self.replica_index}.json"
+        )
+
+    def drain(self, step: int = 0) -> None:
+        """Snapshot the KV cache through the checkpoint layer so a
+        rejoining replica warm-starts its pages (across a TP resize,
+        route the saved shards through ``reshard_kv_state``), plus the
+        in-flight request state (slot, tokens so far) the pages belong
+        to — without it a warm start would restore occupied slots no
+        request owns."""
+        if self.checkpointer is not None:
+            self.checkpointer.save(
+                step, {"kv_cache": self.engine.cache.state_dict()}
+            )
+            _atomic_write({
+                "step": step,
+                "requests": [
+                    {"id": r.id, "prompt": r.prompt,
+                     "max_new_tokens": r.max_new_tokens,
+                     "eos_id": r.eos_id, "tokens": r.tokens,
+                     "slot": slot}
+                    for slot, r in self.batcher.active.items()
+                ],
+            }, self._inflight_path())
+        emit("replica_drained", "serving.replica",
+             replica=self.replica_index,
+             in_flight=len(self.batcher.active))
+        self.drained = True
+
+    def warm_start(self) -> Optional[int]:
+        """Load the newest drain snapshot's cache state, if any, and
+        re-adopt its in-flight requests: each drained slot's request
+        resumes decoding from its restored pages and tokens instead of
+        replaying the prompt.  Restored-active slots without an
+        adoptable owner (no in-flight record, or drained before the
+        first token) are released — their requests are still pending
+        in the journal and replay from the prompt; keeping the slots
+        occupied would wedge admission forever."""
+        if self.checkpointer is None:
+            return None
+        step, state = self.checkpointer.resume()
+        if state is None or "kv_cache" not in state:
+            return None
+        cache = self.engine.cache
+        cache.load_state_dict(state["kv_cache"])
+        try:
+            with open(self._inflight_path()) as f:
+                doc = json.load(f)
+        except (OSError, ValueError):
+            doc = None
+        if doc and doc.get("step") == step:
+            for d in doc["requests"]:
+                slot = int(d["slot"])
+                # tokens==[] means it was drained mid-prefill: the
+                # cache holds nothing useful for it — replay instead
+                if not d["tokens"] or not cache.active[slot]:
+                    continue
+                r = Request(d["prompt"], d["max_new_tokens"],
+                            id=d["id"], eos_id=d.get("eos_id"))
+                r.tokens = [int(t) for t in d["tokens"]]
+                r.slot = slot
+                r.state = RUNNING
+                # deadline restarts at adoption — without it a
+                # configured timeout_s would never apply to resumed
+                # requests (submitted_at None is exempt)
+                r.submitted_at = time.monotonic()
+                self.batcher.active[slot] = r
+        for slot in range(cache.capacity):
+            if cache.active[slot] and slot not in self.batcher.active:
+                cache.release(slot)
+        return step
+
+    def _flush_finished(self, served: dict) -> None:
+        """Write every newly finished request's result (covers both
+        this round's claims and warm-start-resumed in-flight ones)."""
+        for r in self.batcher.finished.values():
+            if r.id not in served:
+                self.journal.write_result(r)
+                served[r.id] = r
+
+    def serve(self, max_rounds: Optional[int] = None) -> dict:
+        """Claim -> serve -> write results, until the journal share is
+        empty.  A :class:`PreemptionError` drains instead of crashing:
+        already-finished results are flushed (done work never replays),
+        and the loop exits cleanly with unserved requests still
+        journaled (the survivors' next claim covers them)."""
+        rounds = 0
+        served = {}
+        while True:
+            _fi.fire("serving.replica_round")
+            in_flight = {r.id for r in self.batcher.active.values()}
+            todo = [d for d in self._claimed()
+                    if d["id"] not in in_flight]
+            if not todo and not in_flight:
+                break
+            with _obs.span("serving.replica_round",
+                           replica=self.replica_index,
+                           n=len(todo) + len(in_flight)):
+                for d in todo:
+                    r = None
+                    try:
+                        r = Request(d["prompt"], d["max_new_tokens"],
+                                    id=d["id"], eos_id=d.get("eos_id"))
+                        self.batcher.submit(r)
+                    except ValueError as err:
+                        # a journaled request this replica can never
+                        # serve (outsizes its cache, malformed) fails
+                        # LOUDLY in the journal — wedging the claim
+                        # loop or crashing the replica would take the
+                        # whole share down with it
+                        if r is None:
+                            r = Request([0], 1, id=d["id"])
+                        r.state = FAILED
+                        r.error = str(err)
+                        self.journal.write_result(r)
+                        served[r.id] = r
+                        emit("request_failed", "serving.replica",
+                             request=r.id, why=str(err))
+                try:
+                    self.batcher.run()
+                except PreemptionError as err:
+                    self._flush_finished(served)
+                    self.drain()
+                    emit("replica_preempted", "serving.replica",
+                         replica=self.replica_index,
+                         error=f"{type(err).__name__}: {err}")
+                    return served
+                self._flush_finished(served)
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+        return served
+
+
+def serve_elastic(build: Callable, journal_root: str, *,
+                  communicator_name: str = "tpu", devices=None,
+                  replica_index: int = 0, n_replicas: int = 1,
+                  comm_kwargs: Optional[dict] = None) -> DecodeReplica:
+    """Re-form the world from the survivors and finish the stream —
+    ``Trainer.run_elastic``'s shape for the serving tier.
+
+    ``build(comm) -> DecodeReplica`` constructs the replica in the new
+    world (engine, journal binding, optional checkpointer for warm
+    start).  The journal's pending list re-partitions over the new
+    replica count by construction, so a dead replica's share migrates
+    to the survivors without dropping a single queued request."""
+    from ..resilience import elastic as _elastic
+
+    comm = _elastic.reform_world(
+        communicator_name, devices=devices, **(comm_kwargs or {})
+    )
+    replica = build(comm)
+    replica.replica_index = int(replica_index)
+    replica.n_replicas = int(n_replicas)
+    restored = replica.warm_start()
+    emit("replica_elastic_restart", "serving.serve_elastic",
+         replica=replica_index, n_replicas=n_replicas,
+         warm_start_step=restored, world=int(comm.size))
+    replica.serve()
+    return replica

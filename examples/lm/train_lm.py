@@ -75,6 +75,14 @@ def main(argv=None):
     p.add_argument("--generate", type=int, default=32,
                    help="tokens to sample after training (0 disables)")
     p.add_argument("--temperature", type=float, default=0.0)
+    p.add_argument("--serve", type=int, default=0,
+                   help="after training, serve N greedy-decode requests "
+                        "through the continuous-batching engine "
+                        "(chainermn_tpu.serving; 0 disables)")
+    p.add_argument("--serve-capacity", type=int, default=4,
+                   help="decode slots for --serve (padded slot model)")
+    p.add_argument("--serve-tokens", type=int, default=16,
+                   help="max new tokens per served request")
     p.add_argument("--cpu-mesh", action="store_true",
                    help="run on a virtual CPU device mesh (testing)")
     args = p.parse_args(argv)
@@ -237,6 +245,54 @@ def main(argv=None):
             )
             print(f"sampled ({tier} KV-cache decode): "
                   f"{out[0].tolist()}")
+
+    if args.serve > 0:
+        # Serving tier: greedy decode over the trained checkpoint
+        # through the continuous-batching engine (paged KV cache,
+        # padded slot model).  SP is training-only — the dense twin
+        # serves; TP serves natively under its mesh.
+        if args.vocab_parallel:
+            p.error("--serve does not support --vocab-parallel yet "
+                    "(serve the dense-head twin)")
+        from chainermn_tpu.serving.batcher import (
+            ContinuousBatcher,
+            Request,
+        )
+        from chainermn_tpu.serving.decode import DecodeEngine
+
+        serve_model = make_model(None, tp_axis, deterministic=True)
+        kw = {}
+        if tp_axis is not None:
+            kw = dict(comm=comm, param_specs=specs)
+        engine = DecodeEngine(
+            serve_model, params, capacity=args.serve_capacity, **kw
+        )
+        batcher = ContinuousBatcher(engine)
+        rng_req = np.random.RandomState(11)
+        requests = [
+            Request(
+                corpus[rng_req.randint(corpus.shape[0]),
+                       : int(rng_req.randint(4, 12))].tolist(),
+                args.serve_tokens,
+            )
+            for _ in range(args.serve)
+        ]
+        t0 = time.perf_counter()
+        results = batcher.serve(requests)
+        dt = time.perf_counter() - t0
+        report = batcher.latency_report()
+        if chief:
+            for r in results[: min(3, len(results))]:
+                print(f"  {r.id}: {r.output}")
+            lat = report.get("serving.token_latency", {})
+            print(
+                f"served {report['done']} requests "
+                f"({report['tokens_generated']} tokens, "
+                f"{report['tokens_generated'] / dt:,.0f} tok/s, "
+                f"token p50 {lat.get('p50_ms', float('nan')):.2f} ms "
+                f"p99 {lat.get('p99_ms', float('nan')):.2f} ms, "
+                f"failed {report['failed']})"
+            )
     return last_loss
 
 

@@ -1387,6 +1387,128 @@ def scenario_spot_reclaim_phase2(pid, nproc, scratch):
             "final_w": float(got[0])}
 
 
+def _serving_fixture():
+    """Shared by the serving_churn phases: a deterministic tiny LM
+    (same seed on every process -> identical params -> greedy decode
+    of any request is bit-identical no matter WHICH replica runs it)
+    and the scripted request stream."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, max_len=64)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    rng = np.random.RandomState(5)
+    stream = [
+        ("c%d" % i, rng.randint(0, 64, int(rng.randint(3, 10))).tolist(),
+         6)
+        for i in range(8)
+    ]
+    return model, params, stream
+
+
+def _serving_engine(model, params):
+    from chainermn_tpu.serving.decode import DecodeEngine
+
+    return DecodeEngine(model, params, capacity=2, page_size=8)
+
+
+def scenario_serving_churn_phase1(pid, nproc, scratch):
+    """ISSUE 13 satellite, run A (the churn): two single-process decode
+    replicas share one journal directory and partition a scripted
+    8-request stream by submission seq.  The fault injector kills
+    replica 1 (process-targeted ``die`` at the ``serving.decode_step``
+    site) mid-stream — a hard reclaim, no drain.  Replica 0 completes
+    its own share; replica 1's unserved requests stay journaled
+    (results are atomic files, so no torn result can exist).  Recovery
+    happens at restart (phase 2, world size 1)."""
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.replica import DecodeReplica, RequestJournal
+
+    model, params, stream = _serving_fixture()
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    if pid == 0:
+        journal.submit_all([
+            Request(p, m, id=i) for i, p, m in stream
+        ])
+    # journal-level rendezvous (no collectives: a dead peer must not
+    # wedge the survivor) — wait until the full stream is visible
+    deadline = time.monotonic() + 60
+    while len(journal.requests()) < len(stream):
+        if time.monotonic() > deadline:
+            raise RuntimeError("journal never filled")
+        time.sleep(0.05)
+    replica = DecodeReplica(
+        _serving_engine(model, params), journal,
+        replica_index=pid, n_replicas=nproc,
+    )
+    served = replica.serve()  # process 1 dies inside (env fault spec)
+    # replica 0 (the coordination-service host) lingers so the targeted
+    # kill lands before the leader disappears, then exits hard —
+    # jax.distributed teardown would block on the dead peer
+    print("RESULT " + json.dumps(
+        {"served": sorted(served), "replica": pid}
+    ), flush=True)
+    time.sleep(1.0)
+    os._exit(0)
+
+
+def scenario_serving_churn_phase2(pid, nproc, scratch):
+    """Run B (the elastic completion): the surviving world re-forms at
+    replica count 1 via ``serve_elastic`` — the pending partition
+    re-derives over ONE replica, so the dead replica's share migrates —
+    and every journaled request completes with outputs BIT-IDENTICAL
+    to a no-fault run (greedy decode is deterministic in the request,
+    not in the replica that runs it: pinned here by comparing every
+    result against a fresh in-process oracle engine)."""
+    from chainermn_tpu.serving.replica import RequestJournal, serve_elastic
+
+    assert nproc == 1
+    model, params, stream = _serving_fixture()
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    pending_before = len(journal.pending())
+    assert pending_before > 0, (
+        "phase 1's kill should have left unserved requests"
+    )
+
+    def build(comm):
+        from chainermn_tpu.serving.replica import DecodeReplica
+
+        return DecodeReplica(
+            _serving_engine(model, params), journal,
+            replica_index=0, n_replicas=1,
+        )
+
+    replica = serve_elastic(
+        build, os.path.join(scratch, "serve_journal"),
+        communicator_name="tpu", replica_index=0, n_replicas=1,
+    )
+    assert len(journal.pending()) == 0
+    results = journal.results()
+    assert sorted(results) == sorted(i for i, _p, _m in stream)
+    # the no-fault oracle: every request decoded directly
+    oracle_eng = _serving_engine(model, params)
+    mismatches = []
+    for rid, prompt, max_new in stream:
+        want = oracle_eng.generate(prompt, max_new)
+        if results[rid]["tokens"] != want:
+            mismatches.append(rid)
+    assert not mismatches, mismatches
+    ev = replica.batcher.engine  # engine served at least the migrated share
+    return {
+        "pending_before": pending_before,
+        "completed": len(results),
+        "bit_identical": True,
+        "survivor_steps": int(ev.steps),
+    }
+
+
 def scenario_telemetry(pid, nproc, scratch):
     """ISSUE 10 satellite: runtime telemetry in a REAL 2-process world
     (faults via CHAINERMN_TPU_FAULTS set by the spawning test):

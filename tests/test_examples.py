@@ -135,6 +135,22 @@ class TestExampleScripts:
         assert "final:" in out
         assert "sampled (tp-sharded KV-cache decode)" in out
 
+    def test_lm_serve_mode(self, tmp_path):
+        """ISSUE 13 satellite: the --serve mode wires the trained
+        checkpoint to the continuous-batching engine (greedy decode
+        over the paged KV cache) and reports throughput + token
+        latency percentiles."""
+        out = _run(
+            "lm/train_lm.py", "--cpu-mesh", "--steps", "10",
+            "--report-every", "5", "--seq-len", "64", "--d-model", "32",
+            "--n-layers", "2", "--vocab", "64", "--generate", "0",
+            "--serve", "4", "--serve-tokens", "6",
+            "--serve-capacity", "2", tmp_path=tmp_path,
+        )
+        assert "final:" in out
+        assert "served 4 requests" in out
+        assert "failed 0" in out
+
     def test_lm_vocab_parallel_train_and_sample(self, tmp_path):
         """vp tier end-to-end: vp_lm_loss training + native vp decode
         (the embedding/tied head stay sharded through sampling)."""

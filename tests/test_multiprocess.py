@@ -442,6 +442,43 @@ class TestSpotReclaim:
         assert payloads[0]["oracle_match"] is True
 
 
+class TestServingChurn:
+    def test_replica_killed_mid_stream_survivor_completes(self, tmp_path):
+        """ISSUE 13 satellite: a 2-replica serving world decodes a
+        scripted 8-request stream off one shared journal; the fault
+        injector kills replica 1 mid-stream (process-targeted ``die``
+        at its 3rd decode step).  The drained requests stay journaled;
+        the phase-2 world (size 1, via ``serve_elastic``) re-claims and
+        completes every one with outputs bit-identical to the no-fault
+        run (asserted in-scenario against a fresh oracle engine)."""
+        faults = json.dumps([
+            {"site": "serving.decode_step", "kind": "die", "at": [3],
+             "process": 1, "exit_code": 43},
+        ])
+        res = run_world(
+            "serving_churn_phase1", n_procs=2, tmpdir=tmp_path,
+            timeout=420, extra_env={"CHAINERMN_TPU_FAULTS": faults},
+        )
+        rc0, out0 = res[0]
+        rc1, out1 = res[1]
+        assert rc0 == 0 and "RESULT" in out0, (
+            f"replica 0 should complete its share\n{out0[-3000:]}"
+        )
+        assert rc1 == 43, (
+            f"replica 1 should be killed (exit 43) mid-stream\n"
+            f"{out1[-3000:]}"
+        )
+        line = [l for l in out0.splitlines() if l.startswith("RESULT ")]
+        served0 = json.loads(line[-1][len("RESULT "):])["served"]
+        assert served0 == ["c0", "c2", "c4", "c6"], served0
+        res = run_world("serving_churn_phase2", n_procs=1,
+                        tmpdir=tmp_path, timeout=420)
+        payloads = _assert_ok(res, "serving_churn_phase2")
+        assert payloads[0]["pending_before"] >= 4  # replica 1's share
+        assert payloads[0]["completed"] == 8
+        assert payloads[0]["bit_identical"] is True
+
+
 class TestExceptHook:
     def test_crash_contained_not_hung(self, tmp_path):
         # process 1 raises; its hook shuts the distributed client down;

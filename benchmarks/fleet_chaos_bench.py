@@ -1,0 +1,96 @@
+#!/usr/bin/env python
+"""Fleet recovery-latency bench: the detect→reform→reshard→resume path.
+
+Subject: how long the system takes to come back from a preemption wave
+— not model FLOPs.  One rung runs the 8-process smoke chain (a torn
+rendezvous payload, a wave killing 2 of 8 at step 3, one reshard leg
+at 6 landing on the numpy oracle) and derives its latencies from the
+merged :class:`~chainermn_tpu.fleet.report.FleetReport` wall clocks:
+
+  detect_to_reform_ms   first ``die`` fault → ``world_reformed``
+                        (includes the dead world's teardown and the
+                        new world's formation — the restart gap a
+                        scheduler pays)
+  reform_to_resume_ms   ``world_reformed`` → ``elastic_restart``
+                        (checkpoint election + reshard + re-agreement)
+  chain_wall_ms         whole chain, launch to last leg's exit
+
+Honesty: the worlds timeshare the host (CI runs this on a single
+core), so these are END-TO-END wall numbers dominated by process
+launch and XLA compile, useful for DIRECTION (did recovery regress
+10x?) and for the event-order contract, not as interconnect truth.
+The in-scenario linger (``linger_s``, disclosed per row) is harness
+overhead inside detect_to_reform_ms.
+
+Usage:
+    python benchmarks/fleet_chaos_bench.py            # 1 repeat
+    HUNT_FLEET_REPEATS=3 python benchmarks/fleet_chaos_bench.py
+"""
+
+import json
+import os
+import shutil
+import sys
+import tempfile
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from chainermn_tpu.fleet import ChainLeg, ElasticityChain  # noqa: E402
+from chainermn_tpu.utils.benchmarking import protocol_fields  # noqa: E402
+
+LINGER_S = 1.5
+
+
+def run_once(scratch):
+    chain = ElasticityChain(scratch, [
+        ChainLeg(n_procs=8, n_steps=3, wave_at=3, wave_processes=(6, 7),
+                 torn_calls=(1,)),
+        ChainLeg(n_procs=6, n_steps=5),
+    ], budget_s=300, linger_s=LINGER_S)
+    out = chain.run()
+    rep = out["report"]
+    firsts = rep.assert_order("fault_injected", "retry",
+                              "world_reformed", "elastic_reshard",
+                              "elastic_restart")
+    by_kind = {e["kind"]: e for e in firsts}
+    die = min(e["wall"] for e in rep.events("fault_injected")
+              if e["info"].get("fault") == "die")
+    walls = [e["wall"] for e in rep.events()]
+    return {
+        "detect_to_reform_s": by_kind["world_reformed"]["wall"] - die,
+        "reform_to_resume_s": (by_kind["elastic_restart"]["wall"]
+                               - by_kind["world_reformed"]["wall"]),
+        "chain_wall_s": max(walls) - min(walls),
+    }
+
+
+def main():
+    repeats = int(os.environ.get("HUNT_FLEET_REPEATS", "1"))
+    samples = {"detect_to_reform_s": [], "reform_to_resume_s": [],
+               "chain_wall_s": []}
+    for _ in range(repeats):
+        scratch = tempfile.mkdtemp(prefix="fleet_bench_")
+        try:
+            one = run_once(scratch)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+        for k, v in one.items():
+            samples[k].append(v)
+    rows = []
+    for metric, vals in samples.items():
+        row = {
+            "name": f"fleet_recovery.{metric[:-2]}",
+            "unit": "ms",
+            f"{metric[:-2]}_ms": round(min(vals) * 1e3, 1),
+            "n_procs_wave": 8,
+            "n_procs_resume": 6,
+            "linger_s": LINGER_S,
+        }
+        row.update(protocol_fields(vals))
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -40,7 +40,7 @@ def __getattr__(name):
     # Heavier subsystems load lazily to keep import light.
     if name in ("functions", "links", "iterators", "training", "parallel",
                 "models", "ops", "utils", "resilience", "comm_wire",
-                "observability", "serving"):
+                "observability", "serving", "fleet"):
         import importlib
 
         return importlib.import_module(f"chainermn_tpu.{name}")

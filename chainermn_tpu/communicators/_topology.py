@@ -26,6 +26,23 @@ from typing import Any, Sequence
 import numpy as np
 
 
+def _fake_slice_size() -> int:
+    """The ``CHAINERMN_TPU_FAKE_SLICE_SIZE`` knob, parsed once for both
+    consumers (the slice-less ``_node_key`` path and the degenerate
+    multi-process fallback in :meth:`Topology.create`): 0 means
+    disabled (unset, unparseable, or non-positive)."""
+    import os
+
+    fake = os.environ.get("CHAINERMN_TPU_FAKE_SLICE_SIZE")
+    if not fake:
+        return 0
+    try:
+        k = int(fake)
+    except ValueError:
+        return 0
+    return k if k > 0 else 0
+
+
 def _node_key(device: Any) -> Any:
     """Grouping key that plays the role of ChainerMN's hostname.
 
@@ -40,19 +57,12 @@ def _node_key(device: Any) -> Any:
     ``slice_index`` are never regrouped, so the knob cannot mislabel an
     actual TPU topology.
     """
-    import os
-
     slice_index = getattr(device, "slice_index", None)
     if slice_index is not None:
         return ("slice", slice_index)
-    fake = os.environ.get("CHAINERMN_TPU_FAKE_SLICE_SIZE")
-    if fake:
-        try:
-            k = int(fake)
-        except ValueError:
-            k = 0
-        if k > 0:
-            return ("slice", device.id // k)
+    k = _fake_slice_size()
+    if k > 0:
+        return ("slice", device.id // k)
     return ("process", device.process_index)
 
 
@@ -106,7 +116,26 @@ class Topology:
             # ChainerMN's init_ranks did.  Real TPU slices spanning
             # several hosts (platform "tpu") are untouched: a
             # multi-host slice IS one ICI island.
-            keys = [("process", d.process_index) for d in devs]
+            #
+            # CHAINERMN_TPU_FAKE_SLICE_SIZE applies HERE too (fleet
+            # tier): the degenerate claim hid the knob from exactly the
+            # multi-process worlds whose correlated-slice-loss
+            # scenarios need a synthetic grouping — a 16-process world
+            # under FAKE_SLICE_SIZE=4 factorizes into 4 synthetic
+            # slices of 4, so losing "slice 3" is a correlated loss
+            # the topology actually sees.  Grouping is by DENSE
+            # position in the canonical device order, not raw id: the
+            # multi-process CPU backend strides global ids by 2**17
+            # per process, which would put every device in its own
+            # "slice" (the single-process bench path keeps id-based
+            # grouping — its ids are dense and pre-sort order must not
+            # matter there).  Devices carrying a REAL (non-degenerate)
+            # slice layout never reach this branch.
+            k = _fake_slice_size()
+            if k > 0:
+                keys = [("slice", i // k) for i in range(len(devs))]
+            else:
+                keys = [("process", d.process_index) for d in devs]
         unique_keys: list = []
         for k in keys:
             if k not in unique_keys:

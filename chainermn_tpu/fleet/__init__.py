@@ -1,0 +1,55 @@
+"""Fleet chaos tier: simulated 16-64-rank worlds with composable fault
+schedules and elasticity chains.
+
+Every other subsystem's multi-process claims were proven in 2-process
+worlds; this tier is the first whose *subject is the system itself at
+production shape*.  Four pieces:
+
+* :class:`~chainermn_tpu.fleet.world.FleetWorld` — supervised launch of
+  N gloo-CPU ``jax.distributed`` processes over a shared scratch, env
+  wiring for the fault injector's per-process targeting, and a hard
+  wall-clock budget with a loud teardown (every tail quoted) on
+  overrun.
+* :class:`~chainermn_tpu.fleet.schedule.FaultSchedule` — the DSL that
+  composes the existing fault taxonomy into timed waves: preemption
+  waves, correlated synthetic-slice loss, torn agreement payloads,
+  stragglers that migrate between ranks across windows.
+* :class:`~chainermn_tpu.fleet.chain.ElasticityChain` — back-to-back
+  N→M reshards (e.g. 16→12→14) through ``Trainer.run_elastic``, every
+  leg verified against the single-world numpy oracle
+  (:func:`~chainermn_tpu.fleet.chain.momentum_oracle`) and the ZeRO
+  bit-identity contract.
+* :class:`~chainermn_tpu.fleet.report.FleetReport` — every process's
+  telemetry export and resilience log merged into ONE wall-ordered
+  timeline, with :meth:`~chainermn_tpu.fleet.report.FleetReport.
+  assert_order` pinning the detect→retry→reform→reshard→resume story.
+
+See docs/resilience.md ("Fleet chaos tier") and tests/README.md for
+the test-tier split (the 16+-process scenarios are ``slow``; one
+8-process smoke of the same machinery rides tier-1).
+"""
+
+from .chain import ChainLeg, ElasticityChain, momentum_oracle  # noqa: F401
+from .report import FleetReport, export_resilience_log  # noqa: F401
+from .schedule import FaultSchedule  # noqa: F401
+from .world import (  # noqa: F401
+    REAPED,
+    FleetBudgetError,
+    FleetProcResult,
+    FleetResult,
+    FleetWorld,
+)
+
+__all__ = [
+    "ChainLeg",
+    "ElasticityChain",
+    "FaultSchedule",
+    "FleetBudgetError",
+    "FleetProcResult",
+    "FleetReport",
+    "FleetResult",
+    "FleetWorld",
+    "REAPED",
+    "export_resilience_log",
+    "momentum_oracle",
+]

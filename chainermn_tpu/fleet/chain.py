@@ -1,0 +1,203 @@
+"""ElasticityChain: back-to-back N→M reshards under a numpy oracle.
+
+PR 7's elastic tier proved ONE resize (2→1, ``spot_reclaim``).  A
+production fleet reshards repeatedly — a preemption wave shrinks the
+world, capacity comes back, another wave hits — and the claim that
+matters is that the *composition* of reshards stays on the trajectory
+the uninterrupted single world would have produced: the ZeRO blocked
+leaves re-partition bit-identically at every leg, so the chain's final
+params are the oracle's, not "close to" them.
+
+:class:`ElasticityChain` drives that: each :class:`ChainLeg` is one
+:class:`~chainermn_tpu.fleet.world.FleetWorld` launch of the
+``chain_leg`` scenario (``fleet/worker.py``) over one shared scratch —
+the first leg may carry a preemption wave (victims die mid-run, the
+leg's snapshots are what survives), every later leg resumes through
+``Trainer.run_elastic`` at its own world size and must land on
+:func:`momentum_oracle`.  The merged
+:class:`~chainermn_tpu.fleet.report.FleetReport` over the scratch then
+shows the whole detect→retry→reform→reshard→resume story end to end.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
+
+import numpy as np
+
+from .report import FleetReport
+from .schedule import FaultSchedule
+from .world import REAPED, FleetWorld
+
+
+def momentum_oracle(n_steps: int, *, lr: float = 0.1, mom: float = 0.9,
+                    c: float = 0.5, dim: int = 4) -> List[np.ndarray]:
+    """The single-world trajectory: sgd+momentum on grad ``w - c`` from
+    ``w0 = 0``, simulated in numpy with no world at all.  Every chain
+    leg's loss is built so its gradient is exactly this at ANY world
+    size (see ``worker._chain_pieces``), which is what makes one
+    world-free simulation the oracle for every resize point."""
+    w = np.zeros(dim)
+    v = np.zeros(dim)
+    traj = []
+    for _ in range(int(n_steps)):
+        g = w - c
+        v = mom * v + g
+        w = w - lr * v
+        traj.append(w.copy())
+    return traj
+
+
+class ChainLeg(NamedTuple):
+    """One leg: a world size and the (absolute) iteration to reach.
+
+    ``wave_at``/``wave_processes``: a preemption wave — the listed
+    processes die at step ``wave_at`` (only legal on the first leg: a
+    wave mid-chain would be a new chain over the surviving scratch).
+    ``straggler``: ``{"process": k, "delay": s}`` — that process is
+    slow for every step of the leg (resume legs attach a
+    ``MetricsReport`` whose conviction rides back in the payload).
+    ``torn_calls``: agreement-exchange call counts to tear this leg
+    (lockstep-retried by the agreement stack).
+    """
+
+    n_procs: int
+    n_steps: int
+    wave_at: Optional[int] = None
+    wave_processes: Tuple[int, ...] = ()
+    straggler: Optional[dict] = None
+    torn_calls: Tuple[int, ...] = ()
+
+
+class ElasticityChain:
+    """Drive the legs over one scratch; verify each against the oracle.
+
+    ``budget_s`` bounds EACH leg's wall clock (the fleet worlds
+    timeshare the host, so this is a deadlock detector, not a perf
+    assertion).  ``run()`` returns ``{"legs": [per-leg payload dict],
+    "report": FleetReport}``.
+    """
+
+    def __init__(self, scratch: str, legs: Sequence[ChainLeg], *,
+                 lr: float = 0.1, mom: float = 0.9, dim: int = 4,
+                 seed: int = 0, budget_s: float = 300.0,
+                 linger_s: float = 1.5, report_every: int = 1,
+                 exit_code: int = 43):
+        if not legs:
+            raise ValueError("a chain needs at least one leg")
+        for k, leg in enumerate(legs):
+            if leg.wave_at is not None:
+                if k != 0:
+                    raise ValueError(
+                        f"leg {k}: a preemption wave is only legal on "
+                        "the first leg (a mid-chain wave is a new "
+                        "chain over the surviving scratch)"
+                    )
+                if not leg.wave_processes:
+                    raise ValueError("wave_at set but no wave_processes")
+                if 0 in leg.wave_processes:
+                    raise ValueError(
+                        "process 0 hosts the coordination service and "
+                        "cannot be a wave victim (a real scheduler "
+                        "restarts the coordinator host last)"
+                    )
+                if not 1 <= leg.wave_at <= leg.n_steps:
+                    raise ValueError(
+                        f"wave_at {leg.wave_at} outside 1..{leg.n_steps}"
+                    )
+                if max(leg.wave_processes) >= leg.n_procs:
+                    raise ValueError(
+                        f"wave targets {leg.wave_processes} outside the "
+                        f"{leg.n_procs}-process world"
+                    )
+            elif leg.wave_processes:
+                raise ValueError(f"leg {k}: wave_processes without wave_at")
+        self.scratch = str(scratch)
+        self.legs = list(legs)
+        self.lr, self.mom, self.dim = float(lr), float(mom), int(dim)
+        self.seed = int(seed)
+        self.budget_s = float(budget_s)
+        self.linger_s = float(linger_s)
+        self.report_every = int(report_every)
+        self.exit_code = int(exit_code)
+
+    def _schedule_for(self, k: int, leg: ChainLeg,
+                      resumed_from: int) -> FaultSchedule:
+        sched = FaultSchedule(seed=self.seed)
+        if leg.torn_calls:
+            sched.torn_payload(leg.torn_calls)
+        if leg.wave_at is not None:
+            sched.preemption_wave(
+                leg.wave_processes, window=(leg.wave_at, leg.wave_at),
+                exit_code=self.exit_code,
+            )
+        if leg.straggler:
+            # window in per-leg trainer.update calls: every step this
+            # leg will actually run
+            n_calls = max(leg.n_steps - resumed_from, 1)
+            sched.straggler(
+                int(leg.straggler["process"]), window=(1, n_calls),
+                delay=float(leg.straggler.get("delay", 0.25)),
+            )
+        return sched
+
+    def run(self) -> Dict:
+        oracle = momentum_oracle(
+            max(l.n_steps for l in self.legs),
+            lr=self.lr, mom=self.mom, dim=self.dim,
+        )
+        payloads: List[Dict[int, dict]] = []
+        resumed_from = 0
+        prev_world: Optional[int] = None
+        for k, leg in enumerate(self.legs):
+            sched = self._schedule_for(k, leg, resumed_from)
+            world = FleetWorld(
+                leg.n_procs, self.scratch, label=f"leg{k}",
+                schedule=sched, budget_s=self.budget_s,
+            )
+            args = {
+                "n_steps": leg.n_steps, "wave_at": leg.wave_at,
+                "lr": self.lr, "mom": self.mom, "dim": self.dim,
+                "linger_s": self.linger_s,
+                "straggler": bool(leg.straggler),
+                "report_every": self.report_every,
+            }
+            if leg.wave_at is not None:
+                # victims: their injected exit code, exactly; the
+                # survivors publish results BEFORE the wave point and
+                # may then be reaped by the runtime's peer-death
+                # propagation (see worker.scenario_chain_leg)
+                expect = {
+                    p: (self.exit_code if p in leg.wave_processes
+                        else REAPED)
+                    for p in range(leg.n_procs)
+                }
+            else:
+                expect = {}
+            res = world.launch("chain_leg", args, expect_exit=expect)
+            got = res.payloads()
+            if leg.wave_at is not None:
+                for pid, p in got.items():
+                    assert p["steps_saved"] == leg.wave_at - 1, p
+                resumed_from = leg.wave_at - 1
+            else:
+                # a chain may legally START with a plain leg: nothing
+                # to resume yet, and run_elastic records restored_step
+                # None for a fresh scratch
+                want_resumed = resumed_from if resumed_from > 0 else None
+                for pid, p in got.items():
+                    assert p["oracle_match"] is True, (pid, p)
+                    assert p["resumed_step"] == want_resumed, (pid, p)
+                    if prev_world is not None and \
+                            prev_world != leg.n_procs:
+                        assert p["resized"] == [prev_world,
+                                                leg.n_procs], (pid, p)
+                    want_w = float(oracle[leg.n_steps - 1][0])
+                    assert abs(p["final_w"] - want_w) < 1e-4, (pid, p)
+                resumed_from = leg.n_steps
+            payloads.append(got)
+            prev_world = leg.n_procs
+        return {
+            "legs": payloads,
+            "report": FleetReport.from_scratch(self.scratch),
+        }

@@ -1,0 +1,235 @@
+"""FleetReport: one post-mortem timeline for an N-process world.
+
+Every fleet worker leaves two artifacts in the shared scratch:
+
+* ``{label}_p{k}_events.jsonl`` — the streaming resilience sink
+  (:class:`~chainermn_tpu.resilience.log.JsonlFileSink`): every fault,
+  retry, reform, reshard, and restart, flushed per event so even a
+  process that ``os._exit``s inside a ``die`` fault leaves its record;
+  plus ``{label}_p{k}_trainer_events.jsonl``, the post-run export of
+  ``trainer.resilience_log`` (events recorded directly on the trainer
+  log — ``elastic_restart``, ``restart`` — never reach the global sink
+  registry; the overlap between the two files is deduplicated here by
+  the shared event timestamps).
+* ``{label}_p{k}_trace.jsonl`` — the telemetry span timeline, exported
+  with its wall-clock anchor row (``Timeline.to_jsonl(meta=True)``).
+
+:class:`FleetReport` merges every process's artifacts across every leg
+of a scenario into ONE wall-clock-ordered timeline, so a post-mortem
+reads detect→decide→act→recover end to end: the ``die`` fault on leg-0
+process 5, the lockstep retry of the torn agreement payload, the
+re-formed world, the reshard, and the resumed run — in order, each
+stamped with the leg and process it happened on.  :meth:`assert_order`
+is the scenario-facing contract: the first occurrence of each named
+kind must appear, in the given order.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+import re
+from typing import Dict, List, Optional
+
+from ..resilience.log import ResilienceLog, event_row
+
+_EVENTS_RE = re.compile(r"(?P<label>.+)_p(?P<pid>\d+)(?:_trainer)?_events\.jsonl$")
+_TRACE_RE = re.compile(r"(?P<label>.+)_p(?P<pid>\d+)_trace\.jsonl$")
+
+
+def export_resilience_log(log: ResilienceLog, path: str) -> str:
+    """Write a log's events in the JSONL row shape the report parses
+    (the post-run complement of the streaming sink, for events recorded
+    directly on a trainer's log rather than emitted globally)."""
+    d = os.path.dirname(path)
+    if d:
+        os.makedirs(d, exist_ok=True)
+    with open(path, "a", encoding="utf-8") as f:
+        for ev in log:
+            f.write(json.dumps(event_row(ev)) + "\n")
+    return path
+
+
+def _read_jsonl(path: str) -> List[dict]:
+    rows = []
+    try:
+        with open(path, encoding="utf-8") as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    rows.append(json.loads(line))
+                except ValueError:
+                    continue  # torn final line of a killed process
+    except OSError:
+        pass
+    return rows
+
+
+class FleetReport:
+    """Merged, wall-clock-ordered fleet timeline.
+
+    ``entries``: dicts with ``wall`` (float seconds), ``leg`` (the
+    world label), ``process``, ``kind`` (resilience kind, or
+    ``span:<name>`` for telemetry spans), ``site``, ``info``.
+    """
+
+    def __init__(self, entries: List[dict]):
+        self.entries = sorted(entries, key=lambda e: e["wall"])
+
+    # -- construction ---------------------------------------------------
+    @classmethod
+    def from_scratch(cls, scratch: str) -> "FleetReport":
+        """Merge every ``*_events.jsonl`` / ``*_trace.jsonl`` under
+        ``scratch`` (all legs, all processes)."""
+        entries: List[dict] = []
+        seen = set()
+        for path in sorted(glob.glob(
+                os.path.join(scratch, "*_events.jsonl"))):
+            m = _EVENTS_RE.search(os.path.basename(path))
+            if not m:
+                continue
+            label = m.group("label")
+            for row in _read_jsonl(path):
+                if "kind" not in row or "time" not in row:
+                    continue
+                # one event can appear in both the streaming sink and
+                # the trainer-log export (emit fans out to both); the
+                # shared event object means identical timestamps
+                key = (label, row.get("process"), row["kind"],
+                       row.get("site"), round(row.get("monotonic", 0.0), 7))
+                if key in seen:
+                    continue
+                seen.add(key)
+                entries.append({
+                    "wall": float(row["time"]),
+                    "leg": label,
+                    "process": int(row.get("process", 0)),
+                    "kind": row["kind"],
+                    "site": row.get("site"),
+                    "info": row.get("info") or {},
+                })
+        for path in sorted(glob.glob(
+                os.path.join(scratch, "*_trace.jsonl"))):
+            m = _TRACE_RE.search(os.path.basename(path))
+            if not m:
+                continue
+            label = m.group("label")
+            rows = _read_jsonl(path)
+            wall0 = None
+            for row in rows:
+                if row.get("type") == "meta":
+                    wall0 = float(row["args"]["wall0"])
+                    break
+            if wall0 is None:
+                continue  # no anchor: cannot place on the shared clock
+            for row in rows:
+                if row.get("type") != "span":
+                    continue  # resilience instants live in events files
+                entries.append({
+                    "wall": wall0 + float(row["t"]),
+                    "leg": label,
+                    "process": int(row.get("process", 0)),
+                    "kind": f"span:{row['name']}",
+                    "site": None,
+                    "info": dict(row.get("args") or {},
+                                 dur=row.get("dur")),
+                })
+        return cls(entries)
+
+    # -- queries --------------------------------------------------------
+    def events(self, kind: Optional[str] = None) -> List[dict]:
+        if kind is None:
+            return list(self.entries)
+        return [e for e in self.entries if e["kind"] == kind]
+
+    def first(self, kind: str) -> Optional[dict]:
+        for e in self.entries:
+            if e["kind"] == kind:
+                return e
+        return None
+
+    @property
+    def counts(self) -> Dict[str, int]:
+        out: Dict[str, int] = {}
+        for e in self.entries:
+            out[e["kind"]] = out.get(e["kind"], 0) + 1
+        return out
+
+    @property
+    def processes(self) -> Dict[str, List[int]]:
+        """leg label -> sorted process indices that left any record."""
+        out: Dict[str, set] = {}
+        for e in self.entries:
+            out.setdefault(e["leg"], set()).add(e["process"])
+        return {k: sorted(v) for k, v in out.items()}
+
+    # -- contracts ------------------------------------------------------
+    def assert_order(self, *kinds: str) -> List[dict]:
+        """The first occurrence of each kind exists and the sequence is
+        strictly wall-clock-ordered — the detect→decide→act→recover
+        contract (e.g. ``fault_injected``, ``retry``,
+        ``world_reformed``, ``elastic_reshard``, ``elastic_restart``).
+        Returns the matched entries; raises ``AssertionError`` with the
+        rendered post-mortem on any violation."""
+        firsts = []
+        for k in kinds:
+            e = self.first(k)
+            if e is None:
+                raise AssertionError(
+                    f"fleet report: no '{k}' event in the merged "
+                    f"timeline (have {sorted(self.counts)})\n"
+                    + self.post_mortem()
+                )
+            firsts.append(e)
+        for a, b in zip(firsts, firsts[1:]):
+            if not a["wall"] < b["wall"]:
+                raise AssertionError(
+                    f"fleet report: '{a['kind']}' "
+                    f"(leg {a['leg']}, p{a['process']}) does not "
+                    f"precede '{b['kind']}' (leg {b['leg']}, "
+                    f"p{b['process']})\n" + self.post_mortem()
+                )
+        return firsts
+
+    # -- rendering ------------------------------------------------------
+    def post_mortem(self, max_rows: Optional[int] = 120,
+                    include_spans: bool = False) -> str:
+        """The human-readable merged timeline, times relative to the
+        first entry."""
+        rows = [e for e in self.entries
+                if include_spans or not e["kind"].startswith("span:")]
+        if not rows:
+            return "FleetReport(empty)"
+        t0 = rows[0]["wall"]
+        lines = [f"FleetReport: {len(rows)} event(s), "
+                 f"legs {sorted({e['leg'] for e in rows})}"]
+        shown = rows if max_rows is None else rows[:max_rows]
+        for e in shown:
+            info = "".join(
+                f" {k}={v}" for k, v in sorted(e["info"].items())
+                if v is not None
+            )
+            lines.append(
+                f"  +{e['wall'] - t0:8.3f}s {e['leg']}/p{e['process']:<3d} "
+                f"{e['kind']}"
+                + (f" @{e['site']}" if e["site"] else "")
+                + info
+            )
+        if max_rows is not None and len(rows) > max_rows:
+            lines.append(f"  ... {len(rows) - max_rows} more")
+        return "\n".join(lines)
+
+    def to_jsonl(self, path: str) -> str:
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for e in self.entries:
+                f.write(json.dumps(e, default=str) + "\n")
+        return path
+
+    def __len__(self):
+        return len(self.entries)

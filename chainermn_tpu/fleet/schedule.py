@@ -1,0 +1,225 @@
+"""Composable fault schedules for fleet-shaped chaos scenarios.
+
+The fault injector (``resilience.fault_injection``) is deliberately
+low-level: one :class:`~chainermn_tpu.resilience.fault_injection.
+FaultSpec` is one rule at one site.  A fleet scenario needs *waves* —
+"four of these sixteen processes die within this window", "every
+process of slice 2 disappears together", "the straggler migrates from
+rank 3 to rank 9 after the first report window" — and composing those
+by hand into spec lists is exactly the error-prone bookkeeping a chaos
+tier must not leave to each scenario.
+
+:class:`FaultSchedule` is that composition layer.  Every method appends
+specs (and returns ``self``, so schedules chain); :meth:`env` renders
+the whole schedule into the environment contract the injector already
+honors (``CHAINERMN_TPU_FAULTS`` + ``CHAINERMN_TPU_FAULT_SEED``, plus
+``CHAINERMN_TPU_FAKE_SLICE_SIZE`` when a synthetic slice grouping is in
+play) — which is how :class:`~chainermn_tpu.fleet.world.FleetWorld`
+delivers it into spawned workers it cannot reach by object reference.
+
+Timing model: the injector is call-count-addressed, not wall-clock
+addressed (determinism contract — see its module docstring), so a
+schedule "window" is a span of 1-based call counts at a site.  For the
+training scenarios the natural site is ``trainer.update`` (one call per
+step), so windows read as step ranges.
+"""
+
+from __future__ import annotations
+
+import copy
+import json
+from typing import Dict, List, Optional, Sequence
+
+from ..resilience.fault_injection import (
+    ENV_SEED,
+    ENV_SPEC,
+    FaultSpec,
+)
+
+ENV_SLICE = "CHAINERMN_TPU_FAKE_SLICE_SIZE"
+
+# default sites: one trainer.update fire per step (the step clock), the
+# obj-store exchange underneath every agreement (plan/trace/inventory)
+STEP_SITE = "trainer.update"
+AGREEMENT_SITE = "obj_store.exchange"
+
+
+def _check_window(window: Sequence[int]) -> tuple:
+    lo, hi = (int(window[0]), int(window[1]))
+    if lo < 1 or hi < lo:
+        raise ValueError(
+            f"window must be (lo, hi) with 1 <= lo <= hi, got {window!r}"
+        )
+    return lo, hi
+
+
+def _check_processes(processes: Sequence[int]) -> List[int]:
+    procs = [int(p) for p in processes]
+    if not procs:
+        raise ValueError("a wave needs at least one target process")
+    if len(set(procs)) != len(procs):
+        raise ValueError(f"duplicate wave targets: {sorted(procs)}")
+    if min(procs) < 0:
+        raise ValueError(f"negative process index in {sorted(procs)}")
+    return procs
+
+
+class FaultSchedule:
+    """A composable, env-renderable list of fault-injector specs.
+
+    ``seed`` feeds the injector's RNG (probabilistic specs); the
+    deterministic wave/straggler/torn methods below never draw from it,
+    so two schedules built the same way compile to byte-identical env
+    payloads.
+    """
+
+    def __init__(self, seed: int = 0):
+        self.seed = int(seed)
+        self._specs: List[dict] = []
+        self.slice_size: Optional[int] = None
+
+    # -- composition ----------------------------------------------------
+    def fault(self, site: str, kind: str, **kwargs) -> "FaultSchedule":
+        """Raw escape hatch: one FaultSpec, validated eagerly (a typo'd
+        kind must fail at schedule build, not inside a spawned worker
+        where the traceback dies with the process)."""
+        spec = {"site": site, "kind": kind, **kwargs}
+        FaultSpec(**spec)  # validate now
+        self._specs.append(spec)
+        return self
+
+    def preemption_wave(self, processes: Sequence[int], *,
+                        window: Sequence[int], site: str = STEP_SITE,
+                        exit_code: int = 43) -> "FaultSchedule":
+        """``k`` processes die within a call-count window at ``site``.
+
+        Each target is assigned one call in ``[lo, hi]``, spread evenly
+        and deterministically by its position in ``processes`` — a
+        one-call window is a simultaneous wave, a wider window is a
+        rolling reclaim.  Lockstep caveat, by design: once the earliest
+        victim dies, every later step's collectives block on it, so
+        survivors of a rolling wave stall rather than advance — exactly
+        the production behavior (recovery happens at restart, which is
+        the next :class:`~chainermn_tpu.fleet.chain.ElasticityChain`
+        leg's job).
+        """
+        procs = _check_processes(processes)
+        lo, hi = _check_window(window)
+        span = hi - lo + 1
+        for i, p in enumerate(procs):
+            at = lo + (i * span) // len(procs)
+            self.fault(site, "die", at=[at], process=p,
+                       exit_code=exit_code)
+        return self
+
+    def slice_loss(self, slice_index: int, *, slice_size: int,
+                   at: int, site: str = STEP_SITE,
+                   exit_code: int = 44) -> "FaultSchedule":
+        """Correlated loss of one synthetic slice: every process of
+        slice ``slice_index`` (the ``CHAINERMN_TPU_FAKE_SLICE_SIZE``
+        grouping — processes ``[k*size, (k+1)*size)``) dies at the same
+        call.  :meth:`env` exports the slice size so the workers'
+        topology actually factorizes into the slices being lost.
+
+        ``slice_size`` counts PROCESSES.  The topology env knob counts
+        device positions, so :meth:`FleetWorld.env_for` scales the
+        exported value by ``local_devices`` — the two groupings always
+        name the same process sets."""
+        if slice_size < 1:
+            raise ValueError(f"slice_size must be >= 1, got {slice_size}")
+        if self.slice_size is not None and self.slice_size != slice_size:
+            raise ValueError(
+                f"one schedule, one slice grouping: already "
+                f"{self.slice_size}, got {slice_size}"
+            )
+        self.slice_size = int(slice_size)
+        procs = range(slice_index * slice_size,
+                      (slice_index + 1) * slice_size)
+        return self.preemption_wave(list(procs), window=(at, at),
+                                    site=site, exit_code=exit_code)
+
+    def torn_payload(self, calls: Sequence[int] = (1,), *,
+                     truncate_to: int = 4,
+                     site: str = AGREEMENT_SITE,
+                     process: Optional[int] = None) -> "FaultSchedule":
+        """Torn payloads during agreement exchanges (plan / trace /
+        inventory all ride ``obj_store.exchange``): each listed call's
+        payload is truncated, surfacing as ``PayloadCorruptionError`` on
+        every rank in lockstep — the retry path the agreement stack
+        exists to survive.  ``process=None`` tears on every rank (the
+        lockstep case); an int targets one rank's outbound payload."""
+        for c in calls:
+            self.fault(site, "truncate", at=[int(c)],
+                       truncate_to=truncate_to, process=process)
+        return self
+
+    def straggler(self, process: int, *, window: Sequence[int],
+                  delay: float = 0.25,
+                  site: str = STEP_SITE) -> "FaultSchedule":
+        """One process is slow for every step of a window.  Call it
+        again with a different process and a later window to make the
+        straggler *migrate* between ranks — the case the leave-one-out
+        detector must track across report windows."""
+        lo, hi = _check_window(window)
+        self.fault(site, "delay", at=list(range(lo, hi + 1)),
+                   delay=float(delay), process=int(process))
+        return self
+
+    def compose(self, other: "FaultSchedule") -> "FaultSchedule":
+        """A new schedule carrying both spec lists (seed from ``self``;
+        slice groupings must agree — two different synthetic slice
+        sizes cannot coexist in one world)."""
+        if (self.slice_size is not None and other.slice_size is not None
+                and self.slice_size != other.slice_size):
+            raise ValueError(
+                f"cannot compose slice groupings {self.slice_size} and "
+                f"{other.slice_size}"
+            )
+        out = FaultSchedule(seed=self.seed)
+        out._specs = copy.deepcopy(self._specs) + copy.deepcopy(
+            other._specs
+        )
+        out.slice_size = (self.slice_size if self.slice_size is not None
+                          else other.slice_size)
+        return out
+
+    # -- rendering ------------------------------------------------------
+    def specs(self) -> List[dict]:
+        return copy.deepcopy(self._specs)
+
+    def to_faultspecs(self) -> List[FaultSpec]:
+        return [FaultSpec(**d) for d in self._specs]
+
+    def env(self) -> Dict[str, str]:
+        """The env-var rendering the injector's ``_from_env`` consumes
+        in every spawned worker."""
+        out = {
+            ENV_SPEC: json.dumps(self._specs),
+            ENV_SEED: str(self.seed),
+        }
+        if self.slice_size is not None:
+            out[ENV_SLICE] = str(self.slice_size)
+        return out
+
+    def describe(self) -> str:
+        """Human-readable summary (the loud-teardown report and the
+        fleet post-mortem both lead with it)."""
+        if not self._specs:
+            return "FaultSchedule(empty)"
+        lines = [f"FaultSchedule(seed={self.seed}, "
+                 f"{len(self._specs)} spec(s))"]
+        for d in self._specs:
+            proc = ("all processes" if d.get("process") is None
+                    else f"process {d['process']}")
+            lines.append(
+                f"  {d['kind']}@{d['site']} at={sorted(d.get('at', []))} "
+                f"[{proc}]"
+            )
+        return "\n".join(lines)
+
+    def __len__(self):
+        return len(self._specs)
+
+    def __repr__(self):
+        return (f"<FaultSchedule seed={self.seed} n={len(self._specs)} "
+                f"slice_size={self.slice_size}>")

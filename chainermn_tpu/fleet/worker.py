@@ -1,0 +1,462 @@
+"""Fleet worker: one process of a simulated 16-64-rank world.
+
+Spawned by :class:`~chainermn_tpu.fleet.world.FleetWorld` (never by
+hand):
+
+    python -m chainermn_tpu.fleet.worker <scenario> <port> <pid> \
+        <nproc> <scratch> <label> <args_json>
+
+Each worker initializes ``jax.distributed`` against the local
+coordinator on a gloo CPU backend, installs telemetry plus the
+streaming resilience sink (so a process killed by a ``die`` fault still
+leaves its events on disk), runs one scenario, exports its timeline
+with the wall-clock anchor, and prints ``RESULT <json>``.
+
+Scenarios are the fleet tier's reusable building blocks — the
+elasticity-chain leg (:func:`scenario_chain_leg`), the fleet-shaped
+serving churn (:func:`scenario_serving_wave` /
+:func:`scenario_serving_resume`), and the world-formation rendezvous —
+driven by tests and ``benchmarks/fleet_chaos_bench.py`` alike.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import sys
+import time
+
+_CTX: dict = {}
+
+
+def _lockstep_allgather(comm, payload, site: str = "fleet.rendezvous"):
+    """An agreement-shaped exchange: every process unpickles every
+    payload, so a torn payload (or a transient fault) fails — and
+    re-exchanges — on all ranks together, exactly like
+    ``plan_agreement`` / ``newest_common_step``."""
+    from chainermn_tpu.resilience.errors import PayloadCorruptionError
+    from chainermn_tpu.resilience.retry import (
+        RetryPolicy,
+        call_with_retry,
+        is_transient,
+    )
+
+    return call_with_retry(
+        lambda: comm.allgather_obj(payload),
+        site=site,
+        policy=RetryPolicy(max_attempts=4),
+        retryable=lambda e: is_transient(e)
+        or isinstance(e, PayloadCorruptionError),
+    )
+
+
+def _export_artifacts() -> None:
+    """Flush this worker's post-mortem artifacts (idempotent)."""
+    tel = _CTX.get("telemetry")
+    if tel is not None:
+        tel.timeline.to_jsonl(_CTX["trace_path"], meta=True)
+
+
+def finish_and_exit(out: dict, code: int = 0,
+                    linger_s: float = 0.0) -> None:
+    """Survivor epilogue for wave scenarios: export artifacts and print
+    the RESULT payload FIRST (the runtime's peer-death propagation may
+    reap this process at any moment once victims die — paperwork before
+    linger), then optionally linger (keeping the coordinator alive for
+    late victims), then ``os._exit`` — a graceful interpreter exit
+    would hang in ``jax.distributed`` teardown waiting for the wave's
+    victims, exactly like a real preemption (recovery happens at
+    restart, the next leg)."""
+    _export_artifacts()
+    print("RESULT " + json.dumps(out or {}), flush=True)
+    sys.stdout.flush()
+    sys.stderr.flush()
+    if linger_s > 0:
+        time.sleep(linger_s)
+    os._exit(code)
+
+
+# ----------------------------------------------------------------------
+def scenario_rendezvous(pid, nproc, scratch, label, args):
+    """World formation at fleet width: create the communicator, run one
+    lockstep agreement exchange (the schedule may tear it — the retry
+    is the point), and report the injector's observations."""
+    import chainermn_tpu as cmn
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    comm = cmn.create_communicator(args.get("comm", "tpu"))
+    assert comm.process_count == nproc, (comm.process_count, nproc)
+    got = _lockstep_allgather(comm, pid)
+    assert got == list(range(nproc)), got
+    inj = fi.active()
+    counts = dict(inj.log.counts) if inj is not None else {}
+    desc = comm.world_descriptor()
+    return {
+        "size": comm.size,
+        "world": desc["world_size"],
+        "mesh_axes": desc["mesh_axes"],
+        "faults": counts.get("fault_injected", 0),
+    }
+
+
+def scenario_sleep(pid, nproc, scratch, label, args):
+    """Wedge on purpose — the budget-teardown test's subject."""
+    time.sleep(float(args.get("sleep_s", 3600)))
+    return {}
+
+
+# ----------------------------------------------------------------------
+def _chain_pieces(comm, scratch, lr, mom, dim):
+    """One elasticity-chain leg's training pieces: a ZeRO (sgd+momentum)
+    world — momentum state genuinely blocked over the ranks, the state
+    that must reshard N→M — over a loss whose gradient is world-size
+    independent.
+
+    Every process feeds the SAME two local rows {0, 1}: the per-chip
+    batch mean is 0.5 at any world size, so the gradient is elementwise
+    ``w - 0.5`` on every leg of any chain and the single-world numpy
+    oracle (:func:`~chainermn_tpu.fleet.chain.momentum_oracle`) prices
+    the whole trajectory with no replay.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+    import chainermn_tpu as cmn
+    from chainermn_tpu.optimizers import build_train_step
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(lr, momentum=mom), comm, zero_redundancy=True
+    )
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    ckpt = cmn.create_multi_node_checkpointer(
+        "chain", comm, path=os.path.join(scratch, "chain_ckpt")
+    )
+    rows = [np.zeros((dim,), np.float32), np.ones((dim,), np.float32)]
+    return opt, step, ckpt, rows
+
+
+def scenario_chain_leg(pid, nproc, scratch, label, args):
+    """One leg of an elasticity chain (driven by
+    :class:`~chainermn_tpu.fleet.chain.ElasticityChain`).
+
+    Wave leg (``wave_at`` set — chain-initial): rendezvous (the
+    schedule may tear the exchange → lockstep retry), then train and
+    collectively snapshot steps ``1..wave_at-1``, each step checked
+    against the oracle; then fire the wave site — the schedule's
+    victims die there, the survivors linger (so every victim's exit
+    lands while the coordinator still serves) and are reaped with the
+    job, exactly like a real preemption wave.
+
+    Resume leg: ``Trainer.run_elastic`` re-forms the world, restores
+    THROUGH the checkpoint resharder (the elected snapshot's manifest
+    names the previous leg's world), and runs to ``n_steps`` with
+    per-iteration snapshots; the final params must land on the
+    uninterrupted single-world oracle trajectory.
+    """
+    import warnings
+
+    import numpy as np
+    from chainermn_tpu.fleet.chain import momentum_oracle
+    from chainermn_tpu.resilience import fault_injection as fi
+
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+    n_steps = int(args["n_steps"])
+    wave_at = args.get("wave_at")
+    linger = float(args.get("linger_s", 1.5))
+    oracle = momentum_oracle(n_steps, lr=lr, mom=mom, dim=dim)
+
+    if wave_at is not None:
+        # -- wave leg (manual loop: Trainer.run would hang in the wave
+        # step's collective once the first victim dies) --------------
+        import jax.numpy as jnp
+        import chainermn_tpu as cmn
+
+        wave_at = int(wave_at)
+        comm = cmn.create_communicator("tpu")
+        got = _lockstep_allgather(comm, pid)
+        assert got == list(range(nproc)), got
+        opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
+        p0 = {"w": jnp.zeros((dim,))}
+        params, opt_state = step.place(p0, opt.init(p0))
+        batch = np.stack(rows)
+        for s in range(1, wave_at):
+            fi.fire("trainer.update")
+            params, opt_state, _m = step(params, opt_state, batch)
+            ckpt.save(s, {
+                "params": params,
+                "opt_state": opt_state,
+                "trainer": {"iteration": s, "iterator": None},
+            })
+            np.testing.assert_allclose(
+                np.asarray(params["w"]), oracle[s - 1], rtol=1e-5
+            )
+        # Wide-world defect, surfaced by this scenario at 16 processes
+        # and never at 2: the instant the wave's victims die, the
+        # coordination service broadcasts the dead peers and every
+        # SURVIVOR's error-poll thread hard-aborts its own process
+        # (xla's client.h "Terminating process..." path) — racing the
+        # survivor's epilogue.  So the epilogue runs BEFORE the wave
+        # point: artifacts exported, RESULT printed, stdout flushed —
+        # the post-mortem is already safe when the runtime reaps the
+        # survivors, exactly as in a real preemption (the launcher
+        # accepts runtime-reaped survivors for wave legs: see
+        # FleetWorld.REAPED).  The victims' own die records reach disk
+        # through the streaming sink inside fire().
+        _export_artifacts()
+        print("RESULT " + json.dumps({
+            "steps_saved": wave_at - 1,
+            "w": float(np.asarray(params["w"])[0]),
+        }), flush=True)
+        sys.stdout.flush()
+        fi.fire("trainer.update")  # the wave: victims die in here
+        # survivors linger so every victim's exit lands while the
+        # coordinator still serves, then exit hard — the runtime may
+        # reap them first, which is fine: the paperwork is done
+        time.sleep(linger)
+        os._exit(0)
+
+    # -- resume leg ----------------------------------------------------
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    straggler = args.get("straggler")
+    report_holder = {}
+
+    def build(comm):
+        import jax.numpy as jnp
+
+        opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
+        p0 = {"w": jnp.zeros((dim,))}
+        params, opt_state = step.place(p0, opt.init(p0))
+        it = SerialIterator(rows, 2, shuffle=False)
+        trainer = Trainer(Updater(it, step, params, opt_state),
+                          stop_trigger=(n_steps, "iteration"))
+        trainer.extend(ckpt, trigger=(1, "iteration"))
+        if straggler:
+            # per-iteration windows: the first window after a resume is
+            # compile-dominated (its step mean inflates the materiality
+            # floor past any injected delay), so conviction comes from
+            # the later, steady windows — the leg reports the UNION of
+            # flags across windows (read off the straggler events)
+            rep = obs.MetricsReport(
+                comm, trigger=(int(args.get("report_every", 1)),
+                               "iteration"),
+                filename=None,
+            )
+            trainer.extend(rep)
+            report_holder["rep"] = rep
+        return trainer
+
+    with warnings.catch_warnings():
+        # the resharder warns (by design) about reset trainer-template
+        # slots the wave leg's manual saves did not carry
+        warnings.simplefilter("ignore")
+        trainer = Trainer.run_elastic(build, communicator_name="tpu")
+
+    ev = trainer.resilience_log.events("elastic_restart")
+    assert ev, "run_elastic must record its restart"
+    restored = ev[0].info.get("restored_step")
+    resized = ev[0].info.get("resized")
+    assert trainer.iteration == n_steps, trainer.iteration
+    got = np.asarray(trainer.updater.params["w"])
+    ok = bool(np.allclose(got, oracle[n_steps - 1], rtol=1e-5))
+    assert ok, (got, oracle[n_steps - 1])
+    # events recorded directly on the trainer log (elastic_restart,
+    # restart) never reach the global sink — export them for the report
+    from chainermn_tpu.fleet.report import export_resilience_log
+
+    export_resilience_log(
+        trainer.resilience_log,
+        os.path.join(scratch, f"{label}_p{pid}_trainer_events.jsonl"),
+    )
+    stragglers = None
+    if report_holder.get("rep") is not None:
+        stragglers = sorted({
+            int(e.info["process"])
+            for e in trainer.resilience_log.events("straggler")
+        })
+    return {
+        "resumed_step": restored,
+        "resized": list(resized) if resized else None,
+        "oracle_match": ok,
+        "iteration": trainer.iteration,
+        "final_w": float(got[0]),
+        "stragglers": stragglers,
+    }
+
+
+# ----------------------------------------------------------------------
+def _serving_fixture(n_requests: int):
+    """Deterministic tiny LM (same seed on every process → identical
+    params → greedy decode of any request is bit-identical no matter
+    which replica runs it) + the scripted request stream."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    model = TransformerLM(vocab_size=64, d_model=32, n_heads=4,
+                          n_layers=2, max_len=64)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    rng = np.random.RandomState(5)
+    stream = [
+        ("c%d" % i, rng.randint(0, 64, int(rng.randint(3, 10))).tolist(),
+         6)
+        for i in range(n_requests)
+    ]
+    return model, params, stream
+
+
+def _serving_engine(model, params):
+    from chainermn_tpu.serving.decode import DecodeEngine
+
+    return DecodeEngine(model, params, capacity=2, page_size=8)
+
+
+def scenario_serving_wave(pid, nproc, scratch, label, args):
+    """Fleet-shaped serving churn, phase 1: N replicas (>= 4) partition
+    one journaled stream by ``seq % N``; the schedule kills several in
+    ONE wave (process-targeted ``die`` at ``serving.decode_step``).
+    Survivors complete exactly their own shares — verified against the
+    seq-mod contract — and the victims' shares stay journaled."""
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.replica import DecodeReplica, RequestJournal
+
+    n_requests = int(args.get("n_requests", 16))
+    model, params, stream = _serving_fixture(n_requests)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    if pid == 0:
+        journal.submit_all([Request(p, m, id=i) for i, p, m in stream])
+    # journal-level rendezvous (no collectives: a dead peer must never
+    # wedge a survivor)
+    journal.wait_until(len(stream))
+    replica = DecodeReplica(
+        _serving_engine(model, params), journal,
+        replica_index=pid, n_replicas=nproc,
+    )
+    served = replica.serve()  # victims die inside (schedule spec)
+    # the survivor served ITS seq-mod share, whole and nothing else
+    by_id = {r["id"]: r for r in journal.requests()}
+    for rid in served:
+        assert int(by_id[rid]["seq"]) % nproc == pid, (rid, pid)
+    want = {r["id"] for r in by_id.values()
+            if int(r["seq"]) % nproc == pid}
+    assert set(served) == want, (sorted(served), sorted(want))
+    # RESULT before the linger: the survivor may be reaped by the
+    # runtime's peer-death propagation at any point after the kill
+    # (the launcher accepts REAPED for wave survivors)
+    finish_and_exit({"served": sorted(served), "replica": pid},
+                    linger_s=float(args.get("linger_s", 1.5)))
+
+
+def scenario_serving_resume(pid, nproc, scratch, label, args):
+    """Phase 2: the survivors re-form at the new replica count via
+    ``serve_elastic``; the pending partition re-derives over ``seq %
+    n_survivors``, so the dead replicas' shares migrate without
+    coordination, and every journaled request completes bit-identically
+    to a fresh single-engine oracle."""
+    from chainermn_tpu.serving.replica import (
+        RequestJournal,
+        serve_elastic,
+    )
+
+    n_requests = int(args.get("n_requests", 16))
+    model, params, stream = _serving_fixture(n_requests)
+    root = os.path.join(scratch, "serve_journal")
+    journal = RequestJournal(root)
+    pending_before = len(journal.pending())
+    assert pending_before > 0, "phase 1 should have left unserved work"
+    # the re-derived partition this replica is about to claim
+    my_share = {r["id"] for r in journal.pending()
+                if int(r["seq"]) % nproc == pid}
+
+    def build(comm):
+        from chainermn_tpu.serving.replica import DecodeReplica
+
+        return DecodeReplica(
+            _serving_engine(model, params), journal,
+            replica_index=pid, n_replicas=nproc,
+        )
+
+    replica = serve_elastic(
+        build, root, communicator_name="tpu",
+        replica_index=pid, n_replicas=nproc,
+    )
+    served = set(replica.batcher.finished)
+    assert served == my_share, (sorted(served), sorted(my_share))
+    # wait for the OTHER survivors' results before the global checks
+    journal.wait_until_complete(n_requests)
+    results = journal.results()
+    assert sorted(results) == sorted(i for i, _p, _m in stream)
+    oracle_eng = _serving_engine(model, params)
+    mismatches = [
+        rid for rid, prompt, max_new in stream
+        if results[rid]["tokens"] != oracle_eng.generate(prompt, max_new)
+    ]
+    assert not mismatches, mismatches
+    return {
+        "pending_before": pending_before,
+        "completed": len(results),
+        "bit_identical": True,
+        "served": sorted(served),
+    }
+
+
+# ----------------------------------------------------------------------
+def main():
+    scenario, port, pid, nproc, scratch, label, args_json = sys.argv[1:8]
+    pid, nproc = int(pid), int(nproc)
+    args = json.loads(args_json)
+
+    # process-targeted FaultSpec(process=k) resolves the index from this
+    # env var — the launcher sets it, but belt-and-braces for direct use
+    os.environ.setdefault("CHAINERMN_TPU_FAULT_PROCESS_INDEX", str(pid))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    try:
+        # older jax needs gloo selected explicitly for cross-process CPU
+        # collectives; newer releases default to it (or drop the option)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+    except Exception:
+        pass
+    jax.distributed.initialize(
+        f"127.0.0.1:{port}", num_processes=nproc, process_id=pid
+    )
+
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.resilience.log import JsonlFileSink, attach
+
+    tel = obs.Telemetry(label=f"{label}_p{pid}")
+    obs.install(tel)
+    # the streaming sink: every fault/retry/reform/reshard event is on
+    # disk the moment it is emitted, so even a `die` victim's record
+    # survives for the merged FleetReport
+    sink = JsonlFileSink(
+        os.path.join(scratch, f"{label}_p{pid}_events.jsonl")
+    )
+    attach(sink)
+    _CTX.update(
+        telemetry=tel,
+        trace_path=os.path.join(scratch, f"{label}_p{pid}_trace.jsonl"),
+    )
+
+    out = globals()[f"scenario_{scenario}"](pid, nproc, scratch, label,
+                                            args)
+    _export_artifacts()
+    print("RESULT " + json.dumps(out or {}), flush=True)
+
+
+if __name__ == "__main__":
+    main()

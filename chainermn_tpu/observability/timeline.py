@@ -280,15 +280,28 @@ class Timeline:
             json.dump(self.chrome_trace(), f, default=str)
         return path
 
-    def to_jsonl(self, path: str) -> str:
+    def to_jsonl(self, path: str, *, meta: bool = False) -> str:
         """One JSON object per event, sorted by time, timestamps
         relative to ``t0`` in seconds — the grep/diff-friendly export
-        the mp scenarios and ``perf_history`` consume."""
+        the mp scenarios and ``perf_history`` consume.
+
+        ``meta=True`` prepends one ``{"type": "meta", ...}`` row
+        carrying the wall-clock anchor (``wall0``, captured at the same
+        instant as ``t0``): cross-process readers (the fleet tier's
+        merged report) recover each event's approximate wall time as
+        ``wall0 + t``, which is what lets N processes' exports land on
+        one ordered timeline."""
         d = os.path.dirname(path)
         if d:
             os.makedirs(d, exist_ok=True)
         pid = self.process
         with open(path, "w", encoding="utf-8") as f:
+            if meta:
+                f.write(json.dumps({
+                    "type": "meta", "name": "timeline.meta", "t": 0.0,
+                    "process": pid, "tid": 0,
+                    "args": {"wall0": self.wall0, "label": self.label},
+                }) + "\n")
             for e in self.events():
                 row = {
                     "type": e["type"],

@@ -24,6 +24,7 @@ identity when several sinks of the same run are merged.
 
 from __future__ import annotations
 
+import json
 import os
 import time
 from typing import Dict, List, Optional
@@ -111,6 +112,62 @@ class ResilienceLog:
 
     def __iter__(self):
         return iter(self._events)
+
+
+class JsonlFileSink(ResilienceLog):
+    """A :class:`ResilienceLog` that also streams every event to a JSONL
+    file, flushed per event.
+
+    The fleet chaos tier's post-mortem problem: a ``die`` fault records
+    its ``fault_injected`` event and then ``os._exit``s — an in-memory
+    log dies with the process, so the merged fleet timeline would show
+    the *recovery* of a fault that apparently never happened.  Attach
+    one of these (``attach(JsonlFileSink(path))``) and every emitted
+    event is on disk before the next statement runs; the line-oriented
+    append means a process killed mid-write tears at most its final
+    line, which the reader skips.
+
+    Row shape (one JSON object per line): ``kind``, ``site``,
+    ``process``, ``time`` (wall), ``monotonic``, ``info`` (values
+    JSON-safe, ``repr``-fallback) — the contract
+    :class:`~chainermn_tpu.fleet.report.FleetReport` parses.
+    """
+
+    def __init__(self, path: str):
+        super().__init__()
+        self.path = path
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        self._fh = open(path, "a", encoding="utf-8")
+
+    def append(self, ev: ResilienceEvent) -> ResilienceEvent:
+        super().append(ev)
+        self._fh.write(json.dumps(event_row(ev)) + "\n")
+        self._fh.flush()
+        return ev
+
+    def close(self) -> None:
+        if not self._fh.closed:
+            self._fh.close()
+
+
+def event_row(ev: ResilienceEvent) -> dict:
+    """One event as a JSON-safe dict (the JSONL row shape shared by
+    :class:`JsonlFileSink` and the fleet tier's post-run log export)."""
+    info = {
+        k: v if isinstance(v, (int, float, str, bool, type(None)))
+        else repr(v)
+        for k, v in ev.info.items()
+    }
+    return {
+        "kind": ev.kind,
+        "site": ev.site,
+        "process": ev.process,
+        "time": ev.time,
+        "monotonic": ev.monotonic,
+        "info": info,
+    }
 
 
 # -- sink registry ------------------------------------------------------

@@ -119,6 +119,40 @@ class RequestJournal:
         done = self.results()
         return [r for r in self.requests() if r["id"] not in done]
 
+    # -- fleet rendezvous ----------------------------------------------
+    # The journal is the replicas' only shared state, so it is also
+    # their only SAFE rendezvous: polling files can never wedge on a
+    # dead peer the way a collective barrier would — which is exactly
+    # the property a churn scenario needs between waves.
+    def _poll_until(self, getter, n: int, noun: str, timeout_s: float,
+                    poll_s: float):
+        deadline = time.monotonic() + timeout_s
+        while True:
+            got = getter()
+            if len(got) >= n:
+                return got
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"journal {self.root}: {len(got)}/{n} {noun} "
+                    f"after {timeout_s:.0f}s"
+                )
+            time.sleep(poll_s)
+
+    def wait_until(self, n: int, *, timeout_s: float = 60.0,
+                   poll_s: float = 0.05) -> List[dict]:
+        """Block until at least ``n`` requests are journaled; returns
+        them.  Raises ``TimeoutError`` past ``timeout_s``."""
+        return self._poll_until(self.requests, n, "requests",
+                                timeout_s, poll_s)
+
+    def wait_until_complete(self, n: int, *, timeout_s: float = 120.0,
+                            poll_s: float = 0.05) -> dict:
+        """Block until at least ``n`` results exist (how one survivor
+        waits out its peers before whole-stream assertions); returns
+        the results.  Raises ``TimeoutError`` past ``timeout_s``."""
+        return self._poll_until(self.results, n, "results",
+                                timeout_s, poll_s)
+
 
 def claim(requests: Sequence[dict], replica_index: int,
           n_replicas: int) -> List[dict]:

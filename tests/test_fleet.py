@@ -1,0 +1,564 @@
+"""Fleet chaos tier (ISSUE 14) — tier-1 coverage.
+
+Three layers, cheap to expensive:
+
+* **Harness units** (no processes): the ``FaultSchedule`` DSL's
+  compilation/composition/env rendering, ``FleetWorld``'s env wiring,
+  and ``FleetReport``'s merge/dedupe/ordering contracts over
+  synthesized artifacts.
+* **Wide-world units** (no processes): the O(world) paths pinned at
+  N=16/64 against mocked obj stores — ``newest_common_step`` election
+  with a corrupt snapshot and a persistently slow rank, the
+  leave-one-out straggler median with TWO simultaneous stragglers and
+  a migrating one, ``scatter_dataset`` shard balance, and the
+  16→12→14→8 ZeRO block-reshard chain's bit-identity.
+* **One 8-process smoke** (``multiprocess`` mark, hard wall-clock
+  budget — see tests/README.md): a preemption wave + one reshard leg
+  through the real launcher, ending in the merged report's
+  fault→retry→reform→reshard→resume order assertion.  The 16-64-rank
+  scenarios live in test_fleet_chaos.py behind the ``slow`` mark.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from chainermn_tpu.fleet import (
+    ChainLeg,
+    ElasticityChain,
+    FaultSchedule,
+    FleetBudgetError,
+    FleetReport,
+    FleetWorld,
+    momentum_oracle,
+)
+from chainermn_tpu.fleet.schedule import ENV_SLICE
+from chainermn_tpu.resilience.fault_injection import ENV_SPEC, FaultSpec
+
+
+# ----------------------------------------------------------------------
+class TestFaultScheduleDSL:
+    def test_preemption_wave_spreads_deterministically(self):
+        s = FaultSchedule().preemption_wave((3, 5, 9, 11), window=(4, 7))
+        specs = s.specs()
+        assert [d["process"] for d in specs] == [3, 5, 9, 11]
+        assert all(d["kind"] == "die" for d in specs)
+        # evenly spread over the window, deterministic by position
+        assert [d["at"] for d in specs] == [[4], [5], [6], [7]]
+        # byte-identical compilation on a rebuild
+        s2 = FaultSchedule().preemption_wave((3, 5, 9, 11), window=(4, 7))
+        assert s2.env() == s.env()
+
+    def test_one_call_window_is_a_simultaneous_wave(self):
+        s = FaultSchedule().preemption_wave((1, 2), window=(3, 3),
+                                            exit_code=44)
+        assert [d["at"] for d in s.specs()] == [[3], [3]]
+        assert all(d["exit_code"] == 44 for d in s.specs())
+
+    def test_slice_loss_targets_the_whole_slice_and_exports_grouping(self):
+        s = FaultSchedule().slice_loss(1, slice_size=4, at=2)
+        assert [d["process"] for d in s.specs()] == [4, 5, 6, 7]
+        env = s.env()
+        assert env[ENV_SLICE] == "4"
+        # the rendered payload round-trips through the injector's own
+        # constructor (what the spawned worker's _from_env does)
+        specs = [FaultSpec(**d) for d in json.loads(env[ENV_SPEC])]
+        assert all(sp.kind == "die" for sp in specs)
+
+    def test_conflicting_slice_groupings_refused(self):
+        s = FaultSchedule().slice_loss(0, slice_size=4, at=1)
+        with pytest.raises(ValueError, match="one slice grouping"):
+            s.slice_loss(1, slice_size=8, at=2)
+        other = FaultSchedule().slice_loss(0, slice_size=8, at=1)
+        with pytest.raises(ValueError, match="cannot compose"):
+            s.compose(other)
+
+    def test_migrating_straggler_two_windows(self):
+        s = (FaultSchedule()
+             .straggler(3, window=(1, 4), delay=0.2)
+             .straggler(9, window=(5, 8), delay=0.2))
+        specs = s.specs()
+        assert specs[0]["process"] == 3 and specs[0]["at"] == [1, 2, 3, 4]
+        assert specs[1]["process"] == 9 and specs[1]["at"] == [5, 6, 7, 8]
+
+    def test_torn_payload_and_compose(self):
+        a = FaultSchedule().torn_payload(calls=(1, 3), truncate_to=4)
+        b = FaultSchedule().preemption_wave((2,), window=(5, 5))
+        c = a.compose(b)
+        assert len(c) == 3
+        assert [d["kind"] for d in c.specs()] == ["truncate", "truncate",
+                                                  "die"]
+        # composition copies: mutating c never reaches a or b
+        c.straggler(1, window=(1, 1))
+        assert len(a) == 2 and len(b) == 1
+
+    def test_validation_is_eager(self):
+        with pytest.raises(ValueError):
+            FaultSchedule().fault("site", "not_a_kind")
+        with pytest.raises(ValueError, match="window"):
+            FaultSchedule().straggler(0, window=(3, 2))
+        with pytest.raises(ValueError, match="duplicate"):
+            FaultSchedule().preemption_wave((1, 1), window=(1, 1))
+        with pytest.raises(ValueError, match="at least one"):
+            FaultSchedule().preemption_wave((), window=(1, 1))
+
+
+class TestFleetWorldEnvWiring:
+    def test_env_for_wires_schedule_and_targeting(self, tmp_path):
+        sched = FaultSchedule(seed=7).slice_loss(0, slice_size=2, at=1)
+        w = FleetWorld(4, tmp_path, local_devices=2, schedule=sched)
+        env = w.env_for(3)
+        assert env["CHAINERMN_TPU_FAULT_PROCESS_INDEX"] == "3"
+        assert env["CHAINERMN_TPU_FAULT_SEED"] == "7"
+        # 2 processes/slice x 2 devices/process: the exported topology
+        # grouping counts device positions
+        assert env[ENV_SLICE] == "4"
+        assert "device_count=2" in env["XLA_FLAGS"]
+        assert "JAX_PLATFORMS" not in env
+        assert json.loads(env[ENV_SPEC]) == sched.specs()
+
+    def test_slice_grouping_scales_with_local_devices(self, tmp_path):
+        # slice_size counts PROCESSES; the topology env knob counts
+        # device positions — env_for reconciles the units so both
+        # groupings always name the same process sets
+        sched = FaultSchedule().slice_loss(0, slice_size=2, at=1)
+        w = FleetWorld(8, tmp_path, local_devices=2, schedule=sched)
+        assert w.env_for(0)[ENV_SLICE] == "4"
+        # one device per process: exported verbatim
+        w1 = FleetWorld(8, tmp_path, schedule=sched)
+        assert w1.env_for(0)[ENV_SLICE] == "2"
+
+    def test_rejects_empty_world(self, tmp_path):
+        with pytest.raises(ValueError):
+            FleetWorld(0, tmp_path)
+
+
+# ----------------------------------------------------------------------
+# wide-world unit coverage (satellites): the O(world) paths at N=64,
+# no processes
+# ----------------------------------------------------------------------
+class _WideObjComm:
+    """A mocked 64-process obj store for the election paths: this rank's
+    inventory is live, the other 63 are scripted; the first
+    ``flaky_attempts`` exchanges fail the way a persistently slow (or
+    torn) rank fails, exercising the lockstep retry."""
+
+    def __init__(self, peer_inventories, process_index=0,
+                 flaky_attempts=0, flaky_exc=None):
+        from chainermn_tpu.resilience.errors import TransientCommError
+
+        self.process_count = len(peer_inventories) + 1
+        self.process_index = process_index
+        self.size = self.process_count
+        self._peers = peer_inventories
+        self._flaky = flaky_attempts
+        self._exc = flaky_exc or TransientCommError(
+            "rank 7 persistently slow: exchange deadline exceeded",
+            site="obj_store.exchange",
+        )
+        self.exchanges = 0
+
+    def allgather_obj(self, local):
+        self.exchanges += 1
+        if self._flaky > 0:
+            self._flaky -= 1
+            raise self._exc
+        out = list(self._peers)
+        out.insert(self.process_index, local)
+        return out
+
+
+def _local_steps(ckpt, steps, corrupt=()):
+    """Materialize npz-tier snapshots on this rank's disk; ``corrupt``
+    steps get a manifest whose digest can never match (the torn-write
+    case the inventory must exclude)."""
+    from chainermn_tpu.resilience import elastic
+
+    for s in steps:
+        d = ckpt._step_dir(s)
+        os.makedirs(d, exist_ok=True)
+        if s in corrupt:
+            with open(os.path.join(d, "state.npz"), "wb") as f:
+                f.write(b"torn")
+            elastic.write_manifest(
+                {"format": 1, "world_size": 64,
+                 "files": {"state.npz": {"bytes": 4, "sha256": "0" * 64}}},
+                os.path.join(d, elastic.MANIFEST_NAME),
+            )
+
+
+class TestWideWorldElection:
+    """Satellite: ``newest_common_step`` + the lockstep-retried
+    inventory allgather at N=64 (scenario shape: one rank holds a
+    corrupt snapshot, one rank is persistently slow)."""
+
+    def _ckpt(self, tmp_path, comm):
+        from chainermn_tpu.extensions.checkpoint import (
+            _MultiNodeCheckpointer,
+        )
+
+        return _MultiNodeCheckpointer(
+            "wide", comm, path=str(tmp_path), use_orbax=False
+        )
+
+    def test_corrupt_snapshot_excluded_and_election_degrades(
+        self, tmp_path
+    ):
+        # 63 peers all hold {1, 2, 3}; THIS rank's step 3 is torn, so
+        # its inventory is {1, 2} and the 64-way election must land on
+        # 2 — not raise at load time on the corrupt 3
+        comm = _WideObjComm([[1, 2, 3]] * 63)
+        ckpt = self._ckpt(tmp_path, comm)
+        _local_steps(ckpt, (1, 2, 3), corrupt=(3,))
+        assert ckpt._available_steps() == [1, 2]
+        assert ckpt.newest_common_step() == 2
+
+    def test_persistently_slow_rank_retried_in_lockstep(self, tmp_path):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        comm = _WideObjComm([[1, 2]] * 63, flaky_attempts=2)
+        ckpt = self._ckpt(tmp_path, comm)
+        _local_steps(ckpt, (1, 2))
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            assert ckpt.newest_common_step() == 2
+        finally:
+            detach(slog)
+        # two failed exchanges, each retried, third succeeds
+        assert slog.counts.get("retry") == 2
+        assert comm.exchanges == 3
+
+    def test_torn_inventory_payload_retried(self, tmp_path):
+        from chainermn_tpu.resilience.errors import PayloadCorruptionError
+
+        comm = _WideObjComm(
+            [[5]] * 63, flaky_attempts=1,
+            flaky_exc=PayloadCorruptionError(
+                "inventory payload failed to unpickle",
+                site="obj_store.exchange",
+            ),
+        )
+        ckpt = self._ckpt(tmp_path, comm)
+        _local_steps(ckpt, (5,))
+        assert ckpt.newest_common_step() == 5
+        assert comm.exchanges == 2
+
+    def test_one_empty_rank_elects_nothing(self, tmp_path):
+        # a freshly joined rank with no snapshots: the 64-way common
+        # set is empty and the election answers None (resume from
+        # scratch), not a crash
+        comm = _WideObjComm([[1, 2, 3]] * 62 + [[]])
+        ckpt = self._ckpt(tmp_path, comm)
+        _local_steps(ckpt, (1, 2, 3))
+        assert ckpt.newest_common_step() is None
+
+
+class _FakeTrainer:
+    iteration = 16
+
+
+def _phase_data(n, stragglers, *, straggler_host=0.3, healthy_host=0.01,
+                step=1.0):
+    by_proc = {}
+    for p in range(n):
+        host = straggler_host if p in stragglers else healthy_host
+        by_proc[p] = {
+            "process": p,
+            "phases": {
+                "step": [step] * 3,
+                "update.host": [host] * 3,
+            },
+        }
+    return by_proc
+
+
+class TestWideStragglers:
+    """Satellite: the leave-one-out straggler median at N=16/64 with
+    TWO simultaneous stragglers, plus migration between windows."""
+
+    def _report(self):
+        from chainermn_tpu.observability import MetricsReport
+
+        return MetricsReport(None, filename=None)
+
+    @pytest.mark.parametrize("n", [16, 64])
+    def test_two_simultaneous_stragglers_both_convicted(self, n):
+        rep = self._report()
+        rep._flag_stragglers(_phase_data(n, {3, 9}), _FakeTrainer())
+        assert rep.straggler_processes == [3, 9]
+
+    @pytest.mark.parametrize("n", [16, 64])
+    def test_no_false_positives_on_healthy_world(self, n):
+        rep = self._report()
+        rep._flag_stragglers(_phase_data(n, set()), _FakeTrainer())
+        assert rep.straggler_processes == []
+
+    def test_straggler_migrates_between_windows(self):
+        # window 1 convicts rank 3; window 2 (fresh samples — the
+        # incremental-window contract) convicts rank 9 and NOT the
+        # recovered rank 3
+        rep = self._report()
+        rep._flag_stragglers(_phase_data(16, {3}), _FakeTrainer())
+        assert rep.straggler_processes == [3]
+        rep._flag_stragglers(_phase_data(16, {9}), _FakeTrainer())
+        assert rep.straggler_processes == [9]
+
+    def test_materiality_floor_holds_at_64(self):
+        # a "straggler" whose host phase is noise (way below the 5%
+        # step floor) must not be convicted, even at ratio 30x
+        rep = self._report()
+        by_proc = _phase_data(64, {5}, straggler_host=0.03,
+                              healthy_host=0.001, step=10.0)
+        rep._flag_stragglers(by_proc, _FakeTrainer())
+        assert rep.straggler_processes == []
+
+
+class TestScatterShardBalance64:
+    """Satellite: ``scatter_dataset`` shard balance at N=64 — the
+    substrate a straggler-adaptive rebalance will skew."""
+
+    def test_remainder_distribution_pattern_pinned(self):
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 1000, 64  # 1000 = 64*15 + 40
+        sizes, covered = [], []
+        for r in range(size):
+            order, start, end = scatter_index(n, size, r, equalize=False)
+            sizes.append(end - start)
+            covered.extend(order[start:end])
+        # the first `rem` ranks absorb the remainder, one sample each
+        assert sizes == [16] * 40 + [15] * 24
+        # disjoint exact cover
+        assert sorted(covered) == list(range(n))
+
+    def test_equalized_shards_wrap_and_stay_balanced(self):
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 1000, 64
+        sizes, covered = [], []
+        for r in range(size):
+            order, start, end = scatter_index(n, size, r, equalize=True)
+            sizes.append(end - start)
+            covered.extend(order[start:end])
+        # every rank steps the same number of times per epoch
+        assert sizes == [16] * 64
+        counts = np.bincount(np.asarray(covered), minlength=n)
+        # the wrap-around pad re-serves exactly the first 24 samples
+        assert list(counts[:24]) == [2] * 24
+        assert list(counts[24:]) == [1] * (n - 24)
+
+
+class TestChainReshardBitIdentity:
+    """Satellite/tentpole contract: the 16→12→14→8 ZeRO block-reshard
+    CHAIN is bit-identical to a fresh partition of the global state at
+    every leg — composition introduces no drift."""
+
+    @staticmethod
+    def _fresh(flat, world):
+        k = -(-flat.size // world)  # ceil
+        out = np.zeros(world * k, flat.dtype)
+        out[: flat.size] = flat
+        return out.reshape(world, k)
+
+    def test_chain_16_12_14_8_bit_identical_at_every_leg(self):
+        from chainermn_tpu.resilience.elastic import reshard_blocked_leaf
+
+        rng = np.random.RandomState(0)
+        flat = rng.randn(1003).astype(np.float32)  # indivisible on purpose
+        state = self._fresh(flat, 16)
+        for world in (12, 14, 8):
+            want = self._fresh(flat, world)
+            state = reshard_blocked_leaf(state, want.shape)
+            np.testing.assert_array_equal(state, want)
+
+    def test_momentum_oracle_matches_closed_form_sgd(self):
+        # mom=0 collapses to plain sgd's closed form — the oracle's own
+        # sanity pin
+        traj = momentum_oracle(5, lr=0.1, mom=0.0, c=0.5, dim=3)
+        for k, w in enumerate(traj, start=1):
+            np.testing.assert_allclose(
+                w, 0.5 * (1 - 0.9 ** k) * np.ones(3), rtol=1e-12
+            )
+
+
+# ----------------------------------------------------------------------
+class TestFleetReportMerge:
+    def _write_events(self, path, rows):
+        with open(path, "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def _ev(self, kind, t, process=0, site="s", **info):
+        return {"kind": kind, "site": site, "process": process,
+                "time": t, "monotonic": t, "info": info}
+
+    def test_merge_orders_across_legs_and_processes(self, tmp_path):
+        self._write_events(tmp_path / "leg0_p1_events.jsonl", [
+            self._ev("fault_injected", 10.0, process=1, fault="die"),
+        ])
+        self._write_events(tmp_path / "leg1_p0_events.jsonl", [
+            self._ev("world_reformed", 20.0),
+            self._ev("elastic_reshard", 21.0),
+        ])
+        self._write_events(tmp_path / "leg1_p0_trainer_events.jsonl", [
+            self._ev("elastic_reshard", 21.0),  # duplicate: deduped
+            self._ev("elastic_restart", 22.0),
+        ])
+        rep = FleetReport.from_scratch(tmp_path)
+        assert rep.counts == {
+            "fault_injected": 1, "world_reformed": 1,
+            "elastic_reshard": 1, "elastic_restart": 1,
+        }
+        rep.assert_order("fault_injected", "world_reformed",
+                         "elastic_reshard", "elastic_restart")
+        assert rep.processes == {"leg0": [1], "leg1": [0]}
+
+    def test_order_violation_raises_with_post_mortem(self, tmp_path):
+        self._write_events(tmp_path / "leg0_p0_events.jsonl", [
+            self._ev("world_reformed", 5.0),
+            self._ev("fault_injected", 9.0),
+        ])
+        rep = FleetReport.from_scratch(tmp_path)
+        with pytest.raises(AssertionError, match="does not precede"):
+            rep.assert_order("fault_injected", "world_reformed")
+        with pytest.raises(AssertionError, match="no 'retry' event"):
+            rep.assert_order("retry")
+
+    def test_trace_spans_anchor_on_wall0_and_torn_tail_skipped(
+        self, tmp_path
+    ):
+        with open(tmp_path / "leg0_p0_trace.jsonl", "w") as f:
+            f.write(json.dumps({
+                "type": "meta", "name": "timeline.meta", "t": 0.0,
+                "process": 0, "tid": 0, "args": {"wall0": 100.0},
+            }) + "\n")
+            f.write(json.dumps({
+                "type": "span", "name": "step", "t": 2.5, "dur": 0.1,
+                "process": 0, "tid": 0, "args": {},
+            }) + "\n")
+            f.write('{"type": "span", "name": "torn')  # killed mid-write
+        self._write_events(tmp_path / "leg0_p0_events.jsonl", [
+            self._ev("fault_injected", 101.0),
+        ])
+        rep = FleetReport.from_scratch(tmp_path)
+        spans = rep.events("span:step")
+        assert len(spans) == 1 and spans[0]["wall"] == 102.5
+        # the span slots in between on the shared wall clock
+        rep.assert_order("fault_injected", "span:step")
+
+    def test_timeline_meta_row_export(self, tmp_path):
+        from chainermn_tpu.observability.timeline import Timeline
+
+        tl = Timeline(label="x")
+        with tl.span("work"):
+            pass
+        path = tl.to_jsonl(str(tmp_path / "t.jsonl"), meta=True)
+        rows = [json.loads(l) for l in open(path)]
+        assert rows[0]["type"] == "meta"
+        assert rows[0]["args"]["wall0"] == tl.wall0
+        assert [r["name"] for r in rows[1:]] == ["work"]
+        # default export unchanged: no meta row
+        path2 = tl.to_jsonl(str(tmp_path / "t2.jsonl"))
+        rows2 = [json.loads(l) for l in open(path2)]
+        assert all(r["type"] != "meta" for r in rows2)
+
+
+class TestStreamingSink:
+    def test_events_flushed_per_emit(self, tmp_path):
+        from chainermn_tpu.resilience.log import (
+            JsonlFileSink, attach, detach, emit,
+        )
+
+        sink = JsonlFileSink(str(tmp_path / "ev.jsonl"))
+        attach(sink)
+        try:
+            emit("fault_injected", "site.a", fault="die", call=3)
+            # on disk BEFORE any close/flush call — the os._exit case
+            rows = [json.loads(l) for l in open(tmp_path / "ev.jsonl")]
+        finally:
+            detach(sink)
+            sink.close()
+        assert len(rows) == 1
+        assert rows[0]["kind"] == "fault_injected"
+        assert rows[0]["info"] == {"fault": "die", "call": 3}
+        assert "monotonic" in rows[0] and "time" in rows[0]
+        # the sink is still a queryable ResilienceLog
+        assert sink.counts == {"fault_injected": 1}
+
+
+# ----------------------------------------------------------------------
+# process-spawning tier-1 pieces: the budget teardown and the 8-proc
+# smoke of the full machinery (the 16+-rank worlds are `slow`)
+# ----------------------------------------------------------------------
+# hard wall-clock budget for the tier-1 smoke, documented in
+# tests/README.md — the budget is a deadlock detector on a timeshared
+# host, not a perf assertion
+SMOKE_BUDGET_S = 240
+
+
+@pytest.mark.multiprocess
+class TestFleetWorldBudget:
+    def test_overrun_tears_down_loudly(self, tmp_path):
+        # the sleep scenario wedges unconditionally, so ANY budget
+        # catches it — a small one keeps this tier-1 test cheap
+        w = FleetWorld(1, tmp_path, budget_s=5, label="wedge")
+        with pytest.raises(FleetBudgetError) as ei:
+            w.launch("sleep", {"sleep_s": 3600})
+        msg = str(ei.value)
+        assert "exceeded its 5s wall-clock budget" in msg
+        assert "process 0" in msg  # the tail is quoted
+
+
+@pytest.mark.multiprocess
+class TestFleetSmoke8:
+    def test_wave_plus_reshard_8_to_6_on_oracle(self, tmp_path):
+        """The tier-1 smoke of the full fleet machinery (ISSUE 14
+        acceptance, 8-process shape): a torn rendezvous payload
+        (lockstep-retried), a preemption wave killing processes 6 and 7
+        at step 3, and one elasticity-chain leg resuming at world 6
+        through the checkpoint resharder onto the single-world numpy
+        oracle — with the merged FleetReport asserting the
+        fault→retry→reform→reshard→resume event order.
+
+        Also the regression test for the wide-world defect this
+        scenario surfaced at 16 processes (and 2-process worlds never
+        lost): the coordination service's peer-death propagation
+        hard-aborts the wave's SURVIVORS, racing their epilogue.  The
+        fix is epilogue-before-wave (worker.scenario_chain_leg) +
+        REAPED acceptance (world.assert_ok) — every survivor's RESULT
+        payload and streamed artifacts must exist despite any reap,
+        and the resume leg must still find all of leg0's snapshots."""
+        chain = ElasticityChain(str(tmp_path), [
+            ChainLeg(n_procs=8, n_steps=3, wave_at=3,
+                     wave_processes=(6, 7), torn_calls=(1,)),
+            ChainLeg(n_procs=6, n_steps=5),
+        ], budget_s=SMOKE_BUDGET_S)
+        out = chain.run()
+        legs = out["legs"]
+        # every leg-0 process published its payload BEFORE the wave —
+        # victims included (their RESULT precedes their die)
+        assert sorted(legs[0]) == list(range(8))
+        assert all(p["steps_saved"] == 2 for p in legs[0].values())
+        assert sorted(legs[1]) == [0, 1, 2, 3, 4, 5]
+        for p in legs[1].values():
+            assert p["oracle_match"] is True
+            assert p["resumed_step"] == 2
+            assert p["resized"] == [8, 6]
+            assert p["iteration"] == 5
+        rep = out["report"]
+        rep.assert_order("fault_injected", "retry", "world_reformed",
+                         "elastic_reshard", "elastic_restart")
+        # the wave's victims left their die records via the streaming
+        # sink despite os._exit
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        assert sorted(e["process"] for e in dies) == [6, 7]
+        assert all(e["leg"] == "leg0" for e in dies)
+        # every leg-1 process re-agreed and resumed
+        restarts = rep.events("elastic_restart")
+        assert sorted(e["process"] for e in restarts) == [0, 1, 2, 3, 4, 5]

@@ -1,0 +1,189 @@
+"""Fleet chaos tier (ISSUE 14) — the 16-64-rank worlds.
+
+These are the production-shape scenarios: real ``jax.distributed``
+worlds of 16+ gloo-CPU processes driven through composed fault
+schedules and elasticity chains.  They are ``slow`` (excluded from
+tier-1 by ``-m 'not slow'`` — see tests/README.md for the tier split);
+the 8-process smoke of the same machinery rides tier-1 in
+test_fleet.py.
+
+Run just these:   pytest -m slow tests/test_fleet_chaos.py
+"""
+
+import pytest
+
+from chainermn_tpu.fleet import (
+    REAPED,
+    ChainLeg,
+    ElasticityChain,
+    FaultSchedule,
+    FleetReport,
+    FleetWorld,
+)
+
+pytestmark = [pytest.mark.multiprocess, pytest.mark.slow]
+
+
+class TestAcceptanceChain:
+    def test_wave_plus_two_leg_chain_16_12_14(self, tmp_path):
+        """ISSUE 14 acceptance: a 16-process world takes a torn
+        rendezvous payload (lockstep-retried) and a preemption wave
+        killing 4 processes at step 4; the chain then reshards
+        16→12→14 through ``Trainer.run_elastic``, every leg landing on
+        the single-world numpy oracle trajectory (ZeRO momentum blocks
+        re-partitioned bit-identically at each leg), with a straggler
+        that MIGRATES between ranks across legs (2 → 5) convicted by
+        the leave-one-out median on every rank of each world; the
+        merged FleetReport asserts the
+        fault→retry→reform→reshard→resume event order end to end."""
+        chain = ElasticityChain(str(tmp_path), [
+            ChainLeg(n_procs=16, n_steps=4, wave_at=4,
+                     wave_processes=(12, 13, 14, 15), torn_calls=(1,)),
+            ChainLeg(n_procs=12, n_steps=6,
+                     straggler={"process": 2, "delay": 0.6}),
+            ChainLeg(n_procs=14, n_steps=9,
+                     straggler={"process": 5, "delay": 0.6}),
+        ], budget_s=600)
+        out = chain.run()
+        legs = out["legs"]
+        # every leg-0 process published steps_saved before the wave
+        assert sorted(legs[0]) == list(range(16))
+        assert all(p["steps_saved"] == 3 for p in legs[0].values())
+        # leg 1: 16→12, oracle, straggler 2 convicted everywhere
+        for p in legs[1].values():
+            assert p["resized"] == [16, 12]
+            assert p["oracle_match"] is True
+            assert p["stragglers"] == [2]
+        # leg 2: 12→14 (a GROWING world reshards too), migrated
+        # straggler convicted
+        for p in legs[2].values():
+            assert p["resized"] == [12, 14]
+            assert p["oracle_match"] is True
+            assert p["stragglers"] == [5]
+        rep = out["report"]
+        firsts = rep.assert_order(
+            "fault_injected", "retry", "world_reformed",
+            "elastic_reshard", "elastic_restart",
+        )
+        assert firsts[0]["leg"] == "leg0"
+        # the wave victims' die records survived os._exit (streaming
+        # sink) — and a die fault precedes the re-formation
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        assert sorted(e["process"] for e in dies) == [12, 13, 14, 15]
+        reform = rep.first("world_reformed")
+        assert all(e["wall"] < reform["wall"] for e in dies)
+        # straggler migration is visible in the merged timeline
+        flagged = [(e["leg"], e["info"].get("process"))
+                   for e in rep.events("straggler")]
+        assert {("leg1", 2), ("leg2", 5)} <= set(flagged)
+        assert ("leg1", 5) not in set(flagged)
+        assert ("leg2", 2) not in set(flagged)
+
+
+class TestCorrelatedSliceLoss:
+    def test_slice_loss_16_procs_4_slices(self, tmp_path):
+        """Correlated slice loss: 16 processes grouped into 4 synthetic
+        slices (CHAINERMN_TPU_FAKE_SLICE_SIZE=4, exported by the
+        schedule); every process of slice 3 dies at step 2 in one
+        correlated wave; the survivors' snapshots carry the world
+        manifest and the restart at 12 reshards onto the oracle."""
+        sched = FaultSchedule().slice_loss(3, slice_size=4, at=2,
+                                           exit_code=43)
+        assert [d["process"] for d in sched.specs()] == [12, 13, 14, 15]
+        world = FleetWorld(16, str(tmp_path), schedule=sched,
+                           budget_s=600, label="leg0")
+        args = {"n_steps": 2, "wave_at": 2, "lr": 0.1, "mom": 0.9,
+                "dim": 4, "linger_s": 1.5, "straggler": False,
+                "report_every": 1}
+        res = world.launch("chain_leg", args, expect_exit={
+            p: (43 if p in (12, 13, 14, 15) else REAPED)
+            for p in range(16)
+        })
+        payloads = res.payloads()
+        assert all(p["steps_saved"] == 1 for p in payloads.values())
+        # the workers' topology actually factorized into the synthetic
+        # slices being lost (mn_inter = 4 slices x mn_intra 4): a
+        # hierarchical probe world under the same schedule env
+        probe = FleetWorld(16, str(tmp_path / "probe"), schedule=sched,
+                           budget_s=600, label="probe")
+        pres = probe.launch("rendezvous", {"comm": "hierarchical"},
+                            expect_exit={})
+        for p in pres.payloads().values():
+            assert p["mesh_axes"] == {"mn_inter": 4, "mn_intra": 4}
+        # run B: the survivors reshard 16 -> 12 and land on the oracle
+        res2 = FleetWorld(12, str(tmp_path), budget_s=600,
+                          label="leg1").launch(
+            "chain_leg",
+            dict(args, n_steps=4, wave_at=None), expect_exit={})
+        for p in res2.payloads().values():
+            assert p["resized"] == [16, 12]
+            assert p["oracle_match"] is True
+        rep = FleetReport.from_scratch(str(tmp_path))
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        # one CORRELATED wave: all four victims at the same step site
+        assert sorted(e["process"] for e in dies) == [12, 13, 14, 15]
+        assert {e["info"].get("call") for e in dies} == {2}
+
+
+class TestServingChurnFleet:
+    def test_4_replicas_2_killed_in_one_wave(self, tmp_path):
+        """Fleet-shaped serving churn (tentpole satellite): 4 decode
+        replicas partition a 16-request journal by ``seq % 4``; ONE
+        wave kills replicas 1 and 2 at their 3rd decode step.  The
+        survivors complete exactly their own shares; the 2-survivor
+        phase re-claims the dead replicas' shares by ``seq % 2`` and
+        completes every request bit-identically to a fresh oracle
+        engine (asserted in-scenario)."""
+        sched = FaultSchedule().preemption_wave(
+            (1, 2), window=(3, 3), site="serving.decode_step")
+        w1 = FleetWorld(4, str(tmp_path), schedule=sched, budget_s=420,
+                        label="serve0")
+        # survivors may be signal-reaped after publishing their RESULT
+        # (peer-death propagation) — the REAPED contract, as in the
+        # chain's wave legs
+        res1 = w1.launch("serving_wave", {"n_requests": 16},
+                         expect_exit={0: REAPED, 1: 43, 2: 43,
+                                      3: REAPED})
+        p1 = res1.payloads()
+        # seq-mod claiming verified: each survivor served its whole
+        # share and nothing else (also asserted in-scenario)
+        assert p1[0]["served"] == ["c0", "c12", "c4", "c8"]
+        assert p1[3]["served"] == ["c11", "c15", "c3", "c7"]
+        w2 = FleetWorld(2, str(tmp_path), budget_s=420, label="serve1")
+        res2 = w2.launch("serving_resume", {"n_requests": 16},
+                         expect_exit={})
+        p2 = res2.payloads()
+        for pid, p in p2.items():
+            assert p["completed"] == 16
+            assert p["pending_before"] == 8  # the dead replicas' shares
+            assert p["bit_identical"] is True
+        # the migrated partition re-derived over seq % 2
+        assert p2[0]["served"] == ["c10", "c14", "c2", "c6"]
+        assert p2[1]["served"] == ["c1", "c13", "c5", "c9"]
+        rep = FleetReport.from_scratch(str(tmp_path))
+        rep.assert_order("fault_injected", "world_reformed")
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        assert sorted(e["process"] for e in dies) == [1, 2]
+
+
+class TestWideWorldFormation:
+    @pytest.mark.parametrize("n", [32, 64])
+    def test_rendezvous_with_torn_agreement(self, n, tmp_path):
+        """World formation at the tier's design widths: N gloo
+        processes form one world, every rank's FIRST agreement exchange
+        ships a torn payload, and the lockstep retry completes the
+        rendezvous on all N ranks."""
+        sched = FaultSchedule().torn_payload(calls=(1,))
+        w = FleetWorld(n, str(tmp_path), schedule=sched, budget_s=900,
+                       label=f"w{n}")
+        res = w.launch("rendezvous", expect_exit={})
+        payloads = res.payloads()
+        assert sorted(payloads) == list(range(n))
+        assert all(p["size"] == n for p in payloads.values())
+        assert all(p["faults"] >= 1 for p in payloads.values())
+        rep = FleetReport.from_scratch(str(tmp_path))
+        rep.assert_order("fault_injected", "retry")
+        assert len(rep.events("retry")) >= n

@@ -102,6 +102,56 @@ class TestTopologyFromSlices:
             topo.device_grid()
 
 
+class TestFakeSliceGroupingMultiprocess:
+    """Fleet-tier regression (surfaced by test_fleet_chaos.py's
+    ``test_slice_loss_16_procs_4_slices`` scenario): the multi-process
+    CPU backend's degenerate ``slice_index=0`` claim routed every
+    gloo world around the ``CHAINERMN_TPU_FAKE_SLICE_SIZE`` grouping —
+    the knob only engaged when ``slice_index`` was absent — so exactly
+    the worlds whose correlated-slice-loss scenarios need a synthetic
+    slice topology could never factorize into it.  The degenerate-claim
+    fallback now honors the knob before degrading to per-process
+    grouping."""
+
+    def _world(self, n=16):
+        # a gloo-CPU fleet world: every device claims slice 0, one
+        # device per process — with the backend's REAL id layout
+        # (global ids stride 2**17 per process, so any id-based
+        # grouping degenerates; the rule must group by canonical
+        # position)
+        return [
+            FakeTpuDevice(i << 17, slice_index=0, coords=(i, 0, 0),
+                          process_index=i)
+            for i in range(n)
+        ]
+
+    def test_fake_slices_group_degenerate_multiprocess_world(
+        self, monkeypatch
+    ):
+        monkeypatch.setenv("CHAINERMN_TPU_FAKE_SLICE_SIZE", "4")
+        topo = Topology.create(self._world())
+        assert topo.inter_size == 4
+        assert set(topo.intra_sizes) == {4}
+        # synthetic slice k owns processes [4k, 4(k+1)) — the same
+        # grouping FaultSchedule.slice_loss targets
+        assert list(topo.inter_ranks) == [r // 4 for r in range(16)]
+
+    def test_without_the_knob_process_grouping_stands(self, monkeypatch):
+        monkeypatch.delenv("CHAINERMN_TPU_FAKE_SLICE_SIZE",
+                           raising=False)
+        topo = Topology.create(self._world())
+        assert topo.inter_size == 16
+        assert set(topo.intra_sizes) == {1}
+
+    def test_real_slice_layouts_never_regrouped(self, monkeypatch):
+        # two REAL slices: the keys differ, the degenerate-claim branch
+        # never runs, the knob is ignored
+        monkeypatch.setenv("CHAINERMN_TPU_FAKE_SLICE_SIZE", "2")
+        topo = Topology.create(_two_slices())
+        assert topo.inter_size == 2
+        assert set(topo.intra_sizes) == {4}
+
+
 class TestHierarchicalMeshFromSlices:
     def test_mesh_factorizes_inter_by_intra(self):
         import chainermn_tpu as cmn

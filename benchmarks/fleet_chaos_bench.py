@@ -15,6 +15,20 @@ merged :class:`~chainermn_tpu.fleet.report.FleetReport` wall clocks:
                         (checkpoint election + reshard + re-agreement)
   chain_wall_ms         whole chain, launch to last leg's exit
 
+A second rung runs the straggler-adaptive loop (ISSUE 15: a 4-process
+world with an injected straggler, conviction → rebalance → hysteresis →
+demotion, then a 3-process resume leg) and derives the self-healing
+latencies the same wall-anchored way:
+
+  convict_to_action_ms  first ``straggler`` conviction → first
+                        ``adapt_decision`` (how long the policy's
+                        hysteresis deliberates before acting)
+  action_to_recover_ms  the demote ``adapt_action`` (snapshot
+                        committed, world told to shed the rank) →
+                        ``elastic_restart`` of the N−1 world (includes
+                        the old world's exit + relaunch — the
+                        scheduler gap, as above)
+
 Honesty: the worlds timeshare the host (CI runs this on a single
 core), so these are END-TO-END wall numbers dominated by process
 launch and XLA compile, useful for DIRECTION (did recovery regress
@@ -35,10 +49,18 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from chainermn_tpu.fleet import ChainLeg, ElasticityChain  # noqa: E402
+from chainermn_tpu.fleet import (  # noqa: E402
+    REAPED,
+    ChainLeg,
+    ElasticityChain,
+    FaultSchedule,
+    FleetReport,
+    FleetWorld,
+)
 from chainermn_tpu.utils.benchmarking import protocol_fields  # noqa: E402
 
 LINGER_S = 1.5
+ADAPT_PROCS, ADAPT_DELAY_S, ADAPT_DEMOTE_AFTER = 4, 0.5, 3
 
 
 def run_once(scratch):
@@ -64,10 +86,66 @@ def run_once(scratch):
     }
 
 
+def run_adaptive_once(scratch):
+    """One pass of the self-healing loop: straggler conviction →
+    rebalance → demotion at ADAPT_PROCS, resume at ADAPT_PROCS-1."""
+    sched = FaultSchedule().straggler(
+        2, window=(1, 12), delay=ADAPT_DELAY_S
+    )
+    world = FleetWorld(ADAPT_PROCS, scratch, schedule=sched,
+                       budget_s=300, label="adapt0")
+    res = world.launch(
+        "adaptive_leg",
+        {"n_steps": 12, "demote_after": ADAPT_DEMOTE_AFTER,
+         "linger_s": LINGER_S},
+        expect_exit={p: REAPED for p in range(ADAPT_PROCS)},
+    )
+    payloads = res.payloads()
+    demote_step = payloads[0]["iteration"]
+    assert all(p["demoted"] == 2 for p in payloads.values()), payloads
+    FleetWorld(ADAPT_PROCS - 1, scratch, budget_s=300,
+               label="adapt1").launch(
+        "chain_leg",
+        {"n_steps": demote_step + 2, "wave_at": None, "lr": 0.1,
+         "mom": 0.9, "dim": 4, "straggler": False, "report_every": 1},
+        expect_exit={},
+    )
+    rep = FleetReport.from_scratch(scratch)
+    rep.assert_order("fault_injected", "straggler", "adapt_decision",
+                     "world_reformed", "elastic_reshard",
+                     "elastic_restart")
+    convict = rep.first("straggler")["wall"]
+    decide = rep.first("adapt_decision")["wall"]
+    demote_acts = [e["wall"] for e in rep.events("adapt_action")
+                   if e["info"].get("action") == "demote"]
+    recover = rep.first("elastic_restart")["wall"]
+    return {
+        "convict_to_action_s": decide - convict,
+        "action_to_recover_s": recover - min(demote_acts),
+    }
+
+
+def _rows_for(samples, extra):
+    rows = []
+    for metric, vals in samples.items():
+        row = {
+            "name": f"fleet_recovery.{metric[:-2]}",
+            "unit": "ms",
+            f"{metric[:-2]}_ms": round(min(vals) * 1e3, 1),
+            "linger_s": LINGER_S,
+        }
+        row.update(extra)
+        row.update(protocol_fields(vals))
+        rows.append(row)
+        print(json.dumps(row))
+    return rows
+
+
 def main():
     repeats = int(os.environ.get("HUNT_FLEET_REPEATS", "1"))
     samples = {"detect_to_reform_s": [], "reform_to_resume_s": [],
                "chain_wall_s": []}
+    adaptive = {"convict_to_action_s": [], "action_to_recover_s": []}
     for _ in range(repeats):
         scratch = tempfile.mkdtemp(prefix="fleet_bench_")
         try:
@@ -76,19 +154,20 @@ def main():
             shutil.rmtree(scratch, ignore_errors=True)
         for k, v in one.items():
             samples[k].append(v)
-    rows = []
-    for metric, vals in samples.items():
-        row = {
-            "name": f"fleet_recovery.{metric[:-2]}",
-            "unit": "ms",
-            f"{metric[:-2]}_ms": round(min(vals) * 1e3, 1),
-            "n_procs_wave": 8,
-            "n_procs_resume": 6,
-            "linger_s": LINGER_S,
-        }
-        row.update(protocol_fields(vals))
-        rows.append(row)
-        print(json.dumps(row))
+        scratch = tempfile.mkdtemp(prefix="fleet_bench_adapt_")
+        try:
+            one = run_adaptive_once(scratch)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+        for k, v in one.items():
+            adaptive[k].append(v)
+    rows = _rows_for(samples, {"n_procs_wave": 8, "n_procs_resume": 6})
+    rows += _rows_for(adaptive, {
+        "n_procs": ADAPT_PROCS,
+        "n_procs_resume": ADAPT_PROCS - 1,
+        "straggler_delay_s": ADAPT_DELAY_S,
+        "demote_after": ADAPT_DEMOTE_AFTER,
+    })
     return rows
 
 

@@ -371,8 +371,7 @@ def implicit_agreement(comm, trace: CollectiveTrace, hlo_text: str, *,
     raises :class:`ImplicitCollectiveError` before dispatch (a one-rank
     reshard is a divergent collective sequence: dispatching it would
     deadlock, not just waste bandwidth)."""
-    from ..resilience.errors import PayloadCorruptionError
-    from ..resilience.retry import RetryPolicy, call_with_retry, is_transient
+    from ..resilience.retry import lockstep_allgather
 
     report = attribute_collectives(trace, hlo_text, flow)
     mine = [
@@ -383,13 +382,7 @@ def implicit_agreement(comm, trace: CollectiveTrace, hlo_text: str, *,
     site = f"analysis.implicit_agreement({label or trace.label})"
     # same lockstep retry as trace_agreement/plan_agreement: a torn
     # payload is observed by every process, so all retry together
-    everyone = call_with_retry(
-        lambda: comm.allgather_obj(mine),
-        site=site,
-        policy=RetryPolicy(max_attempts=4),
-        retryable=lambda e: is_transient(e)
-        or isinstance(e, PayloadCorruptionError),
-    )
+    everyone = lockstep_allgather(comm, mine, site=site)
     if any(everyone):
         detail = "; ".join(
             f"rank {r}: {'; '.join(v)}"
@@ -419,22 +412,14 @@ def trace_agreement(comm, trace: CollectiveTrace, *,
     CollectiveTraceMismatchError` (non-recoverable — restarting replays
     the same divergent program) when any process disagrees.
     """
-    from ..resilience.errors import (
-        CollectiveTraceMismatchError,
-        PayloadCorruptionError,
-    )
-    from ..resilience.retry import RetryPolicy, call_with_retry, is_transient
+    from ..resilience.errors import CollectiveTraceMismatchError
+    from ..resilience.retry import lockstep_allgather
 
     mine = trace.trace_hash()
     site = f"analysis.trace_agreement({label or trace.label})"
 
-    hashes = call_with_retry(
-        lambda: comm.allgather_obj(mine),
-        site=site,
-        policy=RetryPolicy(max_attempts=max_attempts),
-        retryable=lambda e: is_transient(e)
-        or isinstance(e, PayloadCorruptionError),
-    )
+    hashes = lockstep_allgather(comm, mine, site=site,
+                                max_attempts=max_attempts)
     if any(h != mine for h in hashes):
         raise CollectiveTraceMismatchError(
             f"collective trace hash mismatch across processes: {hashes} "

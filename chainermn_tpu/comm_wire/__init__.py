@@ -126,21 +126,13 @@ def plan_agreement(comm, plan, *, max_attempts: int = 4):
     happen here).  Returns the agreed hash; raises
     :class:`WirePlanMismatchError` on divergence.
     """
-    from ..resilience.errors import PayloadCorruptionError
-    from ..resilience.retry import RetryPolicy, call_with_retry, is_transient
+    from ..resilience.retry import lockstep_allgather
 
     mine = plan.plan_hash()
 
-    def exchange():
-        return comm.allgather_obj(mine)
-
-    hashes = call_with_retry(
-        exchange,
-        site="comm_wire.plan_agreement",
-        policy=RetryPolicy(max_attempts=max_attempts),
-        retryable=lambda e: is_transient(e)
-        or isinstance(e, PayloadCorruptionError),
-    )
+    hashes = lockstep_allgather(comm, mine,
+                                site="comm_wire.plan_agreement",
+                                max_attempts=max_attempts)
     if any(h != mine for h in hashes):
         raise WirePlanMismatchError(
             f"wire-plan hash mismatch across processes: {hashes} "

@@ -1,9 +1,17 @@
-from .scatter_dataset import scatter_dataset, scatter_index, SubDataset  # noqa: F401
+from .scatter_dataset import (  # noqa: F401
+    SubDataset,
+    rescatter,
+    scatter_dataset,
+    scatter_index,
+    weighted_shard_counts,
+)
 from .empty_dataset import create_empty_dataset  # noqa: F401
 
 __all__ = [
     "scatter_dataset",
     "scatter_index",
+    "rescatter",
+    "weighted_shard_counts",
     "SubDataset",
     "create_empty_dataset",
 ]

@@ -55,15 +55,111 @@ class SubDataset:
         return self._order[self._start : self._end]
 
 
+def weighted_shard_counts(total: int, weights: Sequence[float], *,
+                          min_count: int = 0) -> list:
+    """Per-rank sample counts for a weighted split of ``total`` samples.
+
+    Largest-remainder method with DETERMINISTIC remainder placement:
+    each rank's quota is ``total * w_r / sum(w)``; floors are taken,
+    and the remaining samples go to the largest fractional parts, ties
+    broken by the LOWER rank — which makes equal weights reproduce the
+    equalized split's "first ``rem`` ranks absorb the remainder"
+    pattern exactly.  ``min_count`` lifts short shards (stealing one
+    sample at a time from the currently largest shard, ties again to
+    the lower rank) so an equalized weighted shard can never be empty.
+    """
+    w = np.asarray(list(weights), dtype=np.float64)
+    if w.ndim != 1 or w.size == 0:
+        raise ValueError(f"weights must be a non-empty 1-D sequence, "
+                         f"got shape {w.shape}")
+    if not np.all(np.isfinite(w)) or np.any(w <= 0):
+        raise ValueError(
+            f"weights must be finite and > 0 (demotion, not a zero "
+            f"weight, removes a rank); got {list(weights)!r}"
+        )
+    size = int(w.size)
+    total = int(total)
+    if min_count * size > total:
+        raise ValueError(
+            f"cannot give {size} shards >= {min_count} sample(s) each "
+            f"from {total} total"
+        )
+    quota = total * (w / w.sum())
+    counts = np.floor(quota).astype(np.int64)
+    frac = quota - counts
+    # float-noise guards around the exact integer total
+    while counts.sum() > total:
+        counts[int(np.argmax(counts))] -= 1
+    rem = int(total - counts.sum())
+    if rem > 0:
+        # largest fractional part first; ties -> lowest rank
+        take = np.lexsort((np.arange(size), -frac))[:rem]
+        counts[take] += 1
+    while True:
+        short = np.where(counts < min_count)[0]
+        if short.size == 0:
+            break
+        donor = int(np.argmax(counts))  # ties -> lowest rank
+        if counts[donor] <= min_count:
+            raise ValueError(
+                f"cannot satisfy min_count={min_count} over "
+                f"{size} shards of {total} samples"
+            )
+        counts[donor] -= 1
+        counts[int(short[0])] += 1
+    return [int(c) for c in counts]
+
+
+def _weighted_split(order: np.ndarray, size: int, rank: int,
+                    weights: Sequence[float], equalize: bool):
+    """Weighted contiguous split of ``order``.  With ``equalize`` every
+    shard is padded (by wrapping ITS OWN indices — the per-shard form
+    of the equal split's wrap-around pad) to the widest shard's length,
+    so every rank still steps the same number of times per epoch: the
+    lockstep-SPMD contract an adaptive rebalance must not break."""
+    if len(weights) != size:
+        raise ValueError(
+            f"got {len(weights)} weights for {size} shards"
+        )
+    counts = weighted_shard_counts(
+        len(order), weights, min_count=1 if equalize else 0
+    )
+    if not equalize:
+        offsets = np.concatenate([[0], np.cumsum(counts)])
+        return order, int(offsets[rank]), int(offsets[rank + 1])
+    width = max(counts)
+    segments, off = [], 0
+    for c in counts:
+        seg = order[off:off + c]
+        off += c
+        segments.append(np.resize(seg, width))  # wrap-pad within shard
+    out = np.concatenate(segments)
+    return out, rank * width, (rank + 1) * width
+
+
 def scatter_index(n: int, size: int, rank: int, *,
                   shuffle: bool = False, seed: Optional[int] = None,
-                  equalize: bool = True) -> np.ndarray:
-    """Index shard for ``rank`` of ``size`` over a dataset of length ``n``."""
-    order = np.arange(n)
-    if shuffle:
-        order = np.random.RandomState(seed).permutation(n)
-    if equalize and n % size:
-        pad = size - n % size
+                  equalize: bool = True,
+                  weights: Optional[Sequence[float]] = None,
+                  order: Optional[np.ndarray] = None) -> np.ndarray:
+    """Index shard for ``rank`` of ``size`` over a dataset of length ``n``.
+
+    ``weights``: explicit per-rank shard weights (the straggler-adaptive
+    rebalance substrate — see :func:`weighted_shard_counts` for the
+    deterministic remainder placement).  ``order``: a precomputed base
+    permutation to re-split (how a rebalance re-shards the SAME epoch
+    permutation under new weights instead of redrawing it).
+    """
+    if order is None:
+        order = np.arange(n)
+        if shuffle:
+            order = np.random.RandomState(seed).permutation(n)
+    else:
+        order = np.asarray(order)
+    if weights is not None:
+        return _weighted_split(order, size, rank, weights, equalize)
+    if equalize and len(order) % size:
+        pad = size - len(order) % size
         order = np.concatenate([order, order[:pad]])
     per = len(order) // size
     rem = len(order) % size
@@ -82,6 +178,7 @@ def scatter_dataset(
     rank: Optional[int] = None,
     n_shards: Optional[int] = None,
     force_equal_length: bool = True,
+    weights: Optional[Sequence[float]] = None,
 ):
     """Shard ``dataset`` across the communicator.
 
@@ -114,11 +211,49 @@ def scatter_dataset(
         r = comm.rank if rank is None else rank
     if not 0 <= r < n_shards:
         raise ValueError(f"rank {r} out of range for {n_shards} shards")
+    base = np.arange(len(dataset))
+    if shuffle:
+        base = np.random.RandomState(seed).permutation(len(dataset))
     order, start, end = scatter_index(
-        len(dataset), n_shards, r, shuffle=shuffle, seed=seed,
-        equalize=force_equal_length,
+        len(dataset), n_shards, r, equalize=force_equal_length,
+        weights=weights, order=base,
     )
-    return SubDataset(dataset, order, start, end)
+    sub = SubDataset(dataset, order, start, end)
+    # rescatter metadata: the straggler-adaptive rebalance re-splits the
+    # SAME base permutation under new weights (no redraw, no re-seed)
+    sub.base_order = base
+    sub.scatter_spec = {
+        "n_shards": int(n_shards), "rank": int(r),
+        "equalize": bool(force_equal_length),
+        "weights": None if weights is None
+        else tuple(float(w) for w in weights),
+    }
+    return sub
+
+
+def rescatter(sub: SubDataset, weights: Sequence[float]) -> SubDataset:
+    """Re-shard a scattered dataset under new per-rank ``weights``,
+    preserving the original permutation (every rank re-splits the same
+    ``base_order``, so agreeing on the weights IS agreeing on the new
+    shard map).  Only shards produced by :func:`scatter_dataset` carry
+    the needed metadata."""
+    spec = getattr(sub, "scatter_spec", None)
+    base = getattr(sub, "base_order", None)
+    if spec is None or base is None:
+        raise ValueError(
+            "rescatter needs a SubDataset produced by scatter_dataset "
+            "(carrying base_order + scatter_spec)"
+        )
+    order, start, end = scatter_index(
+        len(base), spec["n_shards"], spec["rank"],
+        equalize=spec["equalize"], weights=weights, order=base,
+    )
+    out = SubDataset(sub._dataset, order, start, end)
+    out.base_order = base
+    out.scatter_spec = dict(
+        spec, weights=tuple(float(w) for w in weights)
+    )
+    return out
 
 
 def scatter_dataset_all(dataset, comm, shuffle=False, seed=None):

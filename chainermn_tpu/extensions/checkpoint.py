@@ -304,21 +304,13 @@ class _MultiNodeCheckpointer:
         payload), so all ranks fail — and re-exchange — together instead
         of desynchronizing the agreement.
         """
-        from ..resilience.errors import PayloadCorruptionError
-        from ..resilience.retry import (
-            RetryPolicy,
-            call_with_retry,
-            is_transient,
-        )
+        from ..resilience.retry import lockstep_allgather
 
         with _obs.span("checkpoint.agreement"):
             local = self._available_steps()
-            inventories = call_with_retry(
-                lambda: self._comm.allgather_obj(local),
+            inventories = lockstep_allgather(
+                self._comm, local,
                 site="checkpoint.newest_common_step",
-                policy=RetryPolicy(max_attempts=4),
-                retryable=lambda e: is_transient(e)
-                or isinstance(e, PayloadCorruptionError),
             )
             common = set(inventories[0])
             for inv in inventories[1:]:

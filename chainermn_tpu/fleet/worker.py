@@ -30,24 +30,13 @@ _CTX: dict = {}
 
 
 def _lockstep_allgather(comm, payload, site: str = "fleet.rendezvous"):
-    """An agreement-shaped exchange: every process unpickles every
-    payload, so a torn payload (or a transient fault) fails — and
-    re-exchanges — on all ranks together, exactly like
+    """The agreement-shaped exchange (``resilience.retry.
+    lockstep_allgather``): a torn payload or transient fault fails —
+    and re-exchanges — on all ranks together, exactly like
     ``plan_agreement`` / ``newest_common_step``."""
-    from chainermn_tpu.resilience.errors import PayloadCorruptionError
-    from chainermn_tpu.resilience.retry import (
-        RetryPolicy,
-        call_with_retry,
-        is_transient,
-    )
+    from chainermn_tpu.resilience.retry import lockstep_allgather
 
-    return call_with_retry(
-        lambda: comm.allgather_obj(payload),
-        site=site,
-        policy=RetryPolicy(max_attempts=4),
-        retryable=lambda e: is_transient(e)
-        or isinstance(e, PayloadCorruptionError),
-    )
+    return lockstep_allgather(comm, payload, site=site)
 
 
 def _export_artifacts() -> None:
@@ -240,10 +229,12 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
         trainer.extend(ckpt, trigger=(1, "iteration"))
         if straggler:
             # per-iteration windows: the first window after a resume is
-            # compile-dominated (its step mean inflates the materiality
-            # floor past any injected delay), so conviction comes from
-            # the later, steady windows — the leg reports the UNION of
-            # flags across windows (read off the straggler events)
+            # compile-dominated and excluded from conviction BY
+            # CONTRACT (MetricsReport's warmup_windows=1 default — the
+            # trainer log carries elastic_restart at initialize), so
+            # conviction comes from the later, steady windows — the leg
+            # reports the UNION of flags across windows (read off the
+            # straggler events)
             rep = obs.MetricsReport(
                 comm, trigger=(int(args.get("report_every", 1)),
                                "iteration"),
@@ -289,6 +280,118 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
         "final_w": float(got[0]),
         "stragglers": stragglers,
     }
+
+
+# ----------------------------------------------------------------------
+def scenario_adaptive_leg(pid, nproc, scratch, label, args):
+    """The self-healing runtime's demote leg (ISSUE 15): a straggler
+    (possibly migrating between ranks — the schedule decides) is
+    convicted by ``MetricsReport``, the :class:`~chainermn_tpu.
+    resilience.adaptive.AdaptPolicy` first REBALANCES (weighted
+    re-scatter of the shared dataset, agreed cross-rank through the
+    lockstep-retried exchange, live iterator cursor remapped) and, once
+    the conviction streak outlives the hysteresis window, DEMOTES: a
+    snapshot is committed at the decision iteration and
+    ``DemotionRequiredError`` raises on every rank together.  The next
+    leg (the plain ``chain_leg`` resume at N−1) re-forms the world and
+    must land on the single-world oracle from exactly that step.
+
+    The dataset is constant 0.5-rows scattered across processes, so ANY
+    weighted shard's batch mean is 0.5 — the numpy sgd+momentum oracle
+    holds through every rebalance, making the data skew a real
+    re-scatter rather than a decision-only event.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.datasets import scatter_dataset
+    from chainermn_tpu.fleet.chain import momentum_oracle
+    from chainermn_tpu.fleet.report import export_resilience_log
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.resilience.adaptive import (
+        AdaptiveExecution,
+        AdaptPolicy,
+    )
+    from chainermn_tpu.resilience.errors import DemotionRequiredError
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+    n_steps = int(args["n_steps"])
+
+    comm = cmn.create_communicator("tpu")
+    got = _lockstep_allgather(comm, pid)
+    assert got == list(range(nproc)), got
+
+    # the SAME pieces (loss, ZeRO sgd+momentum optimizer, step, and —
+    # critically — the checkpointer name/path the N−1 chain_leg resume
+    # elects from) as every chain leg; only the dataset differs
+    opt, step, ckpt, _rows = _chain_pieces(comm, scratch, lr, mom, dim)
+    full = [np.full((dim,), 0.5, np.float32)] * (nproc * 4)
+    shard = scatter_dataset(full, comm, shuffle=False, seed=0)
+    width0 = len(shard)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    it = SerialIterator(shard, 2, shuffle=False)
+    trainer = Trainer(Updater(it, step, params, opt_state),
+                      stop_trigger=(n_steps, "iteration"))
+    trainer.extend(ckpt, trigger=(1, "iteration"))
+    trainer.extend(obs.MetricsReport(comm, trigger=(1, "iteration"),
+                                     filename=None))
+    policy = AdaptPolicy(
+        rebalance_after=int(args.get("rebalance_after", 1)),
+        demote_after=int(args.get("demote_after", 3)),
+        cooldown_windows=int(args.get("cooldown_windows", 1)),
+        max_rebalances=int(args.get("max_rebalances", 2)),
+    )
+    trainer.extend(AdaptiveExecution(policy, comm=comm))
+
+    demoted = None
+    try:
+        trainer.run()
+    except DemotionRequiredError as err:
+        demoted = int(err.peer)
+    # the completed prefix sits on the oracle (the rebalances changed
+    # shard maps, never batch statistics)
+    w = np.asarray(trainer.updater.params["w"])
+    oracle_ok = True
+    if trainer.iteration > 0:
+        oracle = momentum_oracle(trainer.iteration, lr=lr, mom=mom,
+                                 dim=dim)
+        oracle_ok = bool(np.allclose(
+            w, oracle[trainer.iteration - 1], rtol=1e-5
+        ))
+    rebalances = trainer.resilience_log.events(
+        "adapt_action", "adaptive.rebalance"
+    )
+    export_resilience_log(
+        trainer.resilience_log,
+        os.path.join(scratch, f"{label}_p{pid}_trainer_events.jsonl"),
+    )
+    stragglers = sorted({
+        int(e.info["process"])
+        for e in trainer.resilience_log.events("straggler")
+    })
+    out = {
+        "demoted": demoted,
+        "iteration": trainer.iteration,
+        "oracle_match": oracle_ok,
+        "stragglers": stragglers,
+        "n_rebalances": len(rebalances),
+        "rebalance_applied": bool(
+            rebalances and rebalances[0].info.get("applied")
+        ),
+        "shard_width": [width0,
+                        len(trainer.updater.iterator.dataset)],
+        "w": float(w[0]),
+    }
+    # every rank exits together after the agreed demotion, but the exit
+    # race with the runtime's peer-death propagation is real (the first
+    # os._exit may reap the rest) — paperwork first, REAPED accepted
+    finish_and_exit(out, linger_s=float(args.get("linger_s", 1.5)))
 
 
 # ----------------------------------------------------------------------

@@ -66,6 +66,17 @@ class MetricsReport:
     If no telemetry is active when the trainer initializes extensions,
     the report enables one for the run (and disables it in
     ``finalize``) — attaching the extension IS opting into measurement.
+
+    **Post-resume warmup skip**: the first report window after a
+    restart (an ``elastic_restart`` on the trainer log at initialize,
+    or a mid-run auto-resume ``restart``) is compile-dominated — the
+    resized/restored world retraces, so every rank's step mean inflates
+    and the materiality floor happens to mask real stragglers.  Rather
+    than leaning on that coincidence, ``warmup_windows`` (default 1)
+    windows after a resume are excluded from conviction BY CONTRACT:
+    rows still aggregate, but the detector emits a
+    ``straggler_warmup_skip`` event instead of convicting.  Fresh runs
+    (no resume) skip nothing.
     """
 
     priority = 120
@@ -78,10 +89,15 @@ class MetricsReport:
                  straggler_phases: Sequence[str] = STRAGGLER_PHASES,
                  min_step_fraction: float = 0.05,
                  filename: Optional[str] = "metrics.jsonl",
-                 out: str = "result"):
+                 out: str = "result",
+                 warmup_windows: int = 1):
         if straggler_factor <= 1.0:
             raise ValueError(
                 f"straggler_factor must be > 1, got {straggler_factor}"
+            )
+        if warmup_windows < 0:
+            raise ValueError(
+                f"warmup_windows must be >= 0, got {warmup_windows}"
             )
         self._comm = comm
         self.trigger = trigger
@@ -91,6 +107,9 @@ class MetricsReport:
         self._min_step_fraction = float(min_step_fraction)
         self._filename = filename
         self._out = out
+        self._warmup_windows = int(warmup_windows)
+        self._warmup_left = 0
+        self._restarts_seen = 0
         self._consumed: Dict[str, int] = {}
         self._own_telemetry = None
         self.last_report: Optional[dict] = None
@@ -101,6 +120,14 @@ class MetricsReport:
         if _tl.active() is None:
             self._own_telemetry = _tl.Telemetry(label="metrics_report")
             _tl.install(self._own_telemetry)
+        # post-resume warmup: a trainer that already carries a restart
+        # record (run_elastic logs elastic_restart BEFORE run) starts
+        # with its first warmup_windows report windows conviction-free
+        log = getattr(trainer, "resilience_log", None)
+        if log is not None:
+            self._restarts_seen = len(log.events("restart"))
+            if log.events("elastic_restart") or self._restarts_seen:
+                self._warmup_left = self._warmup_windows
 
     def finalize(self, trainer=None) -> None:
         if self._own_telemetry is not None and \
@@ -136,23 +163,10 @@ class MetricsReport:
         # single-process worlds still exchange (a cheap in-memory
         # allgather) so the dedupe-by-process and lockstep-retry paths
         # are exercised by every tier, not just the mp one
-        from ..resilience.errors import PayloadCorruptionError
-        from ..resilience.retry import (
-            RetryPolicy,
-            call_with_retry,
-            is_transient,
-        )
+        from ..resilience.retry import lockstep_allgather
 
-        # lockstep retry, exactly as plan_agreement/newest_common_step:
-        # every process unpickles every payload, so a torn payload or
-        # transient fault fails — and re-exchanges — on all ranks
-        # together instead of desynchronizing the collective stream
-        return call_with_retry(
-            lambda: self._comm.allgather_obj(local),
-            site="metrics_report.exchange",
-            policy=RetryPolicy(max_attempts=4),
-            retryable=lambda e: is_transient(e)
-            or isinstance(e, PayloadCorruptionError),
+        return lockstep_allgather(
+            self._comm, local, site="metrics_report.exchange"
         )
 
     def __call__(self, trainer) -> None:
@@ -186,7 +200,29 @@ class MetricsReport:
             )
         }
         rows = self._aggregate(by_proc, trainer.iteration, means_map)
-        self._flag_stragglers(by_proc, trainer, means_map)
+        # a mid-run auto-resume (restart) re-arms the warmup skip: the
+        # rolled-back world re-dispatches (and possibly re-compiles)
+        # exactly like a fresh resume
+        log = getattr(trainer, "resilience_log", None)
+        if log is not None:
+            n_restarts = len(log.events("restart"))
+            if n_restarts > self._restarts_seen:
+                self._restarts_seen = n_restarts
+                self._warmup_left = max(
+                    self._warmup_left, self._warmup_windows
+                )
+        if self._warmup_left > 0:
+            self._warmup_left -= 1
+            self.straggler_processes = []
+            from ..resilience.log import emit
+
+            emit(
+                "straggler_warmup_skip", "metrics_report",
+                iteration=trainer.iteration,
+                windows_left=self._warmup_left,
+            )
+        else:
+            self._flag_stragglers(by_proc, trainer, means_map)
         self.last_report = {
             "iteration": trainer.iteration,
             "rows": rows,

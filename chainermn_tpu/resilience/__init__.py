@@ -11,7 +11,9 @@ auto-resume lives in ``training.trainer.Trainer.run(max_restarts=N)``.
 """
 
 from .errors import (  # noqa: F401
+    AdaptDecisionMismatchError,
     CollectiveTraceMismatchError,
+    DemotionRequiredError,
     PayloadCorruptionError,
     PreemptionError,
     ResilienceError,
@@ -21,6 +23,12 @@ from .errors import (  # noqa: F401
     WorldResizeRequiredError,
 )
 from . import elastic  # noqa: F401  (N→M restart: manifests + resharding)
+from .adaptive import (  # noqa: F401  (straggler-adaptive execution)
+    AdaptPolicy,
+    AdaptiveExecution,
+    drain_replica,
+    remap_iterator_cursor,
+)
 from .fault_injection import (  # noqa: F401
     FaultInjector,
     FaultSpec,
@@ -34,5 +42,6 @@ from .retry import (  # noqa: F401
     RetryPolicy,
     call_with_retry,
     is_transient,
+    lockstep_allgather,
     resilient_call,
 )

@@ -1,0 +1,498 @@
+"""Straggler-adaptive execution: detect → decide → act → recover.
+
+``MetricsReport`` convicts stragglers (leave-one-out median over
+rank-local phases) and the elastic layer can re-form and reshard worlds
+— but until this module nothing connected them: a persistently slow
+host taxed every healthy rank forever, because lockstep SPMD
+collectives run at the slowest participant's pace.  This is the policy
+engine that closes the loop, with three escalating remediation actions:
+
+* **rebalance** — skew ``scatter_dataset`` shards away from the
+  convicted host: a new weighted shard map
+  (:func:`~chainermn_tpu.datasets.scatter_dataset.weighted_shard_counts`
+  — deterministic remainder placement, every shard wrap-padded to the
+  widest so the per-epoch step count stays lockstep-identical) re-splits
+  the SAME base permutation, and the live iterator's cursor remaps onto
+  the new shard width (:func:`remap_iterator_cursor`).
+* **demote** — on a conviction streak outliving the hysteresis window,
+  commit a snapshot at the CURRENT iteration and raise
+  :class:`~chainermn_tpu.resilience.errors.DemotionRequiredError` on
+  every rank together: the surviving world re-forms at N−1
+  (``Trainer.run_elastic``) and resumes through the bit-identical ZeRO
+  block resharder from that snapshot — no step lost.
+* **drain** (serving) — :func:`drain_replica` marks the slow replica
+  draining in the ``RequestJournal``; the deterministic ``seq % n``
+  claim re-derives around it, so its share migrates to healthy replicas
+  without coordination (``serving.replica.claim(draining=...)``).
+
+Decisions are cross-rank agreed before any rank acts: every report
+window exchanges the decision payload over the obj store — action-free
+windows included, so a rank that decided "nothing" cannot leave an
+acting rank hanging in a one-sided exchange — riding the SAME lockstep
+retry as ``plan_agreement`` / ``newest_common_step`` (a torn payload
+fails — and re-exchanges — on all ranks together), and a divergent
+decision raises
+:class:`~chainermn_tpu.resilience.errors.AdaptDecisionMismatchError` on
+every rank before anyone rebalances apart.
+
+Hysteresis (flap suppression): a conviction raises a per-process
+streak, a healthy window DECAYS it by one (so a flapping rank — slow,
+recovered, slow — accumulates streak far slower than a persistently
+slow one), and every action arms a per-process cooldown during which
+the policy will not act on that process again.  The whole policy state
+(streaks, cooldowns, applied weights, totals) checkpoints with the
+trainer (``Trainer.state_dict``) and resets its per-process maps —
+loudly, as an ``adapt_state_reset`` event — when it wakes up in a
+resized world, where the old process indices no longer name the same
+hosts.
+
+Every decision and action lands as a resilience event (emitted through
+the shared sink registry, so it streams to the fleet tier's per-process
+JSONL and merges into the :class:`~chainermn_tpu.fleet.report.
+FleetReport` timeline): the post-mortem contract is
+``straggler → adapt_decision → adapt_action`` and, for a demotion,
+``… → world_reformed → elastic_reshard → elastic_restart`` — detect →
+decide → act → recover end to end.
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Dict, List, Mapping, Optional, Sequence
+
+from .errors import AdaptDecisionMismatchError, DemotionRequiredError
+from .log import emit
+from .retry import lockstep_allgather
+
+AGREEMENT_SITE = "adaptive.agree"
+
+
+def remap_iterator_cursor(state, old_len: int, new_len: int) -> dict:
+    """Re-map a per-rank iterator cursor onto a rebalanced shard width
+    (the SAME-world sibling of ``elastic.reshard_iterator_state``): the
+    epoch fraction ``pos / old_len`` is preserved onto ``new_len``, and
+    the in-flight ``order`` permutation — drawn for the old width — is
+    cleared so ``SerialIterator.restore`` redraws it from the restored
+    RNG stream.  Every rank computes the same remap from the same
+    agreed widths, so cursors stay synchronized."""
+    if not isinstance(state, Mapping):
+        return state
+    out = dict(state)
+    if out.get("pos") is not None:
+        pos = int(out["pos"])
+        out["pos"] = (pos * int(new_len)) // max(int(old_len), 1)
+    out["order"] = None
+    emit(
+        "adaptive_iterator_remap", "adaptive.rebalance",
+        old_len=int(old_len), new_len=int(new_len), pos=out.get("pos"),
+    )
+    return out
+
+
+class AdaptPolicy:
+    """Hysteresis state machine: convictions in, remediation actions out.
+
+    ``observe(convicted, world=..., iteration=...)`` is the pure
+    decision step, called once per report window; it returns a list of
+    action dicts (``{"action": "rebalance", "processes": [...],
+    "weights": [...]}`` / ``{"action": "demote", "process": p}``) and
+    mutates only the policy's own state — applying the actions (and
+    agreeing on them) is :class:`AdaptiveExecution`'s job, which keeps
+    the policy unit-testable at any world size with no processes.
+
+    Knobs: ``rebalance_after`` / ``demote_after`` are conviction-streak
+    thresholds (demote wins when both trip); ``cooldown_windows`` arms
+    a per-process backoff after every action; ``rebalance_skew``
+    multiplies the convicted rank's shard weight per rebalance (floored
+    at ``min_weight``), and ``max_rebalances`` bounds how often data is
+    skewed away from one rank before the only escalation left is
+    demotion.  ``actions`` gates which remediations may fire at all.
+    """
+
+    def __init__(self, *, rebalance_after: int = 1, demote_after: int = 3,
+                 cooldown_windows: int = 1, rebalance_skew: float = 0.5,
+                 min_weight: float = 0.125, max_rebalances: int = 2,
+                 actions: Sequence[str] = ("rebalance", "demote")):
+        if rebalance_after < 1 or demote_after < 1:
+            raise ValueError(
+                f"streak thresholds must be >= 1, got "
+                f"rebalance_after={rebalance_after}, "
+                f"demote_after={demote_after}"
+            )
+        if cooldown_windows < 0:
+            raise ValueError(
+                f"cooldown_windows must be >= 0, got {cooldown_windows}"
+            )
+        if not 0.0 < rebalance_skew < 1.0:
+            raise ValueError(
+                f"rebalance_skew must be in (0, 1), got {rebalance_skew}"
+            )
+        if min_weight <= 0:
+            raise ValueError(f"min_weight must be > 0, got {min_weight}")
+        unknown = set(actions) - {"rebalance", "demote"}
+        if unknown:
+            raise ValueError(f"unknown actions {sorted(unknown)}")
+        self.rebalance_after = int(rebalance_after)
+        self.demote_after = int(demote_after)
+        self.cooldown_windows = int(cooldown_windows)
+        self.rebalance_skew = float(rebalance_skew)
+        self.min_weight = float(min_weight)
+        self.max_rebalances = int(max_rebalances)
+        self.actions = tuple(actions)
+        # -- mutable hysteresis state (checkpointed) --------------------
+        self.world: Optional[int] = None
+        self.streaks: Dict[int, int] = {}
+        self.cooldowns: Dict[int, int] = {}
+        self.rebalances: Dict[int, int] = {}
+        self.weights: Optional[List[float]] = None
+        self.windows = 0
+        self.totals: Dict[str, int] = {"rebalance": 0, "demote": 0}
+        # (old_world, new_world) of the last world-change reset, for the
+        # extension to report; cleared once read
+        self.last_reset = None
+
+    # -- world identity -------------------------------------------------
+    def _sync_world(self, world: int) -> None:
+        world = int(world)
+        if self.world is not None and self.world != world:
+            # process indices in a resized world no longer name the same
+            # hosts: per-process hysteresis resets; run totals survive
+            self.last_reset = (self.world, world)
+            self.streaks.clear()
+            self.cooldowns.clear()
+            self.rebalances.clear()
+            self.weights = None
+        self.world = world
+
+    def _arm_cooldown(self, p: int) -> None:
+        # cooldown_windows=0 means NO backoff: a zero-valued entry
+        # would still block the next window's on_cooldown check
+        if self.cooldown_windows > 0:
+            self.cooldowns[p] = self.cooldown_windows
+
+    def current_weights(self, world: Optional[int] = None) -> List[float]:
+        if self.weights is not None:
+            return list(self.weights)
+        return [1.0] * int(world if world is not None else self.world or 1)
+
+    # -- the decision step ----------------------------------------------
+    def observe(self, convicted: Sequence[int], *, world: int,
+                iteration: int) -> List[dict]:
+        self._sync_world(world)
+        self.windows += 1
+        convicted = sorted({int(p) for p in convicted})
+        # a process on cooldown is blocked for THIS window and the
+        # counter ticks after — an action's backoff spans exactly
+        # `cooldown_windows` further report windows
+        on_cooldown = set(self.cooldowns)
+        for p in list(self.cooldowns):
+            self.cooldowns[p] -= 1
+            if self.cooldowns[p] <= 0:
+                del self.cooldowns[p]
+        # streaks: +1 on conviction, -1 decay on a healthy window (flap
+        # suppression — a slow/recovered/slow rank accumulates slowly)
+        for p in convicted:
+            self.streaks[p] = self.streaks.get(p, 0) + 1
+        for p in list(self.streaks):
+            if p not in convicted:
+                self.streaks[p] -= 1
+                if self.streaks[p] <= 0:
+                    del self.streaks[p]
+        # escalation 2: demote — one process per window (highest streak,
+        # ties to the lowest index), and nothing else that window
+        if "demote" in self.actions:
+            cands = [p for p in convicted
+                     if self.streaks[p] >= self.demote_after
+                     and p not in on_cooldown]
+            if cands:
+                p = min(cands, key=lambda q: (-self.streaks[q], q))
+                self._arm_cooldown(p)
+                self.totals["demote"] += 1
+                return [{
+                    "action": "demote", "process": int(p),
+                    "streak": int(self.streaks[p]),
+                    "iteration": int(iteration),
+                }]
+        # escalation 1: rebalance — one weighted map covering every
+        # process whose streak tripped this window
+        if "rebalance" in self.actions:
+            targets = [
+                p for p in convicted
+                if self.streaks[p] >= self.rebalance_after
+                and p not in on_cooldown
+                and self.rebalances.get(p, 0) < self.max_rebalances
+            ]
+            if targets:
+                weights = self.current_weights(world)
+                for p in targets:
+                    weights[p] = max(
+                        weights[p] * self.rebalance_skew, self.min_weight
+                    )
+                    self._arm_cooldown(p)
+                    self.rebalances[p] = self.rebalances.get(p, 0) + 1
+                self.weights = list(weights)
+                self.totals["rebalance"] += 1
+                return [{
+                    "action": "rebalance",
+                    "processes": [int(p) for p in targets],
+                    "streaks": {str(p): int(self.streaks[p])
+                                for p in targets},
+                    "weights": [float(w) for w in weights],
+                    "iteration": int(iteration),
+                }]
+        return []
+
+    # -- checkpointable state -------------------------------------------
+    def state_dict(self) -> dict:
+        return {
+            "world": self.world,
+            "streaks": {str(k): int(v) for k, v in self.streaks.items()},
+            "cooldowns": {str(k): int(v)
+                          for k, v in self.cooldowns.items()},
+            "rebalances": {str(k): int(v)
+                           for k, v in self.rebalances.items()},
+            "weights": None if self.weights is None
+            else [float(w) for w in self.weights],
+            "windows": int(self.windows),
+            "totals": dict(self.totals),
+        }
+
+    def load_state_dict(self, state: Mapping) -> None:
+        """Restore hysteresis state from a checkpoint.  The saved
+        ``world`` rides along: the first ``observe`` in a DIFFERENT
+        world resets the per-process maps (indices changed meaning)
+        while run totals and the window counter survive."""
+        self.world = (None if state.get("world") is None
+                      else int(state["world"]))
+        self.streaks = {int(k): int(v)
+                        for k, v in (state.get("streaks") or {}).items()}
+        self.cooldowns = {
+            int(k): int(v)
+            for k, v in (state.get("cooldowns") or {}).items()
+        }
+        self.rebalances = {
+            int(k): int(v)
+            for k, v in (state.get("rebalances") or {}).items()
+        }
+        w = state.get("weights")
+        self.weights = None if w is None else [float(x) for x in w]
+        self.windows = int(state.get("windows", 0))
+        self.totals = {"rebalance": 0, "demote": 0,
+                       **{k: int(v)
+                          for k, v in (state.get("totals") or {}).items()}}
+
+
+class AdaptiveExecution:
+    """Trainer extension: applies an :class:`AdaptPolicy` to the
+    convictions of the attached ``MetricsReport``.
+
+    Runs at priority 90 — after the checkpointer (200) and the report
+    (120) in the same extension pass, so a demote decision always finds
+    a snapshot of the current iteration (and forces one itself through
+    the checkpointer before raising, making "no step lost" a contract
+    rather than a trigger coincidence).  ``comm=None`` borrows the
+    report's communicator at initialize.
+    """
+
+    priority = 90
+    trigger = (1, "iteration")
+    name = "adaptive"
+
+    def __init__(self, policy: Optional[AdaptPolicy] = None, *,
+                 comm=None, report=None):
+        self.policy = policy if policy is not None else AdaptPolicy()
+        self._comm = comm
+        self._report = report
+        self._seen_report: Optional[int] = None
+
+    # -- extension protocol ---------------------------------------------
+    def initialize(self, trainer) -> None:
+        if self._report is None:
+            for e in trainer._extensions:
+                if hasattr(e.ext, "straggler_processes") and hasattr(
+                    e.ext, "last_report"
+                ):
+                    self._report = e.ext
+                    break
+        if self._report is None:
+            raise ValueError(
+                "AdaptiveExecution needs a MetricsReport extension on "
+                "the same trainer (the conviction stream it consumes) — "
+                "trainer.extend(MetricsReport(comm, ...)) first"
+            )
+        if self._comm is None:
+            self._comm = getattr(self._report, "_comm", None)
+        # a restored policy that woke up in a resized world reset its
+        # per-process maps lazily; surface any pending reset eagerly
+        if self._comm is not None:
+            self.policy._sync_world(self._world())
+        self._emit_reset_if_any(trainer)
+
+    def _world(self) -> int:
+        if self._comm is None:
+            return 1
+        return int(self._comm.process_count)
+
+    def _emit_reset_if_any(self, trainer) -> None:
+        reset, self.policy.last_reset = self.policy.last_reset, None
+        if reset is not None:
+            emit(
+                "adapt_state_reset", "adaptive.policy",
+                old_world=reset[0], new_world=reset[1],
+                iteration=getattr(trainer, "iteration", None),
+            )
+
+    def __call__(self, trainer) -> None:
+        rep = self._report
+        if rep is None or rep.last_report is None:
+            return
+        rit = int(rep.last_report["iteration"])
+        if rit == self._seen_report:
+            return  # no new report window since the last decision
+        self._seen_report = rit
+        convicted = list(rep.last_report.get("stragglers") or [])
+        actions = self.policy.observe(
+            convicted, world=self._world(), iteration=trainer.iteration
+        )
+        self._emit_reset_if_any(trainer)
+        # EVERY report window agrees — including action-free ones: the
+        # likeliest divergence shape is one rank deciding "no action"
+        # (e.g. its checkpointed hysteresis failed to restore), and
+        # skipping the exchange on empty decisions would turn that into
+        # a one-sided allgather hang instead of the loud
+        # AdaptDecisionMismatchError the contract promises
+        self._agree(trainer.iteration, actions)
+        if not actions:
+            return
+        for a in actions:
+            procs = (a["processes"] if a["action"] == "rebalance"
+                     else [a["process"]])
+            for p in procs:
+                emit(
+                    "adapt_decision", "adaptive.policy",
+                    action=a["action"], process=int(p),
+                    streak=int(self.policy.streaks.get(int(p), 0)),
+                    iteration=int(trainer.iteration),
+                    window=int(self.policy.windows),
+                )
+        for a in actions:
+            if a["action"] == "rebalance":
+                self._rebalance(trainer, a)
+            elif a["action"] == "demote":
+                self._demote(trainer, a)
+
+    # -- agreement -------------------------------------------------------
+    def _agree(self, iteration: int, actions: List[dict]) -> dict:
+        """Exchange the decision payload (lockstep-retried) and require
+        bytewise-identical decisions on every process before anyone
+        acts.  Deterministic inputs make divergence a bug, not a race —
+        which is exactly why it must raise loudly instead of letting
+        ranks rebalance apart."""
+        payload = {"iteration": int(iteration), "actions": actions}
+        if self._comm is None:
+            return payload
+        mine = json.dumps(payload, sort_keys=True)
+        got = lockstep_allgather(self._comm, mine, site=AGREEMENT_SITE)
+        divergent = sorted({g for g in got if g != mine})
+        if divergent:
+            raise AdaptDecisionMismatchError(
+                f"adaptive decisions diverged at iteration {iteration}: "
+                f"this process decided {mine}; {len(divergent)} other "
+                f"decision(s) seen, first: {divergent[0]}",
+                site=AGREEMENT_SITE,
+            )
+        return payload
+
+    # -- actions ---------------------------------------------------------
+    def _rebalance(self, trainer, action: dict) -> None:
+        from ..datasets.scatter_dataset import rescatter
+
+        weights = action["weights"]
+        iterator = getattr(trainer.updater, "iterator", None)
+        dataset = getattr(iterator, "dataset", None)
+        applied = False
+        old_len = new_len = None
+        if (dataset is not None and hasattr(dataset, "scatter_spec")
+                and hasattr(iterator, "serialize")
+                and hasattr(iterator, "restore")):
+            # the swap and the cursor remap are one atomic act: a
+            # dataset of the new width under a cursor/permutation drawn
+            # for the old one indexes out of range (or silently replays
+            # wrong samples), so an iterator that cannot remap keeps
+            # its old shard map — recorded as applied=False
+            new_ds = rescatter(dataset, weights)
+            old_len, new_len = len(dataset), len(new_ds)
+            iterator.dataset = new_ds
+            state = remap_iterator_cursor(
+                iterator.serialize(), old_len, new_len
+            )
+            iterator.restore(state)
+            applied = True
+            # re-commit the current step: the checkpointer (higher
+            # priority) saved BEFORE this rebalance, so without a
+            # re-save an auto-resume would restore the OLD shard
+            # width's cursor/permutation against the NEW dataset —
+            # replaying different samples than the original run (or
+            # indexing an exhausted stale order).  All ranks reach
+            # this point together (the decision was agreed), so the
+            # collective save is safe; a same-step re-save is an
+            # atomic overwrite.
+            ckpt = trainer._find_checkpointer()
+            if ckpt is not None:
+                ckpt(trainer)
+        emit(
+            "adapt_action", "adaptive.rebalance",
+            action="rebalance",
+            processes=",".join(str(p) for p in action["processes"]),
+            weights=",".join(f"{w:g}" for w in weights),
+            applied=applied, old_len=old_len, new_len=new_len,
+            iteration=int(trainer.iteration),
+        )
+
+    def _demote(self, trainer, action: dict) -> None:
+        p = int(action["process"])
+        ckpt = trainer._find_checkpointer()
+        step = None
+        if ckpt is not None:
+            # commit the CURRENT iteration collectively (all ranks reach
+            # this point together — the decision was agreed), so the
+            # N-1 resume loses no step; a same-step re-save is an
+            # atomic overwrite
+            ckpt(trainer)
+            step = int(trainer.iteration)
+        emit(
+            "adapt_action", "adaptive.demote",
+            action="demote", process=p, checkpoint_step=step,
+            iteration=int(trainer.iteration),
+        )
+        raise DemotionRequiredError(
+            f"process {p} demoted at iteration {trainer.iteration} "
+            f"(conviction streak {action['streak']} >= "
+            f"demote_after={self.policy.demote_after}); the surviving "
+            "world re-forms at N-1 via Trainer.run_elastic and resumes "
+            + (f"from the step-{step} snapshot"
+               if step is not None else "from the newest common step"),
+            site="adaptive.demote", peer=p,
+        )
+
+
+# ----------------------------------------------------------------------
+# serving: drain the slow replica
+# ----------------------------------------------------------------------
+def drain_replica(journal, replica_index: int, *,
+                  reason: str = "straggler") -> None:
+    """Escalation for the serving tier: mark ``replica_index`` draining
+    in the :class:`~chainermn_tpu.serving.replica.RequestJournal`.  The
+    deterministic claim re-derives around draining replicas
+    (``claim(draining=...)``), so the slow replica's ``seq % n`` share
+    migrates to the healthy ones without coordination; the draining
+    replica finishes its in-flight requests and claims nothing new."""
+    journal.mark_draining(replica_index)
+    emit(
+        "adapt_decision", "adaptive.policy",
+        action="drain", process=int(replica_index), reason=reason,
+    )
+    emit(
+        "adapt_action", "adaptive.drain",
+        action="drain", replica=int(replica_index), reason=reason,
+    )

@@ -111,6 +111,31 @@ class CollectiveTraceMismatchError(ResilienceError):
     recoverable = False
 
 
+class DemotionRequiredError(ResilienceError):
+    """The adaptive policy (``resilience.adaptive``) demoted a
+    persistently slow rank: its conviction streak outlived the
+    hysteresis window, so the world must shed it.  NOT recoverable in
+    place — rolling back and replaying in the SAME world would run at
+    the straggler's pace again.  Recovery is the elastic path: the
+    surviving ranks re-form at N−1 (``Trainer.run_elastic``) and resume
+    from the snapshot the demotion committed at the decision iteration,
+    so no step is lost.  ``peer`` names the demoted process."""
+
+    recoverable = False
+
+
+class AdaptDecisionMismatchError(ResilienceError):
+    """Processes computed divergent adaptive remediation decisions for
+    the same report window (the agreement exchange of
+    ``resilience.adaptive`` — same shape as ``WirePlanMismatchError``).
+    NOT recoverable: acting apart would hand ranks different shard maps
+    or different worlds, desynchronizing every later collective — the
+    decision inputs (the allgathered metrics report) must be fixed at
+    the source."""
+
+    recoverable = False
+
+
 class RestartBudgetExceededError(ResilienceError):
     """Auto-resume gave up: more recoverable failures than
     ``max_restarts``.  Carries the last underlying error as

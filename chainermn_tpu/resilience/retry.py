@@ -107,6 +107,31 @@ def call_with_retry(fn: Callable, *, site: str, peer=None,
     ) from last
 
 
+def lockstep_allgather(comm, payload, *, site: str,
+                       max_attempts: int = 4):
+    """The agreement-shaped exchange every cross-rank token swap rides
+    (``plan_agreement`` / ``trace_agreement`` / ``newest_common_step``
+    / ``metrics_report.exchange`` / ``adaptive.agree``): allgather
+    ``payload`` over the obj store, retrying transient faults AND
+    :class:`~chainermn_tpu.resilience.errors.PayloadCorruptionError`.
+    Every process unpickles every rank's payload, so a torn payload (or
+    a transient fault) fails — and re-exchanges — on ALL ranks together
+    instead of desynchronizing the collective stream; that lockstep
+    property is what makes the retry safe here when retrying ordinary
+    one-sided host collectives would not be.  One helper so the retry
+    semantics (attempt budget, retryable set) cannot drift apart
+    between the agreement sites."""
+    from .errors import PayloadCorruptionError
+
+    return call_with_retry(
+        lambda: comm.allgather_obj(payload),
+        site=site,
+        policy=RetryPolicy(max_attempts=max_attempts),
+        retryable=lambda e: is_transient(e)
+        or isinstance(e, PayloadCorruptionError),
+    )
+
+
 def resilient_call(site: str, fn: Callable, *, peer=None,
                    policy: Optional[RetryPolicy] = None):
     """Injection-aware wrapper for operations that cannot fail

@@ -35,6 +35,7 @@ from __future__ import annotations
 
 import json
 import os
+import re
 import time
 from typing import Callable, List, Optional, Sequence
 
@@ -119,6 +120,39 @@ class RequestJournal:
         done = self.results()
         return [r for r in self.requests() if r["id"] not in done]
 
+    # -- adaptive drain -------------------------------------------------
+    # The straggler-adaptive escalation for serving (resilience.
+    # adaptive.drain_replica): a drain marker is an atomic journal file,
+    # so every replica observes the same draining set on its next claim
+    # pass — the slow replica's seq-mod share migrates to the healthy
+    # ones with no coordination beyond the shared filesystem.
+    def mark_draining(self, replica_index: int) -> None:
+        """Mark a replica draining: it claims nothing new and its
+        pending share re-derives onto the healthy replicas
+        (:func:`claim` with ``draining=``)."""
+        _atomic_write(
+            {"replica": int(replica_index)},
+            os.path.join(self.root, f"drain_{int(replica_index)}.json"),
+        )
+
+    def clear_draining(self, replica_index: int) -> None:
+        """Lift a drain marker (the replica recovered or rejoined)."""
+        try:
+            os.remove(os.path.join(
+                self.root, f"drain_{int(replica_index)}.json"
+            ))
+        except OSError:
+            pass
+
+    def draining(self) -> List[int]:
+        """Sorted indices of replicas currently marked draining."""
+        out = []
+        for name in os.listdir(self.root):
+            m = re.fullmatch(r"drain_(\d+)\.json", name)
+            if m:
+                out.append(int(m.group(1)))
+        return sorted(out)
+
     # -- fleet rendezvous ----------------------------------------------
     # The journal is the replicas' only shared state, so it is also
     # their only SAFE rendezvous: polling files can never wedge on a
@@ -155,18 +189,34 @@ class RequestJournal:
 
 
 def claim(requests: Sequence[dict], replica_index: int,
-          n_replicas: int) -> List[dict]:
+          n_replicas: int, draining: Sequence[int] = ()) -> List[dict]:
     """Deterministic share of ``requests`` for one replica: the
     journaled submission sequence number modulo the replica count.
     The seq is STABLE (stamped at submit), so concurrent replicas
     partition disjointly no matter when each one looks at the journal;
     after a world resize the survivors re-derive the partition of the
     still-pending seqs under the new count — a dead replica's share
-    migrates without coordination."""
+    migrates without coordination.
+
+    ``draining``: replica indices the adaptive layer marked draining
+    (``RequestJournal.mark_draining``).  A draining replica claims
+    nothing new; every request whose base owner is draining reassigns
+    deterministically to ``healthy[seq % len(healthy)]`` — still a pure
+    function of (seq, n_replicas, draining set), so the partition stays
+    disjoint and complete on every replica without communicating.  All
+    replicas draining falls back to the base partition: a degraded
+    world must keep serving, not wedge."""
+    dr = {int(d) for d in draining if 0 <= int(d) < n_replicas}
+    healthy = [i for i in range(n_replicas) if i not in dr]
+    if not healthy:
+        dr = set()
     out = []
     for i, r in enumerate(requests):
         seq = r.get("seq", i) if isinstance(r, dict) else i
-        if int(seq) % n_replicas == replica_index:
+        owner = int(seq) % n_replicas
+        if owner in dr:
+            owner = healthy[int(seq) % len(healthy)]
+        if owner == replica_index:
             out.append(r)
     return out
 
@@ -195,7 +245,8 @@ class DecodeReplica:
 
     def _claimed(self) -> List[dict]:
         return claim(self.journal.pending(), self.replica_index,
-                     self.n_replicas)
+                     self.n_replicas,
+                     draining=self.journal.draining())
 
     def _inflight_path(self) -> str:
         return os.path.join(

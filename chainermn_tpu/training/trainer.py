@@ -232,6 +232,14 @@ class Trainer:
                 return e.ext
         return None
 
+    def _find_adaptive(self):
+        from ..resilience.adaptive import AdaptiveExecution
+
+        for e in self._extensions:
+            if isinstance(e.ext, AdaptiveExecution):
+                return e.ext
+        return None
+
     def _auto_resume(self, error: ResilienceError) -> None:
         """Roll back to the newest common checkpoint (params, opt_state,
         iteration, iterator position).  Without a checkpointer extension
@@ -246,7 +254,7 @@ class Trainer:
             error=f"{type(error).__name__}: {error}",
         )
 
-    def run(self, max_restarts: int = 0) -> None:
+    def run(self, max_restarts: int = 0, adapt=None) -> None:
         """Run to the stop trigger.
 
         ``max_restarts``: auto-resume budget.  A *recoverable*
@@ -258,7 +266,32 @@ class Trainer:
         ``self.resilience_log``.  Exhaustion raises
         :class:`RestartBudgetExceededError` with the last failure
         chained; non-recoverable errors propagate immediately.
+
+        ``adapt``: a :class:`~chainermn_tpu.resilience.adaptive.
+        AdaptPolicy` (or ``AdaptiveExecution``) making this a
+        straggler-adaptive run: the policy consumes the attached
+        ``MetricsReport``'s convictions and rebalances/demotes per its
+        hysteresis (docs/resilience.md "Self-healing runtime").  A
+        demotion raises :class:`~chainermn_tpu.resilience.errors.
+        DemotionRequiredError` on every rank together — recovery is the
+        elastic N−1 restart, not an in-place resume.
         """
+        if adapt is not None and self._find_adaptive() is None:
+            from ..resilience.adaptive import (
+                AdaptiveExecution,
+                AdaptPolicy,
+            )
+
+            ext = (adapt if isinstance(adapt, AdaptiveExecution)
+                   else AdaptiveExecution(adapt)
+                   if isinstance(adapt, AdaptPolicy)
+                   else None)
+            if ext is None:
+                raise TypeError(
+                    f"adapt= wants an AdaptPolicy or AdaptiveExecution, "
+                    f"got {type(adapt).__name__}"
+                )
+            self.extend(ext)
         self._start_time = time.monotonic()
         _rlog.attach(self.resilience_log)
         try:
@@ -402,13 +435,42 @@ class Trainer:
 
     # -- state (for checkpointing) -------------------------------------
     def state_dict(self) -> Dict[str, Any]:
-        return {
+        out = {
             "iteration": self.iteration,
             "iterator": self.updater.iterator.serialize()
             if hasattr(self.updater.iterator, "serialize") else None,
         }
+        adaptive = self._find_adaptive()
+        if adaptive is not None:
+            # one JSON-string leaf: scalar-shaped, so it survives the
+            # elastic resharder verbatim across any N→M (the POLICY
+            # decides what a world change resets — its per-process
+            # maps — at the first observe() in the new world)
+            import json as _json
+
+            out["adaptive"] = _json.dumps(adaptive.policy.state_dict())
+        return out
 
     def load_state_dict(self, state: Dict[str, Any]) -> None:
         self.iteration = state["iteration"]
         if state.get("iterator") and hasattr(self.updater.iterator, "restore"):
             self.updater.iterator.restore(state["iterator"])
+        adaptive = self._find_adaptive()
+        raw = state.get("adaptive")
+        if adaptive is not None and raw is not None:
+            import json as _json
+
+            try:
+                doc = _json.loads(str(raw))
+                if not isinstance(doc, dict):
+                    raise TypeError(
+                        f"adaptive state decoded to "
+                        f"{type(doc).__name__}, not an object"
+                    )
+                adaptive.policy.load_state_dict(doc)
+            except (ValueError, TypeError, KeyError,
+                    AttributeError) as e:
+                warnings.warn(
+                    f"could not restore adaptive policy state "
+                    f"({type(e).__name__}: {e}); hysteresis starts fresh"
+                )

@@ -205,6 +205,21 @@ class TestRawCollectiveRule:
         assert [v for v in lint_file(path, REPO)
                 if v.rule == "raw-collective"] == []
 
+    def test_adaptive_stays_off_the_sanctioned_list(self):
+        """ISSUE 15 satellite: the straggler-adaptive policy engine is
+        a DECISION layer — its exchanges ride the obj store's audited
+        lockstep retry, never raw device collectives — so neither
+        ``resilience/adaptive.py`` nor the resilience package may ever
+        join the raw-psum sanctioned list, and the module self-lints
+        clean (raw-collective AND raw-timing)."""
+        assert not any(
+            p.startswith("chainermn_tpu/resilience") for p in SANCTIONED
+        ), "resilience/ (adaptive.py included) must stay unsanctioned"
+        path = os.path.join(
+            REPO, "chainermn_tpu", "resilience", "adaptive.py"
+        )
+        assert lint_file(path, REPO) == []
+
 
 # ----------------------------------------------------------------------
 # rule: untimed-row

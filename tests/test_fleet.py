@@ -353,6 +353,626 @@ class TestScatterShardBalance64:
         assert list(counts[24:]) == [1] * (n - 24)
 
 
+class TestWeightedScatter:
+    """Satellite (ISSUE 15): explicit per-rank ``scatter_dataset``
+    weights with deterministic remainder placement — the shard map the
+    adaptive rebalance skews — pinned at N=64 alongside the existing
+    ``scatter_index`` remainder tests."""
+
+    def test_equal_weights_reproduce_equalized_remainder_pattern(self):
+        from chainermn_tpu.datasets import weighted_shard_counts
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 1000, 64
+        counts = weighted_shard_counts(n, [1.0] * size)
+        legacy = []
+        for r in range(size):
+            _o, s, e = scatter_index(n, size, r, equalize=False)
+            legacy.append(e - s)
+        # ties in the largest-remainder placement break to the LOWER
+        # rank, so equal weights reproduce the equalized split's
+        # "first rem ranks absorb the remainder" exactly
+        assert counts == legacy == [16] * 40 + [15] * 24
+
+    def test_weighted_remainder_pattern_n64_pinned(self):
+        from chainermn_tpu.datasets import weighted_shard_counts
+
+        n, size = 1000, 64
+        w = [1.0] * size
+        w[5], w[9] = 0.5, 0.25
+        counts = weighted_shard_counts(n, w)
+        # deterministic largest-remainder placement: the two skewed
+        # ranks take their quota floors, the last four full-weight
+        # ranks lose the remainder — pinned exactly
+        want = [16] * 64
+        want[5], want[9] = 8, 4
+        want[60:] = [15] * 4
+        assert counts == want
+        assert sum(counts) == n
+
+    def test_equalized_weighted_split_uniform_width_full_cover(self):
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 1000, 64
+        w = [1.0] * size
+        w[5], w[9] = 0.5, 0.25
+        widths, covered = set(), set()
+        for r in range(size):
+            order, s, e = scatter_index(n, size, r, weights=w,
+                                        equalize=True)
+            widths.add(e - s)
+            covered.update(int(i) for i in order[s:e])
+        # every rank steps the same number of times per epoch (the
+        # lockstep contract a rebalance must not break): short shards
+        # wrap-pad WITHIN themselves to the widest shard
+        assert widths == {16}
+        assert covered == set(range(n))
+
+    def test_unequalized_weighted_split_is_contiguous_and_disjoint(self):
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 103, 8
+        w = [1.0] * size
+        w[3] = 0.2
+        seen = []
+        for r in range(size):
+            order, s, e = scatter_index(n, size, r, weights=w,
+                                        equalize=False)
+            seen.extend(order[s:e])
+        assert sorted(seen) == list(range(n))
+
+    def test_min_count_lift_and_validation(self):
+        from chainermn_tpu.datasets import weighted_shard_counts
+
+        # a vanishing weight still gets >= 1 sample under min_count
+        # (the equalized path's contract: np.resize of an empty shard
+        # would fabricate indices) — stolen from the largest shard
+        counts = weighted_shard_counts(10, [1.0, 1.0, 1e-9],
+                                       min_count=1)
+        assert counts == [4, 5, 1]
+        assert sum(counts) == 10
+        with pytest.raises(ValueError, match="finite and > 0"):
+            weighted_shard_counts(10, [1.0, 0.0])
+        with pytest.raises(ValueError, match="finite and > 0"):
+            weighted_shard_counts(10, [1.0, -2.0])
+        with pytest.raises(ValueError, match="cannot give"):
+            weighted_shard_counts(3, [1.0] * 8, min_count=1)
+
+    def test_rescatter_preserves_base_permutation(self):
+        from chainermn_tpu.datasets import rescatter, scatter_dataset
+
+        class _Comm:
+            process_count, process_index, rank, size = 4, 1, 1, 4
+
+            def bcast_obj(self, x, root=0):
+                return x
+
+        data = list(range(40, 57))  # 17 samples, distinct values
+        sub = scatter_dataset(data, _Comm(), shuffle=True, seed=7)
+        w = [1.0, 0.5, 1.0, 1.0]
+        sub2 = rescatter(sub, w)
+        # same base permutation re-split: the union of unique indices
+        # over all ranks is still the whole dataset, and this rank's
+        # spec records the agreed weights
+        assert sub2.scatter_spec["weights"] == tuple(w)
+        np.testing.assert_array_equal(sub2.base_order, sub.base_order)
+        # a plain SubDataset without scatter metadata is refused
+        from chainermn_tpu.datasets import SubDataset
+
+        bare = SubDataset(data, np.arange(17), 0, 5)
+        with pytest.raises(ValueError, match="scatter_dataset"):
+            rescatter(bare, w)
+
+
+# ----------------------------------------------------------------------
+class TestAdaptPolicy:
+    """Tentpole (ISSUE 15): the hysteresis state machine, unit-pinned
+    at fleet widths with no processes."""
+
+    def _policy(self, **kw):
+        from chainermn_tpu.resilience.adaptive import AdaptPolicy
+
+        kw.setdefault("rebalance_after", 1)
+        kw.setdefault("demote_after", 3)
+        kw.setdefault("cooldown_windows", 1)
+        return AdaptPolicy(**kw)
+
+    def test_escalation_rebalance_cooldown_demote(self):
+        p = self._policy()
+        a1 = p.observe([3], world=16, iteration=1)
+        assert a1[0]["action"] == "rebalance"
+        assert a1[0]["weights"][3] == 0.5  # skewed away from the host
+        # cooldown blocks the next window entirely
+        assert p.observe([3], world=16, iteration=2) == []
+        a3 = p.observe([3], world=16, iteration=3)
+        assert a3 == [{"action": "demote", "process": 3, "streak": 3,
+                       "iteration": 3}]
+
+    def test_flap_suppression_decays_streak(self):
+        # slow / recovered / slow / recovered ... never reaches the
+        # demote threshold: a healthy window decays the streak
+        p = self._policy(max_rebalances=0)
+        for i, conv in enumerate(
+            [[5], [], [5], [], [5], [], [5], []], start=1
+        ):
+            actions = p.observe(conv, world=16, iteration=i)
+            assert actions == [], (i, actions)
+            assert p.streaks.get(5, 0) <= 1
+
+    def test_two_simultaneous_stragglers_one_weighted_map(self):
+        p = self._policy()
+        a = p.observe([3, 9], world=64, iteration=1)
+        assert len(a) == 1 and a[0]["action"] == "rebalance"
+        assert a[0]["processes"] == [3, 9]
+        w = a[0]["weights"]
+        assert len(w) == 64
+        assert w[3] == w[9] == 0.5 and w[0] == 1.0
+
+    def test_max_rebalances_caps_the_skew(self):
+        p = self._policy(demote_after=99, cooldown_windows=0,
+                         max_rebalances=2)
+        kinds = [p.observe([7], world=16, iteration=i)
+                 for i in range(1, 5)]
+        assert [bool(k) for k in kinds] == [True, True, False, False]
+        assert p.weights[7] == 0.25  # 0.5 ** 2, floored far above min
+
+    def test_demote_picks_highest_streak_then_lowest_index(self):
+        p = self._policy(rebalance_after=99, cooldown_windows=0)
+        p.observe([2, 9], world=16, iteration=1)
+        p.observe([2, 9], world=16, iteration=2)
+        p.observe([9], world=16, iteration=3)
+        a = p.observe([2, 9], world=16, iteration=4)
+        # 9 has streak 4, 2 decayed to 2 (healthy window 3): 9 wins
+        assert a[0] == {"action": "demote", "process": 9, "streak": 4,
+                        "iteration": 4}
+
+    def test_state_round_trips_and_resets_on_world_change(self):
+        from chainermn_tpu.resilience.adaptive import AdaptPolicy
+
+        p = self._policy()
+        p.observe([3], world=16, iteration=1)
+        p.observe([3], world=16, iteration=2)
+        sd = p.state_dict()
+        q = AdaptPolicy()
+        q.load_state_dict(sd)
+        assert q.streaks == {3: 2} and q.world == 16
+        assert q.weights[3] == 0.5
+        assert q.totals["rebalance"] == 1
+        # same world: hysteresis continues where it left off
+        q2 = AdaptPolicy(demote_after=3)
+        q2.load_state_dict(sd)
+        a = q2.observe([3], world=16, iteration=3)
+        assert a[0]["action"] == "demote"
+        # resized world: per-process maps reset (indices renamed),
+        # run totals survive, the reset is observable
+        q.observe([], world=15, iteration=9)
+        assert q.streaks == {} and q.weights is None
+        assert q.last_reset == (16, 15)
+        assert q.totals["rebalance"] == 1
+
+    def test_validation_is_eager(self):
+        from chainermn_tpu.resilience.adaptive import AdaptPolicy
+
+        with pytest.raises(ValueError, match="thresholds"):
+            AdaptPolicy(rebalance_after=0)
+        with pytest.raises(ValueError, match="rebalance_skew"):
+            AdaptPolicy(rebalance_skew=1.0)
+        with pytest.raises(ValueError, match="unknown actions"):
+            AdaptPolicy(actions=("rebalance", "restart"))
+
+
+class _AgreeComm:
+    """Mocked obj store for the decision agreement: optionally flaky
+    (torn payload) for the first ``flaky`` exchanges, then returns the
+    scripted peer payloads + this rank's own."""
+
+    def __init__(self, n, flaky=0, peers=None):
+        self.process_count = self.size = n
+        self.process_index = 0
+        self._flaky = flaky
+        self._peers = peers
+        self.exchanges = 0
+
+    def allgather_obj(self, mine):
+        from chainermn_tpu.resilience.errors import (
+            PayloadCorruptionError,
+        )
+
+        self.exchanges += 1
+        if self._flaky > 0:
+            self._flaky -= 1
+            raise PayloadCorruptionError(
+                "decision payload failed to unpickle",
+                site="obj_store.exchange",
+            )
+        peers = (self._peers if self._peers is not None
+                 else [mine] * (self.process_count - 1))
+        return [mine] + list(peers)
+
+
+class TestAdaptiveAgreement:
+    """Satellite (CI/lint): every policy exchange rides the existing
+    lockstep retry — a torn payload during the rebalance agreement is
+    retried on all ranks together, and a genuinely divergent decision
+    raises on every rank before anyone acts."""
+
+    def _ext(self, comm):
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+        )
+
+        return AdaptiveExecution(AdaptPolicy(), comm=comm)
+
+    def test_torn_rebalance_agreement_retried_in_lockstep(self):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        comm = _AgreeComm(16, flaky=1)
+        ext = self._ext(comm)
+        actions = [{"action": "rebalance", "processes": [3],
+                    "weights": [1.0] * 16, "iteration": 4}]
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            ext._agree(4, actions)
+        finally:
+            detach(slog)
+        assert comm.exchanges == 2  # torn once, re-exchanged
+        assert slog.counts.get("retry") == 1
+        assert slog.events("retry")[0].site == "adaptive.agree"
+
+    def test_divergent_decision_raises_on_every_rank(self):
+        from chainermn_tpu.resilience.errors import (
+            AdaptDecisionMismatchError,
+        )
+
+        comm = _AgreeComm(4, peers=['{"other": "decision"}'] * 3)
+        ext = self._ext(comm)
+        with pytest.raises(AdaptDecisionMismatchError,
+                           match="diverged at iteration 7"):
+            ext._agree(7, [{"action": "demote", "process": 1}])
+
+    def test_exhausted_retries_surface_the_transient_taxonomy(self):
+        from chainermn_tpu.resilience.errors import TransientCommError
+
+        comm = _AgreeComm(4, flaky=99)
+        ext = self._ext(comm)
+        with pytest.raises(TransientCommError):
+            ext._agree(1, [{"action": "demote", "process": 1}])
+
+
+class _StubReport:
+    """Just enough MetricsReport surface for the extension."""
+
+    def __init__(self, comm=None):
+        self._comm = comm
+        self.last_report = None
+        self.straggler_processes = []
+
+    def window(self, iteration, stragglers):
+        self.last_report = {"iteration": iteration, "rows": [],
+                            "stragglers": list(stragglers)}
+        self.straggler_processes = list(stragglers)
+
+
+class TestAdaptiveExecution:
+    """The extension half of the tentpole: conviction stream in,
+    applied rebalance / collective demotion out."""
+
+    def _trainer(self, dataset):
+        from chainermn_tpu.iterators import SerialIterator
+        from chainermn_tpu.training.trainer import Trainer, Updater
+
+        it = SerialIterator(dataset, 2, shuffle=False)
+        return Trainer(Updater(it, lambda *a: None, None, None),
+                       stop_trigger=(1, "iteration"))
+
+    def _scattered(self, n_shards=4, rank=0, n=40):
+        from chainermn_tpu.datasets import scatter_dataset
+
+        class _Comm:
+            process_count, process_index = n_shards, rank
+            size = n_shards
+            rank_ = rank
+
+            def bcast_obj(self, x, root=0):
+                return x
+
+        return scatter_dataset(list(range(n)), _Comm(), shuffle=False,
+                               seed=0)
+
+    def test_rebalance_rescatters_live_iterator_and_remaps_cursor(self):
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+        )
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        sub = self._scattered()  # 40 samples, 4 shards: width 10
+        trainer = self._trainer(sub)
+        saved = []
+
+        class _Ckpt:
+            def restore_trainer(self, t):
+                return None
+
+            def __call__(self, t):
+                saved.append(t.iteration)
+
+        trainer.extend(_Ckpt())
+        for _ in range(3):  # advance the cursor to pos=6
+            next(trainer.updater.iterator)
+        rep = _StubReport(comm=_AgreeComm(4))
+        ext = AdaptiveExecution(AdaptPolicy(), comm=_AgreeComm(4),
+                                report=rep)
+        trainer.extend(ext)
+        ext.initialize(trainer)
+        rep.window(iteration=5, stragglers=[2])
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            ext(trainer)
+        finally:
+            detach(slog)
+        new_ds = trainer.updater.iterator.dataset
+        assert new_ds is not sub
+        assert new_ds.scatter_spec["weights"][2] == 0.5
+        # width grew 10→12 (the skewed map pads every shard to the
+        # widest) and the cursor remapped proportionally (6·12//10),
+        # computed identically on every rank
+        assert len(new_ds) == 12
+        assert trainer.updater.iterator._pos == 7
+        decisions = slog.events("adapt_decision")
+        assert [e.info["action"] for e in decisions] == ["rebalance"]
+        acts = slog.events("adapt_action")
+        assert acts[0].info["applied"] is True
+        assert slog.events("adaptive_iterator_remap")
+        # the rebalance RE-COMMITTED the current step: the higher-
+        # priority checkpointer saved before the shard map changed, so
+        # without this re-save an auto-resume would restore the old
+        # width's cursor against the new dataset (review regression)
+        assert saved == [trainer.iteration]
+        # the same window is never re-decided
+        ext(trainer)
+        assert len(slog.events("adapt_decision")) == 1
+
+    def test_demotion_raises_collectively_with_peer_and_snapshot(self):
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+        )
+        from chainermn_tpu.resilience.errors import (
+            DemotionRequiredError,
+        )
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        trainer = self._trainer(list(range(8)))
+        saved = []
+
+        class _Ckpt:  # checkpointer double: record the forced save
+            def restore_trainer(self, t):
+                return None
+
+            def __call__(self, t):
+                saved.append(t.iteration)
+
+        trainer.extend(_Ckpt())
+        trainer.iteration = 9
+        rep = _StubReport()
+        ext = AdaptiveExecution(
+            AdaptPolicy(demote_after=1, actions=("demote",)),
+            comm=_AgreeComm(4), report=rep,
+        )
+        trainer.extend(ext)
+        ext.initialize(trainer)
+        rep.window(iteration=9, stragglers=[3])
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            with pytest.raises(DemotionRequiredError) as ei:
+                ext(trainer)
+        finally:
+            detach(slog)
+        assert ei.value.peer == 3
+        assert ei.value.recoverable is False
+        assert saved == [9]  # snapshot committed before the raise
+        act = slog.events("adapt_action", "adaptive.demote")[0]
+        assert act.info["checkpoint_step"] == 9
+
+    def test_policy_state_rides_trainer_state_dict(self):
+        import json as _json
+
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+        )
+
+        trainer = self._trainer(list(range(8)))
+        rep = _StubReport()
+        ext = AdaptiveExecution(AdaptPolicy(), comm=_AgreeComm(4),
+                                report=rep)
+        trainer.extend(ext)
+        ext.initialize(trainer)
+        ext.policy.observe([1], world=4, iteration=3)
+        state = trainer.state_dict()
+        assert _json.loads(state["adaptive"])["streaks"] == {"1": 1}
+        # round-trip through a fresh trainer restores the hysteresis
+        t2 = self._trainer(list(range(8)))
+        ext2 = AdaptiveExecution(AdaptPolicy(), comm=_AgreeComm(4),
+                                 report=_StubReport())
+        t2.extend(ext2)
+        t2.load_state_dict(state)
+        assert ext2.policy.streaks == {1: 1}
+        assert ext2.policy.weights[1] == 0.5
+
+    def test_missing_report_fails_loudly_at_initialize(self):
+        from chainermn_tpu.resilience.adaptive import AdaptiveExecution
+
+        trainer = self._trainer(list(range(8)))
+        ext = AdaptiveExecution()
+        trainer.extend(ext)
+        with pytest.raises(ValueError, match="MetricsReport"):
+            ext.initialize(trainer)
+
+    def test_run_adapt_attaches_the_extension_once(self):
+        from chainermn_tpu.observability import MetricsReport
+        from chainermn_tpu.resilience.adaptive import AdaptPolicy
+
+        trainer = self._trainer(list(range(8)))
+        trainer.stop_n, trainer.stop_unit = 0, "iteration"
+        trainer.extend(MetricsReport(None, filename=None))
+        with pytest.raises(TypeError, match="AdaptPolicy"):
+            trainer.run(adapt=object())
+        policy = AdaptPolicy(demote_after=7)
+        trainer.run(adapt=policy)  # 0-iteration run: dispatch only
+        ext = trainer._find_adaptive()
+        assert ext is not None and ext.policy is policy
+        n = len(trainer._extensions)
+        trainer.run(adapt=policy)  # already attached: no duplicate
+        assert len(trainer._extensions) == n
+
+    def test_malformed_checkpointed_policy_state_degrades_gracefully(
+        self,
+    ):
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+        )
+
+        trainer = self._trainer(list(range(8)))
+        ext = AdaptiveExecution(AdaptPolicy(), comm=_AgreeComm(4),
+                                report=_StubReport())
+        trainer.extend(ext)
+        # a resharder-mangled leaf that is valid JSON but not an
+        # object must warn and start fresh, never crash the restore
+        with pytest.warns(UserWarning, match="hysteresis starts fresh"):
+            trainer.load_state_dict(
+                {"iteration": 3, "iterator": None, "adaptive": "[1, 2]"}
+            )
+        assert trainer.iteration == 3
+        assert ext.policy.streaks == {}
+
+
+class TestMetricsWarmupWindow:
+    """Satellite (ISSUE 15): the post-resume warmup-window skip — the
+    compile-dominated first window after a reshard is excluded from
+    conviction BY CONTRACT (``warmup_windows=1``), not by leaning on
+    the materiality floor."""
+
+    def _trainer(self, resumed):
+        from chainermn_tpu.resilience.log import ResilienceLog
+
+        class _T:
+            iteration = 4
+            observation = {}
+            resilience_log = ResilienceLog()
+
+        t = _T()
+        if resumed:
+            t.resilience_log.record(
+                "elastic_restart", "trainer.run_elastic",
+                restored_step=3, world=15,
+            )
+        return t
+
+    def _report(self, trainer, n=16, straggler=5, **kw):
+        """A report over a scripted N-process world, with a live
+        telemetry installed for its lifetime (uninstalled by its own
+        finalize)."""
+        from chainermn_tpu.observability import MetricsReport
+
+        rep = MetricsReport(_ScriptedSummaryComm(n, straggler),
+                            filename=None, **kw)
+        rep.initialize(trainer)
+        assert rep._own_telemetry is not None  # it owns the install
+        return rep
+
+    def test_first_post_resume_window_skipped_second_convicts(self):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        trainer = self._trainer(resumed=True)
+        rep = self._report(trainer)
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            rep(trainer)
+            # the scripted world WOULD convict (the straggler's phase
+            # is far past factor and floor) — the warmup contract
+            # skips it anyway
+            assert rep.straggler_processes == []
+            assert slog.counts.get("straggler_warmup_skip") == 1
+            assert not slog.events("straggler")
+            trainer.iteration = 5
+            rep(trainer)
+            assert rep.straggler_processes == [5]
+            assert slog.events("straggler")
+        finally:
+            detach(slog)
+            rep.finalize()
+
+    def test_fresh_run_skips_nothing(self):
+        trainer = self._trainer(resumed=False)
+        rep = self._report(trainer)
+        try:
+            rep(trainer)
+            assert rep.straggler_processes == [5]
+        finally:
+            rep.finalize()
+
+    def test_midrun_auto_resume_rearms_the_skip(self):
+        trainer = self._trainer(resumed=False)
+        rep = self._report(trainer)
+        try:
+            rep(trainer)
+            assert rep.straggler_processes == [5]
+            # an auto-resume lands on the log mid-run: the next window
+            # skips, the one after convicts again
+            trainer.resilience_log.record(
+                "restart", "obj_store.exchange",
+                restored_step=2, restarts=1,
+            )
+            trainer.iteration = 5
+            rep(trainer)
+            assert rep.straggler_processes == []
+            trainer.iteration = 6
+            rep(trainer)
+            assert rep.straggler_processes == [5]
+        finally:
+            rep.finalize()
+
+    def test_warmup_zero_opts_out(self):
+        trainer = self._trainer(resumed=True)
+        rep = self._report(trainer, warmup_windows=0)
+        try:
+            rep(trainer)
+            assert rep.straggler_processes == [5]
+        finally:
+            rep.finalize()
+
+
+class _ScriptedSummaryComm:
+    """An obj store whose allgather returns a full scripted world of
+    phase summaries (this rank's live payload replaced by script):
+    drives MetricsReport.__call__ through conviction without
+    processes."""
+
+    def __init__(self, n, straggler):
+        self.process_count = self.size = n
+        self.process_index = 0
+        self._n, self._straggler = n, straggler
+
+    def allgather_obj(self, local):
+        return list(_phase_data(self._n, {self._straggler}).values())
+
+
 class TestChainReshardBitIdentity:
     """Satellite/tentpole contract: the 16→12→14→8 ZeRO block-reshard
     CHAIN is bit-identical to a fresh partition of the global state at
@@ -562,3 +1182,75 @@ class TestFleetSmoke8:
         # every leg-1 process re-agreed and resumed
         restarts = rep.events("elastic_restart")
         assert sorted(e["process"] for e in restarts) == [0, 1, 2, 3, 4, 5]
+
+
+@pytest.mark.multiprocess
+class TestAdaptiveSmoke8:
+    def test_migrating_straggler_rebalance_then_demote_8_to_7(
+        self, tmp_path
+    ):
+        """The self-healing-runtime tier-1 smoke (ISSUE 15 acceptance,
+        8-process shape): a straggler migrates 3→5 across report
+        windows; the policy REBALANCES each conviction (weighted
+        re-scatter agreed through the lockstep exchange, live iterator
+        cursor remapped) and, when rank 5's streak outlives the
+        hysteresis window, DEMOTES it — a snapshot committed at the
+        decision iteration, ``DemotionRequiredError`` on every rank
+        together.  The 7-process resume leg reshards onto the numpy
+        sgd+momentum oracle from exactly that step, and the merged
+        report asserts detect→decide→act→recover end to end."""
+        sched = (FaultSchedule()
+                 .straggler(3, window=(1, 2), delay=0.6)
+                 .straggler(5, window=(3, 12), delay=0.6))
+        world = FleetWorld(8, str(tmp_path), schedule=sched,
+                           budget_s=SMOKE_BUDGET_S, label="leg0")
+        from chainermn_tpu.fleet import REAPED
+
+        res = world.launch(
+            "adaptive_leg",
+            {"n_steps": 12, "demote_after": 3, "linger_s": 1.5},
+            expect_exit={p: REAPED for p in range(8)},
+        )
+        p1 = res.payloads()
+        assert sorted(p1) == list(range(8))
+        d = p1[0]["iteration"]
+        for p in p1.values():
+            assert p["demoted"] == 5  # the MIGRATED-to rank, never 3
+            assert p["iteration"] == d
+            assert p["oracle_match"] is True
+            assert p["n_rebalances"] >= 1
+            assert p["rebalance_applied"] is True
+            assert 3 in p["stragglers"] and 5 in p["stragglers"]
+        # resume leg: 8→7 through the checkpoint resharder, from
+        # exactly the demotion's snapshot — no step lost
+        res2 = FleetWorld(7, str(tmp_path), budget_s=SMOKE_BUDGET_S,
+                          label="leg1").launch(
+            "chain_leg",
+            {"n_steps": d + 3, "wave_at": None, "lr": 0.1, "mom": 0.9,
+             "dim": 4, "straggler": False, "report_every": 1},
+            expect_exit={},
+        )
+        for p in res2.payloads().values():
+            assert p["resumed_step"] == d
+            assert p["resized"] == [8, 7]
+            assert p["oracle_match"] is True
+            assert p["iteration"] == d + 3
+        rep = FleetReport.from_scratch(str(tmp_path))
+        rep.assert_order(
+            "fault_injected", "straggler", "adapt_decision",
+            "world_reformed", "elastic_reshard", "elastic_restart",
+        )
+        decisions = rep.events("adapt_decision")
+        reb = [e for e in decisions
+               if e["info"]["action"] == "rebalance"]
+        dem = [e for e in decisions if e["info"]["action"] == "demote"]
+        assert reb and dem
+        # escalation order: data was rebalanced before anyone was shed
+        assert min(e["wall"] for e in reb) < min(
+            e["wall"] for e in dem
+        )
+        assert {e["info"]["process"] for e in dem} == {5}
+        # the committed demote snapshot is the step the world resumed
+        acts = [e for e in rep.events("adapt_action")
+                if e["info"]["action"] == "demote"]
+        assert {e["info"]["checkpoint_step"] for e in acts} == {d}

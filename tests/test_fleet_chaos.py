@@ -81,6 +81,73 @@ class TestAcceptanceChain:
         assert ("leg2", 2) not in set(flagged)
 
 
+class TestAdaptiveDemoteFleet:
+    def test_adaptive_demote_16_to_15(self, tmp_path):
+        """ISSUE 15 acceptance at fleet shape (scenario
+        ``adaptive_demote``): a 16-process world with a straggler that
+        migrates 2→5 across report windows.  The policy rebalances
+        (weighted re-scatter agreed cross-rank, iterator cursor
+        remapped) on each conviction and demotes rank 5 once its streak
+        outlives the hysteresis window — snapshot committed at the
+        decision step, ``DemotionRequiredError`` on all 16 ranks
+        together.  The 15-process resume leg reshards 16→15 through the
+        bit-identical ZeRO block resharder onto the single-world numpy
+        oracle, and the merged report asserts the full
+        ``fault_injected→straggler→adapt_decision→world_reformed→
+        elastic_reshard→elastic_restart`` order on the shared
+        timeline."""
+        sched = (FaultSchedule()
+                 .straggler(2, window=(1, 2), delay=0.6)
+                 .straggler(5, window=(3, 14), delay=0.6))
+        world = FleetWorld(16, str(tmp_path), schedule=sched,
+                           budget_s=600, label="leg0")
+        res = world.launch(
+            "adaptive_leg",
+            {"n_steps": 14, "demote_after": 3, "linger_s": 2.0},
+            expect_exit={p: REAPED for p in range(16)},
+        )
+        p1 = res.payloads()
+        assert sorted(p1) == list(range(16))
+        d = p1[0]["iteration"]
+        for p in p1.values():
+            assert p["demoted"] == 5
+            assert p["iteration"] == d
+            assert p["oracle_match"] is True
+            assert p["rebalance_applied"] is True
+            # the migration is visible in every rank's convictions
+            assert 2 in p["stragglers"] and 5 in p["stragglers"]
+        res2 = FleetWorld(15, str(tmp_path), budget_s=600,
+                          label="leg1").launch(
+            "chain_leg",
+            {"n_steps": d + 3, "wave_at": None, "lr": 0.1, "mom": 0.9,
+             "dim": 4, "straggler": False, "report_every": 1},
+            expect_exit={},
+        )
+        for p in res2.payloads().values():
+            assert p["resumed_step"] == d
+            assert p["resized"] == [16, 15]
+            assert p["oracle_match"] is True
+        rep = FleetReport.from_scratch(str(tmp_path))
+        rep.assert_order(
+            "fault_injected", "straggler", "adapt_decision",
+            "world_reformed", "elastic_reshard", "elastic_restart",
+        )
+        decisions = rep.events("adapt_decision")
+        reb = [e for e in decisions
+               if e["info"]["action"] == "rebalance"]
+        dem = [e for e in decisions if e["info"]["action"] == "demote"]
+        # escalation: rebalance preceded the demotion; only the
+        # persistently slow (migrated-to) rank was shed, on all ranks
+        assert min(e["wall"] for e in reb) < min(
+            e["wall"] for e in dem
+        )
+        assert {e["info"]["process"] for e in dem} == {5}
+        assert sorted({e["process"] for e in dem}) == list(range(16))
+        # every surviving rank resumed
+        restarts = rep.events("elastic_restart")
+        assert sorted(e["process"] for e in restarts) == list(range(15))
+
+
 class TestCorrelatedSliceLoss:
     def test_slice_loss_16_procs_4_slices(self, tmp_path):
         """Correlated slice loss: 16 processes grouped into 4 synthetic

@@ -885,6 +885,112 @@ class TestReplica:
 
 
 # ----------------------------------------------------------------------
+# adaptive drain (ISSUE 15): the serving escalation of the
+# straggler-adaptive policy
+# ----------------------------------------------------------------------
+class TestAdaptiveDrain:
+    """``drain_replica`` marks the slow replica draining in the
+    journal; the deterministic ``seq % n`` claim re-derives around it,
+    so the draining replica's share migrates to healthy replicas with
+    no coordination — and every request still completes bit-identically
+    to a fresh oracle engine (the ISSUE 15 serving acceptance)."""
+
+    def test_claim_reassigns_draining_share_disjoint_complete(self):
+        docs = [{"id": f"r{i}", "seq": i} for i in range(12)]
+        shares = [claim(docs, k, 3, draining=[1]) for k in range(3)]
+        ids = [{d["id"] for d in s} for s in shares]
+        # the draining replica claims nothing; the others partition the
+        # whole stream disjointly
+        assert ids[1] == set()
+        assert ids[0] | ids[2] == {f"r{i}" for i in range(12)}
+        assert not ids[0] & ids[2]
+        # deterministic: the reassignment is a pure function of seq and
+        # the draining set, so every replica derives the same partition
+        again = [claim(docs, k, 3, draining=[1]) for k in range(3)]
+        assert [{d["id"] for d in s} for s in again] == ids
+        # base shares of healthy replicas are unchanged (only the
+        # draining replica's share moved)
+        base0 = {d["id"] for d in claim(docs, 0, 3)}
+        assert base0 <= ids[0]
+
+    def test_all_draining_falls_back_to_base_partition(self):
+        docs = [{"id": f"r{i}", "seq": i} for i in range(6)]
+        shares = [claim(docs, k, 2, draining=[0, 1]) for k in range(2)]
+        # a fully draining world must keep serving, not wedge
+        assert {d["id"] for d in shares[0]} == {"r0", "r2", "r4"}
+        assert {d["id"] for d in shares[1]} == {"r1", "r3", "r5"}
+
+    def test_journal_drain_markers_round_trip(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        assert j.draining() == []
+        j.mark_draining(2)
+        j.mark_draining(0)
+        assert j.draining() == [0, 2]
+        j.clear_draining(2)
+        assert j.draining() == [0]
+        # markers never pollute the request/result scans
+        j.submit(Request([1], 2, id="a"))
+        assert [d["id"] for d in j.requests()] == ["a"]
+        assert j.results() == {}
+
+    def test_drained_replica_share_migrates_bit_identical(
+        self, lm, tmp_path
+    ):
+        """The acceptance path: replica 1 is convicted slow and
+        drained; replica 0 completes the WHOLE stream — including the
+        migrated share — with outputs bit-identical to a fresh
+        single-engine oracle, while the drained replica claims nothing
+        new."""
+        from chainermn_tpu.resilience.adaptive import drain_replica
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        docs = [Request(p, 3, id=f"d{i}")
+                for i, p in enumerate(_prompts(91, 6))]
+        j.submit_all(docs)
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            drain_replica(j, 1, reason="convicted straggler")
+        finally:
+            detach(slog)
+        dec = slog.events("adapt_decision")
+        assert dec and dec[0].info["action"] == "drain"
+        assert dec[0].info["process"] == 1
+        assert slog.events("adapt_action", "adaptive.drain")
+        # the draining replica serves nothing new
+        drained = DecodeReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, replica_index=1, n_replicas=2)
+        assert drained.serve() == {}
+        # the healthy replica absorbs the whole stream
+        healthy = DecodeReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, replica_index=0, n_replicas=2)
+        healthy.serve()
+        assert len(j.pending()) == 0
+        oracle_eng = DecodeEngine(model, params, capacity=2,
+                                  page_size=8)
+        res = j.results()
+        for r in docs:
+            want = oracle_eng.generate(r.prompt, r.max_new_tokens)
+            assert res[r.id]["tokens"] == want, r.id
+
+    def test_cleared_drain_restores_base_claim(self, tmp_path):
+        j = RequestJournal(str(tmp_path))
+        j.submit_all([Request([1], 1, id=f"c{i}") for i in range(4)])
+        j.mark_draining(1)
+        assert claim(j.pending(), 1, 2,
+                     draining=j.draining()) == []
+        j.clear_draining(1)
+        share = claim(j.pending(), 1, 2, draining=j.draining())
+        assert {d["id"] for d in share} == {"c1", "c3"}
+
+
+# ----------------------------------------------------------------------
 # mnlint: serving is NOT part of the sanctioned comm layer
 # ----------------------------------------------------------------------
 class TestServingLint:

@@ -29,6 +29,22 @@ latencies the same wall-anchored way:
                         the old world's exit + relaunch — the
                         scheduler gap, as above)
 
+A third rung runs the scale-UP loop (ISSUE 16: a 7-process world with
+the capacity watcher, a concurrent 1-process probe publishing presence
+for a healed host, probation → agreed promote → 8-process resume from
+the decision snapshot):
+
+  probation_to_promote_ms  first ``host_returned`` manifest observed →
+                           the promote ``adapt_decision`` (the
+                           probation dwell the admission gate charges
+                           a healed host)
+  promote_to_restart_ms    the promote ``adapt_action`` (snapshot
+                           committed, admission marker posted) →
+                           ``elastic_restart`` of the N+1 world (the
+                           restart gap growth pays — amortized by
+                           ``promote_quorum`` when several hosts heal
+                           together)
+
 Honesty: the worlds timeshare the host (CI runs this on a single
 core), so these are END-TO-END wall numbers dominated by process
 launch and XLA compile, useful for DIRECTION (did recovery regress
@@ -125,6 +141,53 @@ def run_adaptive_once(scratch):
     }
 
 
+GROW_PROCS = 7
+
+
+def run_grow_once(scratch):
+    """One pass of the scale-UP loop: a healed host probes under
+    weight-0 probation while the training world's capacity watcher
+    evaluates it, the cross-rank decision promotes, and the N+1 world
+    resumes from exactly the decision snapshot."""
+    pace = FaultSchedule().pace(window=(1, 300), delay=0.2)
+    grow = FleetWorld(GROW_PROCS, scratch, schedule=pace, budget_s=300,
+                      label="grow0").start(
+        "grow_leg",
+        {"n_steps": 300, "probation_windows": 2, "promote_quorum": 1,
+         "report_every": 1, "linger_s": LINGER_S},
+    )
+    probe = FleetWorld(1, scratch, budget_s=300, label="probe0").start(
+        "probe_host",
+        {"host": f"h{GROW_PROCS}", "world": GROW_PROCS,
+         "steps_per_window": 3, "window_sleep_s": 0.25,
+         "max_windows": 400},
+    )
+    res = grow.wait(expect_exit={p: REAPED for p in range(GROW_PROCS)})
+    d = res.payloads()[0]["iteration"]
+    assert probe.wait(expect_exit={}).payloads()[0]["promoted"] is True
+    FleetWorld(GROW_PROCS + 1, scratch, budget_s=300,
+               label="grow1").launch(
+        "chain_leg",
+        {"n_steps": d + 2, "wave_at": None, "lr": 0.1, "mom": 0.9,
+         "dim": 4, "straggler": False, "report_every": 1},
+        expect_exit={},
+    )
+    rep = FleetReport.from_scratch(scratch)
+    rep.assert_order("host_returned", "probation_pass",
+                     "adapt_decision", "adapt_action",
+                     "world_reformed", "elastic_restart")
+    returned = rep.first("host_returned")["wall"]
+    decide = min(e["wall"] for e in rep.events("adapt_decision")
+                 if e["info"].get("action") == "promote")
+    act = min(e["wall"] for e in rep.events("adapt_action")
+              if e["info"].get("action") == "promote")
+    restart = rep.first("elastic_restart")["wall"]
+    return {
+        "probation_to_promote_s": decide - returned,
+        "promote_to_restart_s": restart - act,
+    }
+
+
 def _rows_for(samples, extra):
     rows = []
     for metric, vals in samples.items():
@@ -146,6 +209,7 @@ def main():
     samples = {"detect_to_reform_s": [], "reform_to_resume_s": [],
                "chain_wall_s": []}
     adaptive = {"convict_to_action_s": [], "action_to_recover_s": []}
+    growth = {"probation_to_promote_s": [], "promote_to_restart_s": []}
     for _ in range(repeats):
         scratch = tempfile.mkdtemp(prefix="fleet_bench_")
         try:
@@ -161,12 +225,25 @@ def main():
             shutil.rmtree(scratch, ignore_errors=True)
         for k, v in one.items():
             adaptive[k].append(v)
+        scratch = tempfile.mkdtemp(prefix="fleet_bench_grow_")
+        try:
+            one = run_grow_once(scratch)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+        for k, v in one.items():
+            growth[k].append(v)
     rows = _rows_for(samples, {"n_procs_wave": 8, "n_procs_resume": 6})
     rows += _rows_for(adaptive, {
         "n_procs": ADAPT_PROCS,
         "n_procs_resume": ADAPT_PROCS - 1,
         "straggler_delay_s": ADAPT_DELAY_S,
         "demote_after": ADAPT_DEMOTE_AFTER,
+    })
+    rows += _rows_for(growth, {
+        "n_procs": GROW_PROCS,
+        "n_procs_resume": GROW_PROCS + 1,
+        "probation_windows": 2,
+        "promote_quorum": 1,
     })
     return rows
 

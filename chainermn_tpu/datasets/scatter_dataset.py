@@ -67,22 +67,36 @@ def weighted_shard_counts(total: int, weights: Sequence[float], *,
     pattern exactly.  ``min_count`` lifts short shards (stealing one
     sample at a time from the currently largest shard, ties again to
     the lower rank) so an equalized weighted shard can never be empty.
+
+    An EXPLICIT zero weight is legal and means "this rank owns no
+    samples" — the probationary-host contract (scale-up: a candidate
+    runs report windows on a weight-0 shard before it may carry state).
+    Zero-weight ranks get exactly 0, never receive remainder samples,
+    and are exempt from the ``min_count`` lift; at least one weight
+    must still be positive (someone has to own the data), and negative
+    or non-finite weights stay errors.
     """
     w = np.asarray(list(weights), dtype=np.float64)
     if w.ndim != 1 or w.size == 0:
         raise ValueError(f"weights must be a non-empty 1-D sequence, "
                          f"got shape {w.shape}")
-    if not np.all(np.isfinite(w)) or np.any(w <= 0):
+    if not np.all(np.isfinite(w)) or np.any(w < 0):
         raise ValueError(
-            f"weights must be finite and > 0 (demotion, not a zero "
-            f"weight, removes a rank); got {list(weights)!r}"
+            f"weights must be finite and >= 0 (zero = a probationary "
+            f"rank owning no samples; demotion, not a negative weight, "
+            f"removes a rank); got {list(weights)!r}"
         )
+    if not np.any(w > 0):
+        raise ValueError(
+            f"at least one weight must be > 0, got {list(weights)!r}"
+        )
+    pos = w > 0
     size = int(w.size)
     total = int(total)
-    if min_count * size > total:
+    if min_count * int(np.count_nonzero(pos)) > total:
         raise ValueError(
-            f"cannot give {size} shards >= {min_count} sample(s) each "
-            f"from {total} total"
+            f"cannot give {int(np.count_nonzero(pos))} shards >= "
+            f"{min_count} sample(s) each from {total} total"
         )
     quota = total * (w / w.sum())
     counts = np.floor(quota).astype(np.int64)
@@ -92,11 +106,15 @@ def weighted_shard_counts(total: int, weights: Sequence[float], *,
         counts[int(np.argmax(counts))] -= 1
     rem = int(total - counts.sum())
     if rem > 0:
-        # largest fractional part first; ties -> lowest rank
-        take = np.lexsort((np.arange(size), -frac))[:rem]
-        counts[take] += 1
+        # largest fractional part first; ties -> lowest rank; a
+        # zero-weight rank's frac is exactly 0.0 but float noise can
+        # zero a positive rank's frac too — the remainder must land on
+        # ranks that OWN data
+        ranked = [i for i in np.lexsort((np.arange(size), -frac))
+                  if pos[i]]
+        counts[ranked[:rem]] += 1
     while True:
-        short = np.where(counts < min_count)[0]
+        short = np.where((counts < min_count) & pos)[0]
         if short.size == 0:
             break
         donor = int(np.argmax(counts))  # ties -> lowest rank
@@ -116,7 +134,13 @@ def _weighted_split(order: np.ndarray, size: int, rank: int,
     shard is padded (by wrapping ITS OWN indices — the per-shard form
     of the equal split's wrap-around pad) to the widest shard's length,
     so every rank still steps the same number of times per epoch: the
-    lockstep-SPMD contract an adaptive rebalance must not break."""
+    lockstep-SPMD contract an adaptive rebalance must not break.
+
+    A weight-0 shard owns NO samples of its own (see
+    :func:`weighted_shard_counts`); under ``equalize`` it is padded
+    from the HEAD of the epoch permutation — pure re-served padding,
+    so the probationary rank still steps in lockstep while drawing
+    nothing the data-owning ranks don't already cover."""
     if len(weights) != size:
         raise ValueError(
             f"got {len(weights)} weights for {size} shards"
@@ -132,6 +156,11 @@ def _weighted_split(order: np.ndarray, size: int, rank: int,
     for c in counts:
         seg = order[off:off + c]
         off += c
+        if c == 0:
+            # np.resize of an EMPTY segment would fabricate zeros
+            # (indices the shard never owned); a weight-0 shard's
+            # lockstep pad is the permutation's head instead
+            seg = order[:width]
         segments.append(np.resize(seg, width))  # wrap-pad within shard
     out = np.concatenate(segments)
     return out, rank * width, (rank + 1) * width

@@ -140,6 +140,36 @@ class FleetReport:
         return cls(entries)
 
     # -- queries --------------------------------------------------------
+    def filter(self, *, legs: Optional[List[str]] = None,
+               kinds: Optional[List[str]] = None,
+               processes: Optional[List[int]] = None) -> "FleetReport":
+        """A sub-report over a slice of the timeline — how a scenario
+        points :meth:`assert_order`'s FIRST-OCCURRENCE semantics at one
+        chain of interest (e.g. the legs a promotion ran on) when the
+        full merged timeline contains earlier occurrences of the same
+        kinds from unrelated legs.  ``None`` means no constraint."""
+        legs_s = None if legs is None else {str(x) for x in legs}
+        kinds_s = None if kinds is None else {str(x) for x in kinds}
+        procs_s = (None if processes is None
+                   else {int(x) for x in processes})
+        return FleetReport([
+            e for e in self.entries
+            if (legs_s is None or e["leg"] in legs_s)
+            and (kinds_s is None or e["kind"] in kinds_s)
+            and (procs_s is None or e["process"] in procs_s)
+        ])
+
+    def between(self, t0: Optional[float] = None,
+                t1: Optional[float] = None) -> "FleetReport":
+        """A wall-clock slice ``[t0, t1]`` of the timeline (either end
+        open when ``None``) — the complement of :meth:`filter` for
+        isolating one leg's span of a shared-scratch run."""
+        return FleetReport([
+            e for e in self.entries
+            if (t0 is None or e["wall"] >= float(t0))
+            and (t1 is None or e["wall"] <= float(t1))
+        ])
+
     def events(self, kind: Optional[str] = None) -> List[dict]:
         if kind is None:
             return list(self.entries)

@@ -165,6 +165,19 @@ class FaultSchedule:
                    delay=float(delay), process=int(process))
         return self
 
+    def pace(self, *, window: Sequence[int], delay: float = 0.05,
+             site: str = STEP_SITE) -> "FaultSchedule":
+        """EVERY process is slowed by the same ``delay`` for each step
+        of a window — a world-wide pace floor.  On a timeshared host
+        the natural per-step variance can rival the injected straggler
+        delays; pinning a common floor makes step-mean RATIOS (the
+        straggler rule, the probation rule) noise-robust without making
+        any process a relative straggler."""
+        lo, hi = _check_window(window)
+        self.fault(site, "delay", at=list(range(lo, hi + 1)),
+                   delay=float(delay), process=None)
+        return self
+
     def compose(self, other: "FaultSchedule") -> "FaultSchedule":
         """A new schedule carrying both spec lists (seed from ``self``;
         slice groupings must agree — two different synthetic slice

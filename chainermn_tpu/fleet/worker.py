@@ -144,6 +144,11 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
     names the previous leg's world), and runs to ``n_steps`` with
     per-iteration snapshots; the final params must land on the
     uninterrupted single-world oracle trajectory.
+
+    ``resume_wave`` composes the two: the wave leg first restores the
+    elected snapshot through the resharder (so a breathing world can be
+    preempted AGAIN after it grew), then runs the manual loop from the
+    restored step to ``wave_at`` — the absolute step the wave fires at.
     """
     import warnings
 
@@ -172,8 +177,26 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
         opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
         p0 = {"w": jnp.zeros((dim,))}
         params, opt_state = step.place(p0, opt.init(p0))
+        start = 1
+        if args.get("resume_wave"):
+            # mid-chain wave: restore the elected snapshot THROUGH the
+            # checkpoint resharder first (a throwaway Trainer carries
+            # the state templates), then run the manual loop from the
+            # restored step to the ABSOLUTE wave step
+            from chainermn_tpu.iterators import SerialIterator
+            from chainermn_tpu.training.trainer import Trainer, Updater
+
+            it = SerialIterator(rows, 2, shuffle=False)
+            t = Trainer(Updater(it, step, params, opt_state),
+                        stop_trigger=(wave_at, "iteration"))
+            with warnings.catch_warnings():
+                warnings.simplefilter("ignore")
+                restored = ckpt.restore_trainer(t)
+            assert restored is not None, "resume_wave needs a snapshot"
+            params, opt_state = t.updater.params, t.updater.opt_state
+            start = int(restored) + 1
         batch = np.stack(rows)
-        for s in range(1, wave_at):
+        for s in range(start, wave_at):
             fi.fire("trainer.update")
             params, opt_state, _m = step(params, opt_state, batch)
             ckpt.save(s, {
@@ -198,7 +221,8 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
         # through the streaming sink inside fire().
         _export_artifacts()
         print("RESULT " + json.dumps({
-            "steps_saved": wave_at - 1,
+            "steps_saved": wave_at - start,
+            "resumed_step": start - 1 if start > 1 else None,
             "w": float(np.asarray(params["w"])[0]),
         }), flush=True)
         sys.stdout.flush()
@@ -395,6 +419,246 @@ def scenario_adaptive_leg(pid, nproc, scratch, label, args):
 
 
 # ----------------------------------------------------------------------
+def scenario_grow_leg(pid, nproc, scratch, label, args):
+    """The scale-UP leg (ISSUE 16): an N-process training world runs
+    with a :class:`~chainermn_tpu.resilience.adaptive.CapacityWatcher`
+    over the shared scratch's presence manifests.  Candidate hosts
+    (concurrent 1-process ``probe_host`` worlds) publish per-window
+    manifests; the watcher holds each under probation until its probe
+    step means clear the straggler rule for ``probation_windows``
+    consecutive NEW windows, the policy holds the ready set until
+    ``promote_quorum`` hosts can join in ONE restart, and the agreed
+    decision commits a snapshot and raises
+    :class:`~chainermn_tpu.resilience.errors.PromotionRequiredError`
+    on every rank together.  The next leg (a plain ``chain_leg`` resume
+    at N+k) re-forms the world and must land on the single-world oracle
+    from exactly the decision step.
+    """
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.datasets import scatter_dataset
+    from chainermn_tpu.fleet.chain import momentum_oracle
+    from chainermn_tpu.fleet.report import export_resilience_log
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.resilience.adaptive import (
+        AdaptiveExecution,
+        AdaptPolicy,
+        CapacityWatcher,
+    )
+    from chainermn_tpu.resilience.errors import PromotionRequiredError
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+    n_steps = int(args["n_steps"])
+
+    comm = cmn.create_communicator("tpu")
+    got = _lockstep_allgather(comm, pid)
+    assert got == list(range(nproc)), got
+
+    # the SAME pieces (and checkpointer root) as every chain leg, so
+    # the N+k resume elects this leg's decision snapshot
+    opt, step, ckpt, _rows = _chain_pieces(comm, scratch, lr, mom, dim)
+    full = [np.full((dim,), 0.5, np.float32)] * (nproc * 4)
+    shard = scatter_dataset(full, comm, shuffle=False, seed=0)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    it = SerialIterator(shard, 2, shuffle=False)
+    trainer = Trainer(Updater(it, step, params, opt_state),
+                      stop_trigger=(n_steps, "iteration"))
+    trainer.extend(ckpt, trigger=(1, "iteration"))
+    trainer.extend(obs.MetricsReport(
+        comm,
+        trigger=(int(args.get("report_every", 1)), "iteration"),
+        filename=None,
+    ))
+    policy = AdaptPolicy(
+        demote_after=int(args.get("demote_after", 3)),
+        probation_windows=int(args.get("probation_windows", 2)),
+        promote_quorum=int(args.get("promote_quorum", 1)),
+        readmit_cooldown_windows=int(
+            args.get("readmit_cooldown_windows", 0)
+        ),
+    )
+    watcher = CapacityWatcher(
+        scratch,
+        probation_windows=policy.probation_windows,
+        straggler_factor=float(args.get("probe_straggler_factor", 1.5)),
+    )
+    trainer.extend(AdaptiveExecution(
+        policy, comm=comm, watcher=watcher,
+        hosts=[f"h{i}" for i in range(nproc)],
+    ))
+    restored = None
+    if args.get("resume"):
+        with warnings.catch_warnings():
+            # the resharder warns about reset trainer-template slots a
+            # wave leg's manual saves did not carry
+            warnings.simplefilter("ignore")
+            restored = ckpt.restore_trainer(trainer)
+    promote = None
+    try:
+        trainer.run()
+    except PromotionRequiredError as err:
+        promote = {"hosts": [str(h) for h in err.hosts],
+                   "new_world": int(err.new_world)}
+    # the completed prefix sits on the oracle (probation is decision
+    # state, never batch statistics)
+    w = np.asarray(trainer.updater.params["w"])
+    oracle_ok = True
+    if trainer.iteration > 0:
+        oracle = momentum_oracle(trainer.iteration, lr=lr, mom=mom,
+                                 dim=dim)
+        oracle_ok = bool(np.allclose(
+            w, oracle[trainer.iteration - 1], rtol=1e-5
+        ))
+    export_resilience_log(
+        trainer.resilience_log,
+        os.path.join(scratch, f"{label}_p{pid}_trainer_events.jsonl"),
+    )
+    out = {
+        "promote": promote,
+        "iteration": trainer.iteration,
+        "resumed_step": restored,
+        "oracle_match": oracle_ok,
+        "promote_total": policy.totals.get("promote", 0),
+        "w": float(w[0]),
+    }
+    # every rank exits together after the agreed promotion, but the
+    # exit race with the runtime's peer-death propagation is real —
+    # paperwork first, REAPED accepted (same epilogue as the demote leg)
+    finish_and_exit(out, linger_s=float(args.get("linger_s", 1.5)))
+
+
+def scenario_probe_host(pid, nproc, scratch, label, args):
+    """A returning/new host's probation protocol (ISSUE 16): a
+    1-process world that trains on a WEIGHT-0 scatter shard (pure
+    permutation-head padding — rank ``world`` of a ``world+1``-wide
+    weighted split owns no sample, so it steps at world cadence while
+    holding no state; and it mounts NO checkpointer, so the chain's
+    snapshot root is untouched).  Each report window it measures its
+    step mean through ``MetricsReport`` and publishes one presence
+    manifest (atomic tmp+rename), pacing itself to the training world's
+    window cadence.  It keeps probing until the training world's agreed
+    promote decision posts its ADMISSION marker
+    (``AdaptiveExecution._promote`` publishes it on rank 0 and
+    withdraws the presence manifest) — the candidate exits on the
+    marker; the N+k resume leg is its first participation in the
+    world.  A schedule may
+    straggle its early steps (``delay`` at ``trainer.update``): the
+    watcher holds it (``probation_hold``) until the dirty windows age
+    out, which is the heal-then-readmit path.
+    """
+    import numpy as np
+    import jax.numpy as jnp
+    import optax
+
+    import chainermn_tpu as cmn
+    from chainermn_tpu import observability as obs
+    from chainermn_tpu.datasets.scatter_dataset import scatter_index
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.optimizers import build_train_step
+    from chainermn_tpu.resilience.adaptive import (
+        admission_path,
+        clear_admission,
+        clear_presence,
+        publish_presence,
+    )
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    assert nproc == 1, "a probe is a 1-process world"
+    host = str(args["host"])
+    world = int(args.get("world", 1))  # the training world it joins
+    spw = int(args.get("steps_per_window", 3))
+    max_windows = int(args.get("max_windows", 200))
+    window_sleep = float(args.get("window_sleep_s", 0.25))
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+
+    comm = cmn.create_communicator("tpu")
+    # the candidate's shard: rank ``world`` of a ``world+1``-wide split
+    # with weight 0 — an equalized pad drawn from the permutation head,
+    # so the probe steps in world cadence while OWNING no sample
+    full = [np.full((dim,), 0.5, np.float32)] * (world * 4)
+    order, start, end = scatter_index(
+        len(full), world + 1, world,
+        weights=[1.0] * world + [0.0], equalize=True,
+    )
+    shard = [full[int(i)] for i in order[start:end]]
+    assert shard, "the equalized weight-0 shard pads, never empties"
+
+    def loss_fn(params, batch):
+        return 0.5 * jnp.sum((params["w"] - batch.mean(axis=0)) ** 2)
+
+    opt = cmn.create_multi_node_optimizer(
+        optax.sgd(lr, momentum=mom), comm, zero_redundancy=True
+    )
+    step = build_train_step(comm, loss_fn, opt, donate=False)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    it = SerialIterator(shard, 2, shuffle=False)
+    trainer = Trainer(Updater(it, step, params, opt_state),
+                      stop_trigger=(max_windows * spw, "iteration"))
+    rep = obs.MetricsReport(comm, trigger=(spw, "iteration"),
+                            filename=None)
+    trainer.extend(rep)
+
+    # a fresh probe must not read its ancestor's admission
+    clear_admission(scratch, host)
+    state = {"window": 0}
+
+    class _Promoted(Exception):
+        pass
+
+    class _Publish:
+        """Presence publisher: one manifest per report window."""
+
+        priority = 80  # after MetricsReport (120) in the same pass
+        trigger = (spw, "iteration")
+        name = "presence"
+
+        def __call__(self, t):
+            if os.path.exists(admission_path(scratch, host)):
+                # the agreed decision answered: admitted
+                raise _Promoted()
+            mean = rep.process_means("step").get(0)
+            if mean is None:
+                return  # no measurement yet — publish nothing
+            state["window"] += 1
+            publish_presence(scratch, host, window=state["window"],
+                             step_mean_s=mean)
+            # pace probe windows to the training world's cadence: the
+            # watcher only advances a streak on NEW windows, one per
+            # scan, so racing far ahead just freezes the manifest
+            time.sleep(window_sleep)
+
+    trainer.extend(_Publish())
+    promoted = False
+    admission = None
+    try:
+        trainer.run()
+    except _Promoted:
+        promoted = True
+        with open(admission_path(scratch, host)) as f:
+            admission = json.load(f)
+    clear_presence(scratch, host)  # idempotent: gone if promoted
+    return {
+        "host": host,
+        "promoted": promoted,
+        "admission": admission,
+        "windows": state["window"],
+        "steps": trainer.iteration,
+    }
+
+
+# ----------------------------------------------------------------------
 def _serving_fixture(n_requests: int):
     """Deterministic tiny LM (same seed on every process → identical
     params → greedy decode of any request is bit-identical no matter
@@ -513,6 +777,174 @@ def scenario_serving_resume(pid, nproc, scratch, label, args):
         "bit_identical": True,
         "served": sorted(served),
     }
+
+
+def scenario_serving_autoscale(pid, nproc, scratch, label, args):
+    """Load-driven autoscale over a pool of resident replica slots
+    (ISSUE 16): every process is one slot serving in pool mode
+    (``serve(until_complete=...)``); the highest slots start
+    drain-marked (standbys).  Process 0 is ALSO the single decision
+    maker: it trickles the offered load into the journal and runs one
+    :class:`~chainermn_tpu.serving.replica.ReplicaAutoscaler` observe
+    per decision window — the opening burst's backlog scales the pool
+    UP (``clear_draining``; the standby's ``seq % n`` share re-derives
+    on its next claim pass), and the post-load calm scales it back DOWN
+    to ``min_replicas`` (``mark_draining``).  The atomic drain markers
+    are the only coordination.  Every request completes bit-identically
+    to a fresh single-engine oracle; an ownership handoff at an
+    activation instant may duplicate decode WORK (the claim is
+    lease-free by design), but greedy decode is deterministic and
+    result writes are idempotent overwrites, so never a result."""
+    import threading
+
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.replica import (
+        DecodeReplica,
+        ReplicaAutoscaler,
+        RequestJournal,
+    )
+
+    n_requests = int(args.get("n_requests", 30))
+    burst = int(args.get("burst", 18))
+    wave = int(args.get("wave", 4))
+    min_replicas = int(args.get("min_replicas", 2))
+    observe_s = float(args.get("observe_s", 0.4))
+    serve_timeout = float(args.get("serve_timeout_s", 200.0))
+    model, params, stream = _serving_fixture(n_requests)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    if pid == 0:
+        # standbys first (markers must precede any claimable work),
+        # then the opening burst
+        for slot in range(min_replicas, nproc):
+            journal.mark_draining(slot)
+        journal.submit_all([Request(p, m, id=i)
+                            for i, p, m in stream[:burst]])
+    # journal-level rendezvous (no collectives: autoscale must never
+    # couple the slots' control planes)
+    journal.wait_until(burst)
+    replica = DecodeReplica(_serving_engine(model, params), journal,
+                            replica_index=pid, n_replicas=nproc)
+
+    def serve():
+        return replica.serve(until_complete=n_requests,
+                             timeout_s=serve_timeout)
+
+    if pid != 0:
+        served = serve()
+        journal.wait_until_complete(n_requests,
+                                    timeout_s=serve_timeout)
+        return {"served": sorted(served), "replica": pid,
+                "was_standby": pid >= min_replicas}
+
+    # process 0: its replica slot serves in a thread; the main thread
+    # is the pool's one decision maker
+    served_box = {}
+    t = threading.Thread(target=lambda: served_box.update(serve()))
+    t.start()
+    scaler = ReplicaAutoscaler(
+        journal, nproc, min_replicas=min_replicas,
+        queue_per_replica=int(args.get("queue_per_replica", 4)),
+        scale_after=int(args.get("scale_after", 2)),
+        cooldown_windows=int(args.get("cooldown_windows", 1)),
+    )
+    actions = []
+    submitted = burst
+    deadline = time.monotonic() + serve_timeout
+    while time.monotonic() < deadline:
+        if submitted < n_requests:  # the trickle behind the burst
+            nxt = stream[submitted:submitted + wave]
+            journal.submit_all([Request(p, m, id=i)
+                                for i, p, m in nxt])
+            submitted += len(nxt)
+        a = scaler.observe()
+        if a:
+            actions.append(a)
+        done = len(journal.results()) >= n_requests
+        # keep observing through the post-load calm until the pool has
+        # breathed back down — relief at an empty queue is the
+        # scale-down signal, exactly like a real idle pool
+        if (done and scaler.totals["scale_down"] >= 1
+                and len(scaler.active()) <= min_replicas):
+            break
+        time.sleep(observe_s)
+    t.join(timeout=60)
+    results = journal.results()
+    assert len(results) == n_requests, (len(results), n_requests)
+    oracle_eng = _serving_engine(model, params)
+    mismatches = [
+        rid for rid, prompt, max_new in stream
+        if results[rid]["tokens"] != oracle_eng.generate(prompt, max_new)
+    ]
+    assert not mismatches, mismatches
+    return {
+        "served": sorted(served_box), "replica": 0,
+        "actions": actions,
+        "totals": dict(scaler.totals),
+        "active_final": scaler.active(),
+    }
+
+
+def scenario_serving_drain_cycle(pid, nproc, scratch, label, args):
+    """Drain -> heal -> re-claim, end to end (ISSUE 16 satellite):
+    replica ``nproc-1`` starts drain-marked (``drain_replica`` — the
+    adaptive-layer entry point, so the report carries the decision
+    trail) and polls as a standby while the healthy replicas complete
+    batch 1, the drained slot's reassigned share included.  Once batch
+    1 is fully served — the queue is empty, so ownership can change
+    with NOTHING pending — process 0 lifts the marker and submits batch
+    2: the returned replica re-derives its pure ``seq % n`` share of
+    the new work.  With the marker flip at a pending-empty instant the
+    shares are disjoint BY CONSTRUCTION (same seqs, same draining set
+    on every reader): no request is served twice and none is
+    orphaned."""
+    import threading
+
+    from chainermn_tpu.resilience.adaptive import drain_replica
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.replica import DecodeReplica, RequestJournal
+
+    b1 = int(args.get("batch1", 12))
+    b2 = int(args.get("batch2", 12))
+    total = b1 + b2
+    serve_timeout = float(args.get("serve_timeout_s", 200.0))
+    model, params, stream = _serving_fixture(total)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    drained = nproc - 1
+    if pid == 0:
+        drain_replica(journal, drained)
+        journal.submit_all([Request(p, m, id=i)
+                            for i, p, m in stream[:b1]])
+    journal.wait_until(b1)
+    replica = DecodeReplica(_serving_engine(model, params), journal,
+                            replica_index=pid, n_replicas=nproc)
+
+    def serve():
+        return replica.serve(until_complete=total,
+                             timeout_s=serve_timeout)
+
+    if pid != 0:
+        served = serve()
+        journal.wait_until_complete(total, timeout_s=serve_timeout)
+        return {"served": sorted(served), "replica": pid}
+    served_box = {}
+    t = threading.Thread(target=lambda: served_box.update(serve()))
+    t.start()
+    # batch 1 completes WITHOUT the drained slot: its share migrated
+    journal.wait_until_complete(b1, timeout_s=serve_timeout)
+    assert journal.draining() == [drained], journal.draining()
+    journal.clear_draining(drained)  # heal: re-admit the slot
+    journal.submit_all([Request(p, m, id=i)
+                        for i, p, m in stream[b1:]])
+    results = journal.wait_until_complete(total, timeout_s=serve_timeout)
+    t.join(timeout=60)
+    oracle_eng = _serving_engine(model, params)
+    mismatches = [
+        rid for rid, prompt, max_new in stream
+        if results[rid]["tokens"] != oracle_eng.generate(prompt, max_new)
+    ]
+    assert not mismatches, mismatches
+    return {"served": sorted(served_box), "replica": 0,
+            "batch1": b1, "batch2": b2}
 
 
 # ----------------------------------------------------------------------

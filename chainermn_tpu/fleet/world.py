@@ -207,20 +207,24 @@ class FleetWorld:
         return env
 
     # -- launch ---------------------------------------------------------
-    def launch(self, scenario: str, args: Optional[dict] = None,
-               *, expect_exit: Optional[Dict[int, object]] = None
-               ) -> FleetResult:
-        """Spawn the world, wait under the budget, return the result.
+    def start(self, scenario: str, args: Optional[dict] = None
+              ) -> "FleetWorld":
+        """Spawn the world WITHOUT blocking and return ``self``.
 
-        ``args`` is delivered to every worker as a JSON argv (the
-        scenario's parameter block).  ``expect_exit`` forwards to
-        :meth:`FleetResult.assert_ok` when given; without it the caller
-        asserts explicitly.
-        """
+        The async half of :meth:`launch` — how a driver runs several
+        worlds concurrently (the scale-up scenario: an N-proc training
+        world plus 1-proc probe worlds publishing presence manifests
+        into the same scratch).  Collect with :meth:`wait`; the budget
+        clock starts here."""
+        if getattr(self, "_pending", None) is not None:
+            raise RuntimeError(
+                f"fleet world '{self.label}' already started — wait() "
+                "first"
+            )
         port = _free_port()
         args_json = json.dumps(args or {})
-        outs = []
-        procs = []
+        outs: list = []
+        procs: list = []
         t0 = time.monotonic()
         try:
             for i in range(self.n_procs):
@@ -234,6 +238,36 @@ class FleetWorld:
                     env=self.env_for(i), stdout=out,
                     stderr=subprocess.STDOUT,
                 ))
+        except BaseException:
+            # never leave a half-launched world running; close the
+            # output file a failed Popen orphaned
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+            for out in outs:
+                out.close()
+            raise
+        self._pending = (scenario, outs, procs, t0)
+        return self
+
+    def running(self) -> bool:
+        """True while any started process is still alive."""
+        if getattr(self, "_pending", None) is None:
+            return False
+        return any(p.poll() is None for p in self._pending[2])
+
+    def wait(self, *, expect_exit: Optional[Dict[int, object]] = None
+             ) -> FleetResult:
+        """Block until the started world exits (or its budget — counted
+        from :meth:`start` — expires), collect outputs, and return the
+        :class:`FleetResult`."""
+        if getattr(self, "_pending", None) is None:
+            raise RuntimeError(
+                f"fleet world '{self.label}' was never started"
+            )
+        scenario, outs, procs, t0 = self._pending
+        self._pending = None
+        try:
             deadline = t0 + self.budget_s
             pending = set(range(self.n_procs))
             while pending and time.monotonic() < deadline:
@@ -256,15 +290,11 @@ class FleetWorld:
                     sorted(pending),
                 ))
         finally:
-            # safety net for exceptional exits (spawn failure,
-            # interrupt): never leave a half-launched world running,
-            # and close the output file a failed Popen orphaned
-            # (outs can be one longer than procs)
+            # safety net for exceptional exits (interrupt): never
+            # leave the world running
             for p in procs:
                 if p.poll() is None:
                     p.kill()
-            for out in outs[len(procs):]:
-                out.close()
             results = []
             for i, (p, out) in enumerate(zip(procs, outs)):
                 out.flush()
@@ -277,6 +307,19 @@ class FleetWorld:
         if expect_exit is not None:
             result.assert_ok(expect_exit)
         return result
+
+    def launch(self, scenario: str, args: Optional[dict] = None,
+               *, expect_exit: Optional[Dict[int, object]] = None
+               ) -> FleetResult:
+        """Spawn the world, wait under the budget, return the result.
+
+        ``args`` is delivered to every worker as a JSON argv (the
+        scenario's parameter block).  ``expect_exit`` forwards to
+        :meth:`FleetResult.assert_ok` when given; without it the caller
+        asserts explicitly.  Equivalent to ``start(...)`` + ``wait()``.
+        """
+        self.start(scenario, args)
+        return self.wait(expect_exit=expect_exit)
 
     def _overrun_report(self, scenario: str, outs, procs,
                         elapsed: float, stuck: Sequence[int]) -> str:

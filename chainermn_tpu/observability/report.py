@@ -233,6 +233,25 @@ class MetricsReport:
         )
         self._write(rows)
 
+    # -- consumers -----------------------------------------------------
+    def process_means(self, phase: str = "step") -> Dict[int, float]:
+        """Per-process mean SECONDS for ``phase`` from the last report
+        window (empty before the first window, or when the phase went
+        unrecorded).  The capacity layer's probation rule compares a
+        candidate host's probe-window step mean against the world's
+        medians through this accessor — the same numbers the straggler
+        detector convicts on, read back out of the aggregated rows."""
+        rep = self.last_report
+        if not rep:
+            return {}
+        for row in rep.get("rows") or []:
+            if row.get("phase") == phase:
+                return {
+                    int(p): float(m) / 1e3
+                    for p, m in (row.get("process_mean_ms") or {}).items()
+                }
+        return {}
+
     # -- aggregation ---------------------------------------------------
     def _aggregate(self, by_proc: Dict[int, dict], iteration: int,
                    means_map: Optional[Dict[str, Dict[int, float]]]

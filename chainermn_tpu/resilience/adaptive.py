@@ -24,6 +24,24 @@ engine that closes the loop, with three escalating remediation actions:
   draining in the ``RequestJournal``; the deterministic ``seq % n``
   claim re-derives around it, so its share migrates to healthy replicas
   without coordination (``serving.replica.claim(draining=...)``).
+* **promote** — the UPWARD direction (scale-up): a returning or new
+  host announces itself with a presence manifest on the shared scratch
+  (:func:`publish_presence` — the same atomic tmp+rename contract as
+  the serving journal) and runs probe windows on a weight-0
+  ``scatter_dataset`` shard, carrying no state.  The
+  :class:`CapacityWatcher` admits it under **health probation**: only
+  NEW probe windows count, each must clear the straggler rule
+  (candidate step mean ≤ ``straggler_factor`` × the world's
+  leave-one-out-style median of per-process step means) and
+  ``probation_windows`` consecutive clean windows are required — a
+  dirty window resets the streak.  A host demoted earlier re-enters
+  through the SAME gate after ``readmit_cooldown_windows`` report
+  windows (the policy's ``host_history`` survives world resizes, keyed
+  by host id, not process index).  The promote decision snapshots at
+  the decision iteration and raises
+  :class:`~chainermn_tpu.resilience.errors.PromotionRequiredError` on
+  every rank together; the relaunched world re-forms at N+k and
+  ``Trainer.run_elastic`` reshards the ZeRO blocks bit-identically.
 
 Decisions are cross-rank agreed before any rank acts: every report
 window exchanges the decision payload over the obj store — action-free
@@ -58,13 +76,220 @@ decide → act → recover end to end.
 from __future__ import annotations
 
 import json
+import os
+import re
+from statistics import median
 from typing import Dict, List, Mapping, Optional, Sequence
 
-from .errors import AdaptDecisionMismatchError, DemotionRequiredError
+from .errors import (
+    AdaptDecisionMismatchError,
+    DemotionRequiredError,
+    PromotionRequiredError,
+)
 from .log import emit
 from .retry import lockstep_allgather
 
 AGREEMENT_SITE = "adaptive.agree"
+
+# capacity manifests live under <scratch>/presence/ — the shared-FS
+# announcement channel returning/new hosts publish into
+PRESENCE_DIR = "presence"
+_PRESENCE_RE = re.compile(r"host_(.+)\.json$")
+
+
+def presence_path(scratch: str, host: str) -> str:
+    return os.path.join(scratch, PRESENCE_DIR, f"host_{host}.json")
+
+
+def publish_presence(scratch: str, host: str, *, window: int,
+                     step_mean_s: Optional[float] = None,
+                     state: str = "candidate") -> str:
+    """A candidate host's heartbeat: one atomic (tmp+rename — the
+    serving-journal/manifest contract, so a reader never sees a torn
+    file) JSON manifest under ``<scratch>/presence/``, overwritten per
+    probe window.  ``window`` is the candidate's own monotonically
+    advancing probe-window counter — the :class:`CapacityWatcher` only
+    counts a window it has not seen before, so a stalled candidate
+    cannot farm probation passes off one stale manifest.
+    ``step_mean_s`` is the candidate's measured mean step seconds for
+    that window (its side of the straggler rule)."""
+    from .elastic import write_manifest
+
+    root = os.path.join(scratch, PRESENCE_DIR)
+    os.makedirs(root, exist_ok=True)
+    path = presence_path(scratch, host)
+    write_manifest({
+        "host": str(host),
+        "window": int(window),
+        "step_mean_s": (None if step_mean_s is None
+                        else float(step_mean_s)),
+        "state": str(state),
+    }, path)
+    return path
+
+
+def clear_presence(scratch: str, host: str) -> None:
+    """Withdraw a host's presence manifest (promoted — it is world
+    state now — or gave up)."""
+    try:
+        os.remove(presence_path(scratch, host))
+    except OSError:
+        pass
+
+
+def admission_path(scratch: str, host: str) -> str:
+    return os.path.join(scratch, PRESENCE_DIR, f"admitted_{host}.json")
+
+
+def publish_admission(scratch: str, host: str, *,
+                      new_world: int, step: Optional[int]) -> str:
+    """The decision's answer to a candidate: an atomic marker the
+    promoted host polls for.  Withdrawal of the presence manifest alone
+    cannot signal admission — the candidate may republish its heartbeat
+    in the same instant and resurrect the file — so the marker is a
+    separate, append-only fact.  Invisible to :meth:`CapacityWatcher.
+    scan` by name (``admitted_*`` never matches the ``host_*``
+    pattern)."""
+    from .elastic import write_manifest
+
+    root = os.path.join(scratch, PRESENCE_DIR)
+    os.makedirs(root, exist_ok=True)
+    path = admission_path(scratch, host)
+    write_manifest({
+        "host": str(host),
+        "new_world": int(new_world),
+        "checkpoint_step": (None if step is None else int(step)),
+    }, path)
+    return path
+
+
+def clear_admission(scratch: str, host: str) -> None:
+    """Remove a stale admission marker (a fresh probe of a previously
+    promoted host must not read its ancestor's admission)."""
+    try:
+        os.remove(admission_path(scratch, host))
+    except OSError:
+        pass
+
+
+class CapacityWatcher:
+    """Probation accounting for returning/new hosts.
+
+    ``scan()`` reads the presence manifests (rank 0's filesystem view —
+    :class:`AdaptiveExecution` broadcasts ONE scan to all ranks, so the
+    probation state machine advances identically everywhere and the
+    promote decision is byte-identical by construction before it even
+    reaches the agreement exchange).  ``evaluate()`` is the pure step:
+    given the broadcast manifests and the world's per-process step
+    means (``MetricsReport.process_means``), it advances each
+    candidate's streak and returns the hosts that have cleared
+    probation — ``probation_windows`` consecutive NEW clean windows,
+    clean meaning the candidate's step mean is within
+    ``straggler_factor`` × the median of the world's step means: the
+    same rule that convicts stragglers, pointed at admission.
+
+    Events: first sighting emits ``host_returned``; a dirty or blocked
+    window emits ``probation_hold`` (streak reset / cooldown); clearing
+    emits ``probation_pass``.  All are per-rank, like every other
+    adaptive event — the merged fleet report dedupes nothing and shows
+    every rank reaching the same verdict."""
+
+    def __init__(self, scratch: str, *, probation_windows: int = 2,
+                 straggler_factor: float = 1.5):
+        if probation_windows < 1:
+            raise ValueError(
+                f"probation_windows must be >= 1, got {probation_windows}"
+            )
+        if straggler_factor <= 1.0:
+            raise ValueError(
+                f"straggler_factor must be > 1, got {straggler_factor}"
+            )
+        self.scratch = str(scratch)
+        self.root = os.path.join(str(scratch), PRESENCE_DIR)
+        self.probation_windows = int(probation_windows)
+        self.straggler_factor = float(straggler_factor)
+        self.returned: set = set()
+        self.passed: set = set()
+        self.seen_window: Dict[str, int] = {}
+        self.streaks: Dict[str, int] = {}
+
+    def scan(self) -> Dict[str, dict]:
+        """Read every presence manifest (torn/unparseable files are
+        skipped — the atomic-write contract means the next pass sees
+        them whole)."""
+        out: Dict[str, dict] = {}
+        try:
+            names = sorted(os.listdir(self.root))
+        except OSError:
+            return out
+        for name in names:
+            m = _PRESENCE_RE.fullmatch(name)
+            if not m:
+                continue
+            try:
+                with open(os.path.join(self.root, name)) as f:
+                    doc = json.load(f)
+            except (OSError, ValueError):
+                continue
+            if isinstance(doc, dict):
+                out[str(doc.get("host", m.group(1)))] = doc
+        return out
+
+    def evaluate(self, manifests: Mapping[str, dict],
+                 world_step_means: Mapping[int, float], *,
+                 blocked: Sequence[str] = ()) -> List[str]:
+        """Advance probation from one (broadcast) scan; return the
+        host ids currently READY for promotion, sorted.  ``blocked``:
+        hosts the policy holds out (re-admission cooldown after a
+        demotion) — sighted and reported, but their probation does not
+        advance."""
+        blocked = {str(h) for h in blocked}
+        means = [float(v) for v in (world_step_means or {}).values()]
+        med = median(means) if means else None
+        ready: List[str] = []
+        for host in sorted(manifests):
+            doc = manifests[host]
+            if host not in self.returned:
+                self.returned.add(host)
+                emit(
+                    "host_returned", "adaptive.capacity",
+                    host=host, window=doc.get("window"),
+                )
+            if host in blocked:
+                emit(
+                    "probation_hold", "adaptive.capacity",
+                    host=host, reason="readmit_cooldown",
+                )
+                continue
+            if host in self.passed:
+                ready.append(host)  # cleared earlier, not yet promoted
+                continue
+            w = int(doc.get("window", 0))
+            if w <= self.seen_window.get(host, -1):
+                continue  # no NEW probe window since the last pass
+            self.seen_window[host] = w
+            mean = doc.get("step_mean_s")
+            clean = (mean is not None and med is not None and med > 0
+                     and float(mean) <= self.straggler_factor * med)
+            if clean:
+                self.streaks[host] = self.streaks.get(host, 0) + 1
+            else:
+                self.streaks[host] = 0
+                emit(
+                    "probation_hold", "adaptive.capacity",
+                    host=host, window=w,
+                    reason=("no_measurement"
+                            if mean is None or med is None or med <= 0
+                            else "straggler"),
+                )
+            if self.streaks.get(host, 0) >= self.probation_windows:
+                self.passed.add(host)
+                emit(
+                    "probation_pass", "adaptive.capacity",
+                    host=host, windows=int(self.streaks[host]), window=w,
+                )
+                ready.append(host)
+        return sorted(ready)
 
 
 def remap_iterator_cursor(state, old_len: int, new_len: int) -> dict:
@@ -107,12 +332,28 @@ class AdaptPolicy:
     at ``min_weight``), and ``max_rebalances`` bounds how often data is
     skewed away from one rank before the only escalation left is
     demotion.  ``actions`` gates which remediations may fire at all.
+
+    Scale-up: ``ready_hosts`` (hosts the :class:`CapacityWatcher` says
+    cleared probation) turn into one ``{"action": "promote", "hosts":
+    [...], "new_world": N+k}`` decision — demote still wins the window
+    (shedding a straggler supersedes growing), promote wins over
+    rebalance (the restart makes the skew moot).  ``host_history``
+    records every demotion KEYED BY HOST ID, so unlike the per-process
+    maps it survives world resizes: ``readmit_cooldown_windows`` report
+    windows must pass before a demoted host may re-enter probation, and
+    a promoted-then-reconvicted host skips the rebalance ladder — its
+    conviction streak starts from the pre-demotion history, not fresh
+    (``hosts``, the process→host mapping, makes the link).
     """
 
     def __init__(self, *, rebalance_after: int = 1, demote_after: int = 3,
                  cooldown_windows: int = 1, rebalance_skew: float = 0.5,
                  min_weight: float = 0.125, max_rebalances: int = 2,
-                 actions: Sequence[str] = ("rebalance", "demote")):
+                 probation_windows: int = 2,
+                 readmit_cooldown_windows: int = 2,
+                 promote_quorum: int = 1,
+                 actions: Sequence[str] = ("rebalance", "demote",
+                                           "promote")):
         if rebalance_after < 1 or demote_after < 1:
             raise ValueError(
                 f"streak thresholds must be >= 1, got "
@@ -129,7 +370,20 @@ class AdaptPolicy:
             )
         if min_weight <= 0:
             raise ValueError(f"min_weight must be > 0, got {min_weight}")
-        unknown = set(actions) - {"rebalance", "demote"}
+        if probation_windows < 1:
+            raise ValueError(
+                f"probation_windows must be >= 1, got {probation_windows}"
+            )
+        if readmit_cooldown_windows < 0:
+            raise ValueError(
+                f"readmit_cooldown_windows must be >= 0, got "
+                f"{readmit_cooldown_windows}"
+            )
+        if promote_quorum < 1:
+            raise ValueError(
+                f"promote_quorum must be >= 1, got {promote_quorum}"
+            )
+        unknown = set(actions) - {"rebalance", "demote", "promote"}
         if unknown:
             raise ValueError(f"unknown actions {sorted(unknown)}")
         self.rebalance_after = int(rebalance_after)
@@ -138,6 +392,9 @@ class AdaptPolicy:
         self.rebalance_skew = float(rebalance_skew)
         self.min_weight = float(min_weight)
         self.max_rebalances = int(max_rebalances)
+        self.probation_windows = int(probation_windows)
+        self.readmit_cooldown_windows = int(readmit_cooldown_windows)
+        self.promote_quorum = int(promote_quorum)
         self.actions = tuple(actions)
         # -- mutable hysteresis state (checkpointed) --------------------
         self.world: Optional[int] = None
@@ -146,7 +403,14 @@ class AdaptPolicy:
         self.rebalances: Dict[int, int] = {}
         self.weights: Optional[List[float]] = None
         self.windows = 0
-        self.totals: Dict[str, int] = {"rebalance": 0, "demote": 0}
+        self.totals: Dict[str, int] = {"rebalance": 0, "demote": 0,
+                                       "promote": 0}
+        # demotion history KEYED BY HOST ID — survives world resizes
+        # (process indices change meaning at a resize; host ids don't):
+        # host -> {"streak": pre-demotion conviction streak, "window":
+        # the policy window it was demoted at, "promoted": re-admitted
+        # since}
+        self.host_history: Dict[str, dict] = {}
         # (old_world, new_world) of the last world-change reset, for the
         # extension to report; cleared once read
         self.last_reset = None
@@ -175,9 +439,46 @@ class AdaptPolicy:
             return list(self.weights)
         return [1.0] * int(world if world is not None else self.world or 1)
 
+    # -- host history (scale-up / re-admission) -------------------------
+    def readmit_blocked(self, host) -> bool:
+        """A demoted host may not start (or advance) probation until
+        ``readmit_cooldown_windows`` report windows after its demotion
+        — the cooldown the re-admission gate honors.  A host already
+        promoted back is never blocked by its old record."""
+        rec = self.host_history.get(str(host))
+        if rec is None or rec.get("promoted"):
+            return False
+        return self.windows < (int(rec.get("window", 0))
+                               + self.readmit_cooldown_windows)
+
+    def _effective_streak(self, p: int, hosts) -> int:
+        """Conviction streak for process ``p``, inheriting pre-demotion
+        history when ``hosts`` maps it to a promoted-then-re-admitted
+        host: the flap demote→probation→promote→convict skips straight
+        back to demote instead of climbing the rebalance ladder
+        again."""
+        s = int(self.streaks.get(p, 0))
+        if hosts is not None and 0 <= p < len(hosts):
+            rec = self.host_history.get(str(hosts[p]))
+            if rec is not None and rec.get("promoted"):
+                s += int(rec.get("streak", 0))
+        return s
+
+    def _readmitted(self, p: int, hosts) -> bool:
+        if hosts is None or not 0 <= p < len(hosts):
+            return False
+        rec = self.host_history.get(str(hosts[p]))
+        return rec is not None and bool(rec.get("promoted"))
+
     # -- the decision step ----------------------------------------------
     def observe(self, convicted: Sequence[int], *, world: int,
-                iteration: int) -> List[dict]:
+                iteration: int, ready_hosts: Sequence[str] = (),
+                hosts: Optional[Sequence[str]] = None) -> List[dict]:
+        """One report window's decision.  ``ready_hosts``: host ids the
+        :class:`CapacityWatcher` reports as having cleared probation
+        (promotion candidates).  ``hosts``: the current world's
+        process-index → host-id mapping, linking per-process streaks to
+        the host-keyed demotion history."""
         self._sync_world(world)
         self.windows += 1
         convicted = sorted({int(p) for p in convicted})
@@ -199,28 +500,62 @@ class AdaptPolicy:
                 if self.streaks[p] <= 0:
                     del self.streaks[p]
         # escalation 2: demote — one process per window (highest streak,
-        # ties to the lowest index), and nothing else that window
+        # ties to the lowest index), and nothing else that window.  The
+        # EFFECTIVE streak folds in pre-demotion history for a
+        # promoted-then-reconvicted host (flap fast-path: no second
+        # climb up the rebalance ladder).
         if "demote" in self.actions:
             cands = [p for p in convicted
-                     if self.streaks[p] >= self.demote_after
+                     if self._effective_streak(p, hosts) >= self.demote_after
                      and p not in on_cooldown]
             if cands:
-                p = min(cands, key=lambda q: (-self.streaks[q], q))
+                p = min(cands,
+                        key=lambda q: (-self._effective_streak(q, hosts), q))
+                eff = self._effective_streak(p, hosts)
                 self._arm_cooldown(p)
                 self.totals["demote"] += 1
+                if hosts is not None and 0 <= p < len(hosts):
+                    self.host_history[str(hosts[p])] = {
+                        "streak": int(eff), "window": int(self.windows),
+                        "promoted": False,
+                    }
                 return [{
                     "action": "demote", "process": int(p),
-                    "streak": int(self.streaks[p]),
+                    "streak": int(eff),
+                    "iteration": int(iteration),
+                }]
+        # scale-up: promote every ready host in one decision — wins over
+        # rebalance (the N+k restart re-derives the shard map anyway)
+        # but never fires in a demote window (shedding the straggler
+        # first keeps the two elastic transitions serialized)
+        if "promote" in self.actions and ready_hosts:
+            ready = sorted({str(h) for h in ready_hosts
+                            if not self.readmit_blocked(h)})
+            # promote_quorum amortizes world re-formations: hold the
+            # ready hosts (the watcher keeps them ready) until at least
+            # this many can join in ONE N→N+k restart
+            if ready and len(ready) >= self.promote_quorum:
+                for h in ready:
+                    rec = self.host_history.get(h)
+                    if rec is not None:
+                        rec["promoted"] = True
+                self.totals["promote"] += 1
+                return [{
+                    "action": "promote", "hosts": ready,
+                    "world": int(world),
+                    "new_world": int(world) + len(ready),
                     "iteration": int(iteration),
                 }]
         # escalation 1: rebalance — one weighted map covering every
-        # process whose streak tripped this window
+        # process whose streak tripped this window; a re-admitted host
+        # is excluded (its next conviction goes straight to demote)
         if "rebalance" in self.actions:
             targets = [
                 p for p in convicted
                 if self.streaks[p] >= self.rebalance_after
                 and p not in on_cooldown
                 and self.rebalances.get(p, 0) < self.max_rebalances
+                and not self._readmitted(p, hosts)
             ]
             if targets:
                 weights = self.current_weights(world)
@@ -255,6 +590,9 @@ class AdaptPolicy:
             else [float(w) for w in self.weights],
             "windows": int(self.windows),
             "totals": dict(self.totals),
+            "host_history": {
+                str(h): dict(rec) for h, rec in self.host_history.items()
+            },
         }
 
     def load_state_dict(self, state: Mapping) -> None:
@@ -277,9 +615,17 @@ class AdaptPolicy:
         w = state.get("weights")
         self.weights = None if w is None else [float(x) for x in w]
         self.windows = int(state.get("windows", 0))
-        self.totals = {"rebalance": 0, "demote": 0,
+        self.totals = {"rebalance": 0, "demote": 0, "promote": 0,
                        **{k: int(v)
                           for k, v in (state.get("totals") or {}).items()}}
+        # host-keyed: survives the resize reset above by design
+        self.host_history = {
+            str(h): {"streak": int(rec.get("streak", 0)),
+                     "window": int(rec.get("window", 0)),
+                     "promoted": bool(rec.get("promoted", False))}
+            for h, rec in (state.get("host_history") or {}).items()
+            if isinstance(rec, Mapping)
+        }
 
 
 class AdaptiveExecution:
@@ -292,6 +638,15 @@ class AdaptiveExecution:
     the checkpointer before raising, making "no step lost" a contract
     rather than a trigger coincidence).  ``comm=None`` borrows the
     report's communicator at initialize.
+
+    ``watcher``: a :class:`CapacityWatcher` enables the scale-up path —
+    rank 0 scans the presence manifests once per report window and
+    broadcasts the scan (``bcast_obj``), so every rank advances the
+    same probation state machine and the promote decision entering the
+    agreement exchange is identical by construction.  ``hosts`` maps
+    the current world's process indices to host ids (defaults to
+    ``h0..h{N-1}``) — the link between per-process convictions and the
+    policy's host-keyed demotion history.
     """
 
     priority = 90
@@ -299,10 +654,13 @@ class AdaptiveExecution:
     name = "adaptive"
 
     def __init__(self, policy: Optional[AdaptPolicy] = None, *,
-                 comm=None, report=None):
+                 comm=None, report=None, watcher=None,
+                 hosts: Optional[Sequence[str]] = None):
         self.policy = policy if policy is not None else AdaptPolicy()
         self._comm = comm
         self._report = report
+        self._watcher = watcher
+        self._hosts = None if hosts is None else [str(h) for h in hosts]
         self._seen_report: Optional[int] = None
 
     # -- extension protocol ---------------------------------------------
@@ -326,6 +684,8 @@ class AdaptiveExecution:
         # per-process maps lazily; surface any pending reset eagerly
         if self._comm is not None:
             self.policy._sync_world(self._world())
+        if self._hosts is None:
+            self._hosts = [f"h{i}" for i in range(self._world())]
         self._emit_reset_if_any(trainer)
 
     def _world(self) -> int:
@@ -351,8 +711,10 @@ class AdaptiveExecution:
             return  # no new report window since the last decision
         self._seen_report = rit
         convicted = list(rep.last_report.get("stragglers") or [])
+        ready = self._probation(rep)
         actions = self.policy.observe(
-            convicted, world=self._world(), iteration=trainer.iteration
+            convicted, world=self._world(), iteration=trainer.iteration,
+            ready_hosts=ready, hosts=self._hosts,
         )
         self._emit_reset_if_any(trainer)
         # EVERY report window agrees — including action-free ones: the
@@ -365,6 +727,16 @@ class AdaptiveExecution:
         if not actions:
             return
         for a in actions:
+            if a["action"] == "promote":
+                for h in a["hosts"]:
+                    emit(
+                        "adapt_decision", "adaptive.policy",
+                        action="promote", host=str(h),
+                        new_world=int(a["new_world"]),
+                        iteration=int(trainer.iteration),
+                        window=int(self.policy.windows),
+                    )
+                continue
             procs = (a["processes"] if a["action"] == "rebalance"
                      else [a["process"]])
             for p in procs:
@@ -380,6 +752,27 @@ class AdaptiveExecution:
                 self._rebalance(trainer, a)
             elif a["action"] == "demote":
                 self._demote(trainer, a)
+            elif a["action"] == "promote":
+                self._promote(trainer, a)
+
+    # -- probation (scale-up) --------------------------------------------
+    def _probation(self, rep) -> List[str]:
+        """One watcher pass per report window: rank 0 scans the presence
+        manifests, the scan is broadcast, every rank evaluates the same
+        inputs.  Returns the promotion-ready host ids (sorted)."""
+        if self._watcher is None:
+            return []
+        scan = None
+        if (self._comm is None
+                or int(getattr(self._comm, "process_index", 0)) == 0):
+            scan = self._watcher.scan()
+        if self._comm is not None and hasattr(self._comm, "bcast_obj"):
+            scan = self._comm.bcast_obj(scan, root=0)
+        means = (rep.process_means("step")
+                 if hasattr(rep, "process_means") else {})
+        blocked = {h for h in (scan or {})
+                   if self.policy.readmit_blocked(h)}
+        return self._watcher.evaluate(scan or {}, means, blocked=blocked)
 
     # -- agreement -------------------------------------------------------
     def _agree(self, iteration: int, actions: List[dict]) -> dict:
@@ -473,6 +866,44 @@ class AdaptiveExecution:
             + (f"from the step-{step} snapshot"
                if step is not None else "from the newest common step"),
             site="adaptive.demote", peer=p,
+        )
+
+    def _promote(self, trainer, action: dict) -> None:
+        hosts = [str(h) for h in action["hosts"]]
+        new_world = int(action["new_world"])
+        ckpt = trainer._find_checkpointer()
+        step = None
+        if ckpt is not None:
+            # commit the CURRENT iteration collectively before growing:
+            # the N+k resume reshards exactly this snapshot, so no step
+            # is lost across the world re-formation
+            ckpt(trainer)
+            step = int(trainer.iteration)
+        emit(
+            "adapt_action", "adaptive.promote",
+            action="promote", hosts=",".join(hosts),
+            new_world=new_world, checkpoint_step=step,
+            iteration=int(trainer.iteration),
+        )
+        # answer the candidates — rank 0 only, mirroring the rank-0
+        # scan: post each promoted host's admission marker (the fact it
+        # polls for) and withdraw its presence manifest (it is world
+        # state now, not a candidate)
+        if self._watcher is not None and (
+            self._comm is None
+            or int(getattr(self._comm, "process_index", 0)) == 0
+        ):
+            for h in hosts:
+                publish_admission(self._watcher.scratch, h,
+                                  new_world=new_world, step=step)
+                clear_presence(self._watcher.scratch, h)
+        raise PromotionRequiredError(
+            f"host(s) {', '.join(hosts)} cleared probation at iteration "
+            f"{trainer.iteration}; the world grows to {new_world} and "
+            "resumes "
+            + (f"from the step-{step} snapshot"
+               if step is not None else "from the newest common step"),
+            site="adaptive.promote", hosts=hosts, new_world=new_world,
         )
 
 

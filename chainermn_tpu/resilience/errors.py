@@ -124,6 +124,28 @@ class DemotionRequiredError(ResilienceError):
     recoverable = False
 
 
+class PromotionRequiredError(ResilienceError):
+    """The adaptive capacity layer (``resilience.adaptive``) promoted
+    one or more probationary hosts: each cleared the straggler rule for
+    ``probation_windows`` consecutive report windows, and the
+    cross-rank-agreed decision is to grow the world to ``new_world``.
+    NOT recoverable in place — the running N-rank world cannot absorb
+    new ranks mid-collective.  Recovery is the elastic path in the
+    OTHER direction from :class:`DemotionRequiredError`: every rank
+    raises together from the snapshot the promotion committed at the
+    decision iteration, and the job relaunches at N+k
+    (``Trainer.run_elastic`` reshards the ZeRO blocks bit-identically
+    onto the grown world).  ``hosts`` names the promoted host ids."""
+
+    recoverable = False
+
+    def __init__(self, message: str, *, hosts=(), new_world=None,
+                 **kwargs):
+        super().__init__(message, **kwargs)
+        self.hosts = tuple(hosts)
+        self.new_world = None if new_world is None else int(new_world)
+
+
 class AdaptDecisionMismatchError(ResilienceError):
     """Processes computed divergent adaptive remediation decisions for
     the same report window (the agreement exchange of

@@ -45,6 +45,7 @@ from .batcher import (  # noqa: F401
 )
 from .replica import (  # noqa: F401
     DecodeReplica,
+    ReplicaAutoscaler,
     RequestJournal,
     serve_elastic,
 )

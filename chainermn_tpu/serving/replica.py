@@ -29,6 +29,13 @@ drained requests resume decoding mid-stream from their restored pages
 (``PagedKVCache.load_state_dict``) instead of replaying the prompt;
 across a TP resize the pages re-split by heads via
 :func:`~chainermn_tpu.serving.kv_cache.reshard_kv_state`.
+
+Autoscale: :class:`ReplicaAutoscaler` sizes the pool from offered load
+(journal queue depth + p99 token latency) by lifting and placing the
+same drain markers — scale-up re-activates a drain-marked standby
+(which sat polling in ``serve(until_complete=...)``), scale-down
+drains the highest active slot; hysteresis mirrors ``AdaptPolicy`` so
+the pool doesn't flap.
 """
 
 from __future__ import annotations
@@ -187,6 +194,22 @@ class RequestJournal:
         return self._poll_until(self.results, n, "results",
                                 timeout_s, poll_s)
 
+    def wait_draining_clear(self, replica_index: int, *,
+                            timeout_s: float = 60.0,
+                            poll_s: float = 0.05) -> None:
+        """Block until ``replica_index`` is no longer drain-marked —
+        how a standby replica waits for the autoscaler (or an
+        operator's ``clear_draining``) to activate it.  Raises
+        ``TimeoutError`` past ``timeout_s``."""
+        deadline = time.monotonic() + timeout_s
+        while int(replica_index) in self.draining():
+            if time.monotonic() > deadline:
+                raise TimeoutError(
+                    f"journal {self.root}: replica {replica_index} "
+                    f"still draining after {timeout_s:.0f}s"
+                )
+            time.sleep(poll_s)
+
 
 def claim(requests: Sequence[dict], replica_index: int,
           n_replicas: int, draining: Sequence[int] = ()) -> List[dict]:
@@ -330,21 +353,46 @@ class DecodeReplica:
                 self.journal.write_result(r)
                 served[r.id] = r
 
-    def serve(self, max_rounds: Optional[int] = None) -> dict:
+    def serve(self, max_rounds: Optional[int] = None, *,
+              until_complete: Optional[int] = None,
+              poll_s: float = 0.05,
+              timeout_s: float = 120.0) -> dict:
         """Claim -> serve -> write results, until the journal share is
         empty.  A :class:`PreemptionError` drains instead of crashing:
         already-finished results are flushed (done work never replays),
         and the loop exits cleanly with unserved requests still
-        journaled (the survivors' next claim covers them)."""
+        journaled (the survivors' next claim covers them).
+
+        ``until_complete``: pool mode — an empty share POLLS the
+        journal instead of exiting, until at least that many results
+        exist stream-wide.  This is how a drain-marked standby stays
+        resident (claiming nothing) and picks up its re-derived share
+        the moment the autoscaler lifts its marker, and how an active
+        replica keeps serving as load arrives.  Raises ``TimeoutError``
+        past ``timeout_s`` of total serving time in pool mode."""
         rounds = 0
         served = {}
+        deadline = (time.monotonic() + timeout_s
+                    if until_complete is not None else None)
         while True:
             _fi.fire("serving.replica_round")
             in_flight = {r.id for r in self.batcher.active.values()}
             todo = [d for d in self._claimed()
                     if d["id"] not in in_flight]
             if not todo and not in_flight:
-                break
+                if until_complete is None:
+                    break
+                if len(self.journal.results()) >= until_complete:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {self.replica_index}: "
+                        f"{len(self.journal.results())}/"
+                        f"{until_complete} results after "
+                        f"{timeout_s:.0f}s in pool mode"
+                    )
+                time.sleep(poll_s)
+                continue
             with _obs.span("serving.replica_round",
                            replica=self.replica_index,
                            n=len(todo) + len(in_flight)):
@@ -410,3 +458,143 @@ def serve_elastic(build: Callable, journal_root: str, *,
          warm_start_step=restored, world=int(comm.size))
     replica.serve()
     return replica
+
+
+class ReplicaAutoscaler:
+    """Load-driven sizing of a replica pool — the serving half of the
+    scale-up story, with :class:`~chainermn_tpu.resilience.adaptive.
+    AdaptPolicy`'s hysteresis shape (direction streaks + action
+    cooldown) pointed at the pool so it doesn't flap.
+
+    Pool model: ``pool_size`` replica slots exist (already-launched
+    processes); INACTIVE slots are drain-marked in the journal, so the
+    deterministic ``seq % n`` claim routes around them and a standby
+    polls idle in ``DecodeReplica.serve(until_complete=...)``.  Scale
+    UP lifts the lowest drain marker (``clear_draining`` — the standby
+    re-derives its share on its next claim pass); scale DOWN marks the
+    highest active slot draining (its share migrates to the survivors,
+    in-flight work finishes).  Exactly ONE decision maker calls
+    ``observe`` once per decision window; the atomic drain markers ARE
+    the broadcast — the same no-coordination contract as claiming.
+
+    Signals (both already measured): journal queue depth
+    (``pending()``) and the p99 token latency
+    (``ContinuousBatcher.latency_report``).  Pressure = queue deeper
+    than ``queue_per_replica`` per active replica, or p99 above
+    ``p99_high_s``; relief = the queue would still fit after shedding
+    one replica and p99 is fine.  A direction must persist
+    ``scale_after`` consecutive windows (a neutral or opposite window
+    resets the streak) and every action arms ``cooldown_windows`` of
+    backoff before the next."""
+
+    def __init__(self, journal: RequestJournal, pool_size: int, *,
+                 min_replicas: int = 1, queue_per_replica: int = 4,
+                 p99_high_s: Optional[float] = None,
+                 scale_after: int = 2, cooldown_windows: int = 1):
+        if pool_size < 1:
+            raise ValueError(f"pool_size must be >= 1, got {pool_size}")
+        if not 1 <= min_replicas <= pool_size:
+            raise ValueError(
+                f"min_replicas must be in [1, pool_size], got "
+                f"{min_replicas} for pool_size={pool_size}"
+            )
+        if queue_per_replica < 1:
+            raise ValueError(
+                f"queue_per_replica must be >= 1, got {queue_per_replica}"
+            )
+        if scale_after < 1:
+            raise ValueError(
+                f"scale_after must be >= 1, got {scale_after}"
+            )
+        if cooldown_windows < 0:
+            raise ValueError(
+                f"cooldown_windows must be >= 0, got {cooldown_windows}"
+            )
+        self.journal = journal
+        self.pool_size = int(pool_size)
+        self.min_replicas = int(min_replicas)
+        self.queue_per_replica = int(queue_per_replica)
+        self.p99_high_s = (None if p99_high_s is None
+                           else float(p99_high_s))
+        self.scale_after = int(scale_after)
+        self.cooldown_windows = int(cooldown_windows)
+        self.streaks = {"up": 0, "down": 0}
+        self.cooldown = 0
+        self.windows = 0
+        self.totals = {"scale_up": 0, "scale_down": 0}
+
+    def active(self) -> List[int]:
+        """Slots currently serving (pool minus the drain-marked)."""
+        dr = set(self.journal.draining())
+        return [i for i in range(self.pool_size) if i not in dr]
+
+    def observe(self, *, queue_depth: Optional[int] = None,
+                p99_token_s: Optional[float] = None) -> Optional[dict]:
+        """One decision window: read the load signals, advance the
+        hysteresis, and — when a direction's streak clears
+        ``scale_after`` off cooldown — apply ONE slot's worth of
+        change through the journal markers.  Returns the action dict
+        (``{"action": "scale_up"|"scale_down", "replica": slot, ...}``)
+        or ``None``."""
+        self.windows += 1
+        if queue_depth is None:
+            queue_depth = len(self.journal.pending())
+        queue_depth = int(queue_depth)
+        active = self.active()
+        n = max(len(active), 1)
+        hot = (self.p99_high_s is not None and p99_token_s is not None
+               and float(p99_token_s) > self.p99_high_s)
+        pressure = (queue_depth > self.queue_per_replica * n) or hot
+        relief = (not hot
+                  and queue_depth <= self.queue_per_replica * (n - 1))
+        # streaks only accumulate toward a move the pool can make
+        if pressure and len(active) < self.pool_size:
+            self.streaks["up"] += 1
+            self.streaks["down"] = 0
+        elif relief and len(active) > self.min_replicas:
+            self.streaks["down"] += 1
+            self.streaks["up"] = 0
+        else:
+            self.streaks["up"] = self.streaks["down"] = 0
+        on_cooldown = self.cooldown > 0
+        if self.cooldown > 0:
+            self.cooldown -= 1
+        if on_cooldown:
+            return None
+        if self.streaks["up"] >= self.scale_after:
+            standby = [i for i in self.journal.draining()
+                       if i < self.pool_size]
+            if not standby:
+                return None
+            slot = min(standby)  # lowest standby activates first
+            self.journal.clear_draining(slot)
+            self.streaks["up"] = self.streaks["down"] = 0
+            self.cooldown = self.cooldown_windows
+            self.totals["scale_up"] += 1
+            action = {"action": "scale_up", "replica": int(slot),
+                      "active": len(active) + 1,
+                      "queue_depth": queue_depth}
+            emit("autoscale_decision", "serving.autoscale",
+                 action="scale_up", replica=int(slot),
+                 queue_depth=queue_depth, active=len(active) + 1,
+                 p99_token_s=p99_token_s)
+            emit("autoscale_action", "serving.autoscale",
+                 action="scale_up", replica=int(slot))
+            return action
+        if self.streaks["down"] >= self.scale_after:
+            slot = max(active)  # highest active sheds first
+            self.journal.mark_draining(slot)
+            self.streaks["up"] = self.streaks["down"] = 0
+            self.cooldown = self.cooldown_windows
+            self.totals["scale_down"] += 1
+            action = {"action": "scale_down", "replica": int(slot),
+                      "active": len(active) - 1,
+                      "queue_depth": queue_depth}
+            emit("autoscale_decision", "serving.autoscale",
+                 action="scale_down", replica=int(slot),
+                 queue_depth=queue_depth, active=len(active) - 1,
+                 p99_token_s=p99_token_s)
+            emit("autoscale_action", "serving.autoscale",
+                 action="scale_down", replica=int(slot))
+            return action
+        return None

@@ -274,7 +274,15 @@ class Trainer:
         hysteresis (docs/resilience.md "Self-healing runtime").  A
         demotion raises :class:`~chainermn_tpu.resilience.errors.
         DemotionRequiredError` on every rank together — recovery is the
-        elastic N−1 restart, not an in-place resume.
+        elastic N−1 restart, not an in-place resume.  With a
+        :class:`~chainermn_tpu.resilience.adaptive.CapacityWatcher`
+        attached (``adapt=AdaptiveExecution(policy, comm=...,
+        watcher=..., hosts=[...])``), healed hosts publishing presence
+        manifests are held under weight-0 probation and an agreed
+        promotion raises :class:`~chainermn_tpu.resilience.errors.
+        PromotionRequiredError` the same collective way — recovery is
+        the elastic N+k restart from the decision snapshot
+        (docs/resilience.md "Scale-up and re-admission").
         """
         if adapt is not None and self._find_adaptive() is None:
             from ..resilience.adaptive import (
@@ -414,6 +422,13 @@ class Trainer:
         for the NEW program (both are keyed per program variant; see
         ``elastic.reestablish_agreements`` to force them explicitly).
         Returns the trainer after ``run(max_restarts=...)``.
+
+        The path is direction-agnostic: the same resharder serves a
+        world that SHRANK (preemption, demotion) and one that GREW (a
+        promoted host joining after probation — the ``N+k`` restart a
+        :class:`~chainermn_tpu.resilience.errors.
+        PromotionRequiredError` asks for; growth floors the iterator
+        cursor, re-visiting a sample rather than skipping one).
         """
         from ..resilience import elastic as _elastic
 

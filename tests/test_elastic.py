@@ -379,6 +379,60 @@ class TestIteratorCursor:
         up = elastic.reshard_iterator_state(st, 2, 4)
         assert up["pos"] == 3
 
+    def test_growth_cursor_divisible_and_ragged(self):
+        # GROWTH N→N+k (ISSUE 16): the global consumed count rides the
+        # remap.  Divisible growth re-splits exactly; ragged growth
+        # floors — a sample may be re-visited, but never skipped, and
+        # the cursor never lands past the new shard's end.
+        st = {"epoch": 2, "pos": 6, "order": np.arange(12)}
+        up = elastic.reshard_iterator_state(st, 4, 8)
+        assert up["pos"] == 3 and up["order"] is None
+        assert up["epoch"] == 2
+        # ragged: 3 ranks x 5 consumed = 15 global → 4 ranks: floor 3
+        ragged = elastic.reshard_iterator_state(
+            {"epoch": 0, "pos": 5, "order": None}, 3, 4)
+        assert ragged["pos"] == 3
+        # the promote shape, growth by one: 7 ranks x 4 → 8 ranks
+        one = elastic.reshard_iterator_state(
+            {"epoch": 0, "pos": 4, "order": None}, 7, 8)
+        assert one["pos"] == 3
+        assert elastic.reshard_iterator_state(
+            {"epoch": 0, "pos": 0, "order": None}, 7, 8)["pos"] == 0
+
+    def test_rebalance_remap_growth_divisible_and_ragged(self):
+        # the rebalance-side twin (adaptive.remap_iterator_cursor) maps
+        # by shard LENGTHS, not world counts: a probationary rank whose
+        # weight-0 shard widens at promotion keeps its epoch fraction.
+        from chainermn_tpu.resilience.adaptive import remap_iterator_cursor
+
+        grown = remap_iterator_cursor(
+            {"epoch": 1, "pos": 2, "order": np.arange(4)}, 4, 8)
+        assert grown["pos"] == 4 and grown["order"] is None
+        assert grown["epoch"] == 1
+        ragged = remap_iterator_cursor({"pos": 3, "order": None}, 5, 7)
+        assert ragged["pos"] == 4  # floor(3*7/5), strictly inside [0, 7)
+        assert remap_iterator_cursor(
+            {"pos": 0, "order": None}, 5, 7)["pos"] == 0
+
+    def test_growth_restore_round_trip_on_wider_world(self):
+        # serialize at world 3, reshard to world 4, restore: the cursor
+        # lands at the remapped pos, the epoch survives, and the order
+        # is redrawn deterministically from the restored RNG stream.
+        from chainermn_tpu.iterators import SerialIterator
+
+        it = SerialIterator(list(range(12)), 4, shuffle=True, seed=11)
+        it.next()
+        it.next()
+        state = it.serialize()
+        up = elastic.reshard_iterator_state(state, 3, 4)
+        a = SerialIterator(list(range(12)), 4, shuffle=True, seed=0)
+        b = SerialIterator(list(range(12)), 4, shuffle=True, seed=5)
+        a.restore(dict(up))
+        b.restore(dict(up))
+        assert a._pos == (state["pos"] * 3) // 4
+        assert a.epoch == state["epoch"]
+        np.testing.assert_array_equal(a._order, b._order)
+
     def test_restore_with_cleared_order_redraws_from_rng(self):
         from chainermn_tpu.iterators import SerialIterator
 

@@ -431,12 +431,56 @@ class TestWeightedScatter:
                                        min_count=1)
         assert counts == [4, 5, 1]
         assert sum(counts) == 10
-        with pytest.raises(ValueError, match="finite and > 0"):
-            weighted_shard_counts(10, [1.0, 0.0])
-        with pytest.raises(ValueError, match="finite and > 0"):
+        with pytest.raises(ValueError, match="finite and >= 0"):
             weighted_shard_counts(10, [1.0, -2.0])
+        with pytest.raises(ValueError, match="at least one weight"):
+            weighted_shard_counts(10, [0.0, 0.0])
         with pytest.raises(ValueError, match="cannot give"):
             weighted_shard_counts(3, [1.0] * 8, min_count=1)
+
+    def test_explicit_zero_weight_is_a_probationary_rank(self):
+        """Satellite (ISSUE 16): an EXPLICIT weight-0 rank owns no
+        samples (probationary host), receives no remainder, is exempt
+        from the min_count lift — and the legacy equal-weight pattern
+        over the positive ranks is unchanged."""
+        from chainermn_tpu.datasets import weighted_shard_counts
+
+        assert weighted_shard_counts(10, [1.0, 0.0]) == [10, 0]
+        # min_count lifts only the POSITIVE ranks
+        assert weighted_shard_counts(10, [1.0, 1e-9, 0.0],
+                                     min_count=1) == [9, 1, 0]
+        # the remainder pattern over the data-owning ranks matches the
+        # same split WITHOUT the probationary rank appended
+        n = 1000
+        with_probe = weighted_shard_counts(n, [1.0] * 64 + [0.0])
+        assert with_probe[:64] == weighted_shard_counts(n, [1.0] * 64)
+        assert with_probe[64] == 0
+
+    def test_zero_weight_equalized_shard_pads_from_permutation_head(self):
+        """The weight-0 shard's lockstep pad: under ``equalize`` it
+        steps the same count per epoch as everyone (width = widest
+        shard) but draws only re-served samples — the head of the
+        epoch permutation — so full cover over the data-owning ranks
+        is untouched."""
+        from chainermn_tpu.datasets.scatter_dataset import scatter_index
+
+        n, size = 103, 9  # 8 data ranks + 1 probe, ragged on purpose
+        w = [1.0] * 8 + [0.0]
+        widths, covered = set(), set()
+        for r in range(size):
+            order, s, e = scatter_index(n, size, r, weights=w,
+                                        equalize=True)
+            widths.add(e - s)
+            if r < 8:
+                covered.update(int(i) for i in order[s:e])
+        assert len(widths) == 1  # lockstep width, probe included
+        assert covered == set(range(n))  # data ranks still cover all
+        # the probe shard re-serves exactly the permutation's head
+        order, s, e = scatter_index(n, size, 8, weights=w,
+                                    equalize=True)
+        base, _s0, _e0 = scatter_index(n, size, 0, weights=w,
+                                       equalize=True)
+        np.testing.assert_array_equal(order[s:e], base[: e - s])
 
     def test_rescatter_preserves_base_permutation(self):
         from chainermn_tpu.datasets import rescatter, scatter_dataset
@@ -559,6 +603,274 @@ class TestAdaptPolicy:
             AdaptPolicy(rebalance_skew=1.0)
         with pytest.raises(ValueError, match="unknown actions"):
             AdaptPolicy(actions=("rebalance", "restart"))
+        with pytest.raises(ValueError, match="probation_windows"):
+            AdaptPolicy(probation_windows=0)
+        with pytest.raises(ValueError, match="readmit_cooldown"):
+            AdaptPolicy(readmit_cooldown_windows=-1)
+        with pytest.raises(ValueError, match="promote_quorum"):
+            AdaptPolicy(promote_quorum=0)
+
+    def test_promote_decision_shape_and_readmit_cooldown(self):
+        """Scale-up (ISSUE 16): ready hosts become one promote decision
+        (world → world+k); a just-demoted host is held out until
+        ``readmit_cooldown_windows`` report windows pass."""
+        p = self._policy(readmit_cooldown_windows=2)
+        hosts = [f"h{i}" for i in range(8)]
+        a = p.observe([], world=8, iteration=4,
+                      ready_hosts=["hx", "hy"], hosts=hosts)
+        assert a == [{"action": "promote", "hosts": ["hx", "hy"],
+                      "world": 8, "new_world": 10, "iteration": 4}]
+        assert p.totals["promote"] == 1
+        # demote h3 at window 2 — the NEXT two windows block its
+        # re-admission, the third admits it
+        p2 = self._policy(rebalance_after=99, demote_after=1,
+                          cooldown_windows=0,
+                          readmit_cooldown_windows=2)
+        d = p2.observe([3], world=8, iteration=1, hosts=hosts)
+        assert d[0]["action"] == "demote"
+        assert p2.host_history["h3"] == {
+            "streak": 1, "window": 1, "promoted": False,
+        }
+        assert p2.readmit_blocked("h3")
+        assert p2.observe([], world=7, iteration=2,
+                          ready_hosts=["h3"], hosts=hosts[:7]) == []
+        a2 = p2.observe([], world=7, iteration=3,
+                        ready_hosts=["h3"], hosts=hosts[:7])
+        assert a2[0]["action"] == "promote"
+        assert a2[0]["new_world"] == 8
+        assert p2.host_history["h3"]["promoted"] is True
+
+    def test_promote_quorum_holds_ready_hosts_for_one_restart(self):
+        """``promote_quorum`` amortizes world re-formations: ready
+        hosts are HELD (the watcher keeps them ready — nothing is
+        consumed) until at least that many can join in one N→N+k
+        restart; then they all promote together."""
+        p = self._policy(promote_quorum=3)
+        hosts = [f"h{i}" for i in range(6)]
+        assert p.observe([], world=6, iteration=1,
+                         ready_hosts=["hx"], hosts=hosts) == []
+        assert p.observe([], world=6, iteration=2,
+                         ready_hosts=["hx", "hy"], hosts=hosts) == []
+        assert p.totals["promote"] == 0
+        a = p.observe([], world=6, iteration=3,
+                      ready_hosts=["hy", "hx", "hz"], hosts=hosts)
+        assert a == [{"action": "promote",
+                      "hosts": ["hx", "hy", "hz"],
+                      "world": 6, "new_world": 9, "iteration": 3}]
+        assert p.totals["promote"] == 1
+        # a cooldown-blocked host does not count toward the quorum
+        p2 = self._policy(rebalance_after=99, demote_after=1,
+                          cooldown_windows=0, promote_quorum=2,
+                          readmit_cooldown_windows=5)
+        p2.observe([3], world=6, iteration=1, hosts=hosts)
+        assert p2.observe([], world=5, iteration=2,
+                          ready_hosts=["h3", "hx"],
+                          hosts=hosts[:5]) == []
+
+    def test_demote_wins_the_window_over_promote(self):
+        p = self._policy(rebalance_after=99, demote_after=1,
+                         cooldown_windows=0)
+        a = p.observe([2], world=8, iteration=5, ready_hosts=["hx"],
+                      hosts=[f"h{i}" for i in range(8)])
+        assert [x["action"] for x in a] == ["demote"]
+        # the ready host was NOT consumed: next (healthy) window
+        # promotes it
+        a2 = p.observe([], world=8, iteration=6, ready_hosts=["hx"],
+                       hosts=[f"h{i}" for i in range(8)])
+        assert [x["action"] for x in a2] == ["promote"]
+
+    def test_flap_demote_probation_promote_convict_skips_to_demote(self):
+        """Satellite (ISSUE 16): the full flap — demoted, re-admitted
+        through probation, promoted, convicted again — skips the
+        rebalance ladder: the effective streak starts from the
+        pre-demotion history, so ONE fresh conviction trips
+        ``demote_after`` again."""
+        p = self._policy(demote_after=3, cooldown_windows=0,
+                         readmit_cooldown_windows=0)
+        hosts8 = [f"h{i}" for i in range(8)]
+        # build h5's streak to demotion (cooldown off, rebalance fires
+        # along the way — ignore the actions, watch the history)
+        p.observe([5], world=8, iteration=1, hosts=hosts8)
+        p.observe([5], world=8, iteration=2, hosts=hosts8)
+        a = p.observe([5], world=8, iteration=3, hosts=hosts8)
+        assert a[0] == {"action": "demote", "process": 5, "streak": 3,
+                        "iteration": 3}
+        assert p.host_history["h5"]["streak"] == 3
+        # world shrank to 7 (per-process maps reset), h5 returns and
+        # clears probation
+        a = p.observe([], world=7, iteration=10, ready_hosts=["h5"],
+                      hosts=hosts8[:7])
+        assert a[0]["action"] == "promote"
+        # grown world: h5 is now process 7; its FIRST re-conviction
+        # goes straight to demote (3 history + 1 fresh >= 3), no
+        # rebalance rung — and the fresh demotion re-records history
+        hosts_new = hosts8[:7] + ["h5"]
+        a = p.observe([7], world=8, iteration=20, hosts=hosts_new)
+        assert a[0] == {"action": "demote", "process": 7, "streak": 4,
+                        "iteration": 20}
+        assert p.host_history["h5"]["promoted"] is False
+        assert p.totals["demote"] == 2
+
+    def test_readmitted_host_excluded_from_rebalance(self):
+        p = self._policy(demote_after=99, cooldown_windows=0)
+        hosts = [f"h{i}" for i in range(4)]
+        p.host_history["h2"] = {"streak": 1, "window": 0,
+                                "promoted": True}
+        # h2 (process 2) convicts but is re-admitted: no rebalance for
+        # it; a normal process still rebalances in the same window
+        a = p.observe([1, 2], world=4, iteration=1, hosts=hosts)
+        assert a[0]["action"] == "rebalance"
+        assert a[0]["processes"] == [1]
+
+    def test_host_history_round_trips_and_survives_resize(self):
+        from chainermn_tpu.resilience.adaptive import AdaptPolicy
+
+        p = self._policy(rebalance_after=99, demote_after=1,
+                         cooldown_windows=0)
+        p.observe([3], world=8, iteration=1,
+                  hosts=[f"h{i}" for i in range(8)])
+        sd = p.state_dict()
+        q = AdaptPolicy()
+        q.load_state_dict(sd)
+        assert q.host_history == {
+            "h3": {"streak": 1, "window": 1, "promoted": False},
+        }
+        assert q.totals["demote"] == 1
+        # a resize resets per-process maps; host-keyed history survives
+        q.observe([], world=7, iteration=2)
+        assert q.streaks == {}
+        assert q.host_history["h3"]["streak"] == 1
+
+
+class TestCapacityWatcher:
+    """Tentpole (ISSUE 16): the probation state machine over presence
+    manifests — scan/evaluate with no processes."""
+
+    def _watcher(self, tmp_path, **kw):
+        from chainermn_tpu.resilience.adaptive import CapacityWatcher
+
+        kw.setdefault("probation_windows", 2)
+        return CapacityWatcher(str(tmp_path), **kw)
+
+    def _publish(self, tmp_path, host, window, mean):
+        from chainermn_tpu.resilience.adaptive import publish_presence
+
+        return publish_presence(str(tmp_path), host, window=window,
+                                step_mean_s=mean)
+
+    def test_probation_clears_after_consecutive_clean_windows(
+        self, tmp_path
+    ):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        w = self._watcher(tmp_path)
+        means = {0: 0.10, 1: 0.10, 2: 0.11}
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            self._publish(tmp_path, "c9", 1, 0.10)
+            assert w.evaluate(w.scan(), means) == []
+            assert slog.counts.get("host_returned") == 1
+            # the SAME manifest again: no new window, no progress
+            assert w.evaluate(w.scan(), means) == []
+            assert w.streaks["c9"] == 1
+            self._publish(tmp_path, "c9", 2, 0.12)
+            assert w.evaluate(w.scan(), means) == ["c9"]
+            assert slog.counts.get("probation_pass") == 1
+            # cleared hosts stay ready until promoted
+            assert w.evaluate(w.scan(), means) == ["c9"]
+        finally:
+            detach(slog)
+
+    def test_dirty_window_resets_the_streak(self, tmp_path):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        w = self._watcher(tmp_path)
+        means = {0: 0.10, 1: 0.10, 2: 0.10}
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            self._publish(tmp_path, "c9", 1, 0.10)
+            w.evaluate(w.scan(), means)
+            # window 2 is a straggler window (0.9 > 1.5 * 0.10)
+            self._publish(tmp_path, "c9", 2, 0.9)
+            assert w.evaluate(w.scan(), means) == []
+            assert w.streaks["c9"] == 0
+            holds = slog.events("probation_hold")
+            assert holds[0].info["reason"] == "straggler"
+            # two more clean windows needed from scratch
+            self._publish(tmp_path, "c9", 3, 0.10)
+            assert w.evaluate(w.scan(), means) == []
+            self._publish(tmp_path, "c9", 4, 0.10)
+            assert w.evaluate(w.scan(), means) == ["c9"]
+        finally:
+            detach(slog)
+
+    def test_blocked_host_sighted_but_held(self, tmp_path):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        w = self._watcher(tmp_path)
+        means = {0: 0.10, 1: 0.10}
+        self._publish(tmp_path, "c9", 1, 0.10)
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            assert w.evaluate(w.scan(), means, blocked=["c9"]) == []
+            hold = slog.events("probation_hold")[0]
+            assert hold.info["reason"] == "readmit_cooldown"
+            assert "c9" in w.returned  # sighted all the same
+            assert w.streaks.get("c9", 0) == 0
+        finally:
+            detach(slog)
+
+    def test_no_measurement_holds_and_torn_manifest_skipped(
+        self, tmp_path
+    ):
+        from chainermn_tpu.resilience.adaptive import presence_path
+
+        w = self._watcher(tmp_path)
+        # no world means yet (empty report): candidate cannot clear
+        self._publish(tmp_path, "c9", 1, 0.10)
+        assert w.evaluate(w.scan(), {}) == []
+        assert w.streaks["c9"] == 0
+        # a torn manifest (killed mid-write without the atomic rename)
+        # is invisible to scan — never a crash
+        os.makedirs(os.path.dirname(presence_path(str(tmp_path), "t")),
+                    exist_ok=True)
+        with open(presence_path(str(tmp_path), "t"), "w") as f:
+            f.write('{"host": "t", "win')
+        assert "t" not in w.scan()
+
+    def test_publish_is_atomic_and_clearable(self, tmp_path):
+        from chainermn_tpu.resilience.adaptive import (
+            clear_presence, presence_path,
+        )
+
+        p = self._publish(tmp_path, "c3", 5, 0.2)
+        assert p == presence_path(str(tmp_path), "c3")
+        with open(p) as f:
+            doc = json.load(f)
+        assert doc == {"host": "c3", "window": 5, "step_mean_s": 0.2,
+                       "state": "candidate"}
+        # no tmp litter next to the manifest (atomic rename contract)
+        assert os.listdir(os.path.dirname(p)) == ["host_c3.json"]
+        clear_presence(str(tmp_path), "c3")
+        assert not os.path.exists(p)
+        clear_presence(str(tmp_path), "c3")  # idempotent
+
+    def test_validation_is_eager(self, tmp_path):
+        from chainermn_tpu.resilience.adaptive import CapacityWatcher
+
+        with pytest.raises(ValueError, match="probation_windows"):
+            CapacityWatcher(str(tmp_path), probation_windows=0)
+        with pytest.raises(ValueError, match="straggler_factor"):
+            CapacityWatcher(str(tmp_path), straggler_factor=1.0)
 
 
 class _AgreeComm:
@@ -572,6 +884,9 @@ class _AgreeComm:
         self._flaky = flaky
         self._peers = peers
         self.exchanges = 0
+
+    def bcast_obj(self, obj, root=0):
+        return obj  # rank 0's view wins — this mock IS rank 0
 
     def allgather_obj(self, mine):
         from chainermn_tpu.resilience.errors import (
@@ -642,19 +957,64 @@ class TestAdaptiveAgreement:
         with pytest.raises(TransientCommError):
             ext._agree(1, [{"action": "demote", "process": 1}])
 
+    def test_torn_promote_agreement_retried_in_lockstep(self):
+        """ISSUE 16 acceptance: the scale-up decision rides the SAME
+        lockstep retry as rebalance/demote — a torn payload during the
+        promote agreement re-exchanges on all ranks together."""
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        comm = _AgreeComm(8, flaky=1)
+        ext = self._ext(comm)
+        actions = [{"action": "promote", "hosts": ["c9"], "world": 8,
+                    "new_world": 9, "iteration": 6}]
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            ext._agree(6, actions)
+        finally:
+            detach(slog)
+        assert comm.exchanges == 2  # torn once, re-exchanged
+        assert slog.counts.get("retry") == 1
+        assert slog.events("retry")[0].site == "adaptive.agree"
+
+    def test_divergent_promote_decision_raises_on_every_rank(self):
+        """ISSUE 16 acceptance: a rank that decided a DIFFERENT grow
+        (or none) raises AdaptDecisionMismatchError before anyone
+        re-forms the world — mirroring the demote pin."""
+        from chainermn_tpu.resilience.errors import (
+            AdaptDecisionMismatchError,
+        )
+
+        other = json.dumps(
+            {"iteration": 6, "actions": []}, sort_keys=True
+        )
+        comm = _AgreeComm(8, peers=[other] * 7)
+        ext = self._ext(comm)
+        with pytest.raises(AdaptDecisionMismatchError,
+                           match="diverged at iteration 6"):
+            ext._agree(6, [{"action": "promote", "hosts": ["c9"],
+                            "world": 8, "new_world": 9,
+                            "iteration": 6}])
+
 
 class _StubReport:
     """Just enough MetricsReport surface for the extension."""
 
-    def __init__(self, comm=None):
+    def __init__(self, comm=None, means=None):
         self._comm = comm
         self.last_report = None
         self.straggler_processes = []
+        self._means = dict(means or {})
 
     def window(self, iteration, stragglers):
         self.last_report = {"iteration": iteration, "rows": [],
                             "stragglers": list(stragglers)}
         self.straggler_processes = list(stragglers)
+
+    def process_means(self, phase="step"):
+        return dict(self._means)
 
 
 class TestAdaptiveExecution:
@@ -784,6 +1144,80 @@ class TestAdaptiveExecution:
         assert saved == [9]  # snapshot committed before the raise
         act = slog.events("adapt_action", "adaptive.demote")[0]
         assert act.info["checkpoint_step"] == 9
+
+    def test_promote_commits_snapshot_and_raises_collectively(
+        self, tmp_path
+    ):
+        """The scale-up half of the tentpole, unit shape: a candidate
+        clears two probe windows, the agreed promote decision commits a
+        snapshot at the decision iteration, emits the promote
+        decision/action events, and raises PromotionRequiredError on
+        the (mocked) world together."""
+        from chainermn_tpu.resilience.adaptive import (
+            AdaptiveExecution,
+            AdaptPolicy,
+            CapacityWatcher,
+            publish_presence,
+        )
+        from chainermn_tpu.resilience.errors import (
+            PromotionRequiredError,
+        )
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        trainer = self._trainer(list(range(8)))
+        saved = []
+
+        class _Ckpt:
+            def restore_trainer(self, t):
+                return None
+
+            def __call__(self, t):
+                saved.append(t.iteration)
+
+        trainer.extend(_Ckpt())
+        rep = _StubReport(means={p: 0.1 for p in range(4)})
+        ext = AdaptiveExecution(
+            AdaptPolicy(), comm=_AgreeComm(4), report=rep,
+            watcher=CapacityWatcher(str(tmp_path),
+                                    probation_windows=2),
+        )
+        trainer.extend(ext)
+        ext.initialize(trainer)
+        assert ext._hosts == ["h0", "h1", "h2", "h3"]
+        # probe window 1: sighted, streak 1, no decision yet
+        publish_presence(str(tmp_path), "c9", window=1,
+                         step_mean_s=0.11)
+        trainer.iteration = 5
+        rep.window(iteration=5, stragglers=[])
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            ext(trainer)
+            assert slog.counts.get("host_returned") == 1
+            assert not slog.events("adapt_decision")
+            # probe window 2: clears probation -> agreed promote
+            publish_presence(str(tmp_path), "c9", window=2,
+                             step_mean_s=0.12)
+            trainer.iteration = 6
+            rep.window(iteration=6, stragglers=[])
+            with pytest.raises(PromotionRequiredError) as ei:
+                ext(trainer)
+        finally:
+            detach(slog)
+        assert ei.value.hosts == ("c9",)
+        assert ei.value.new_world == 5
+        assert ei.value.recoverable is False
+        assert saved == [6]  # snapshot committed before the raise
+        dec = slog.events("adapt_decision")[0]
+        assert dec.info["action"] == "promote"
+        assert dec.info["host"] == "c9"
+        assert dec.info["new_world"] == 5
+        act = slog.events("adapt_action", "adaptive.promote")[0]
+        assert act.info["checkpoint_step"] == 6
+        assert act.info["hosts"] == "c9"
+        assert ext.policy.totals["promote"] == 1
 
     def test_policy_state_rides_trainer_state_dict(self):
         import json as _json
@@ -1253,4 +1687,85 @@ class TestAdaptiveSmoke8:
         # the committed demote snapshot is the step the world resumed
         acts = [e for e in rep.events("adapt_action")
                 if e["info"]["action"] == "demote"]
+        assert {e["info"]["checkpoint_step"] for e in acts} == {d}
+
+
+@pytest.mark.multiprocess
+class TestGrowSmoke8:
+    def test_probation_promote_7_to_8_on_oracle(self, tmp_path):
+        """The scale-UP tier-1 smoke (ISSUE 16 acceptance, 8-process
+        shape): a 7-process training world runs with the capacity
+        watcher while a CONCURRENT 1-process probe world publishes
+        presence manifests for host h7 into the shared scratch.  The
+        watcher holds h7 under probation for 2 clean windows, the
+        agreed decision commits a snapshot and raises
+        ``PromotionRequiredError`` on every rank together, rank 0 posts
+        h7's admission marker, and the 8-process resume leg reshards
+        onto the numpy sgd+momentum oracle from exactly the decision
+        step — the candidate's first participation in the world.  The
+        merged report pins the full promote chain: host_returned →
+        probation_pass → adapt_decision → adapt_action →
+        world_reformed → elastic_reshard → elastic_restart."""
+        from chainermn_tpu.fleet import REAPED
+
+        # a world-wide pace floor: probe/world step-mean RATIOS stay
+        # noise-robust on a timeshared host (the probe is never slower
+        # than 1.5x the world's 0.2s median)
+        pace = FaultSchedule().pace(window=(1, 300), delay=0.2)
+        grow = FleetWorld(7, str(tmp_path), schedule=pace,
+                          budget_s=SMOKE_BUDGET_S, label="leg0").start(
+            "grow_leg",
+            {"n_steps": 300, "probation_windows": 2,
+             "promote_quorum": 1, "report_every": 1, "linger_s": 1.5},
+        )
+        probe = FleetWorld(1, str(tmp_path), budget_s=SMOKE_BUDGET_S,
+                           label="probe0").start(
+            "probe_host",
+            {"host": "h7", "world": 7, "steps_per_window": 3,
+             "window_sleep_s": 0.25, "max_windows": 400},
+        )
+        # the promotion exits every rank together — REAPED, like the
+        # demote leg
+        res = grow.wait(expect_exit={p: REAPED for p in range(7)})
+        pg = res.payloads()
+        assert sorted(pg) == list(range(7))
+        d = pg[0]["iteration"]
+        for p in pg.values():
+            assert p["promote"] == {"hosts": ["h7"], "new_world": 8}
+            assert p["iteration"] == d
+            assert p["oracle_match"] is True
+            assert p["resumed_step"] is None  # fresh leg, not a resume
+        pp = probe.wait(expect_exit={}).payloads()[0]
+        assert pp["promoted"] is True
+        assert pp["admission"]["new_world"] == 8
+        assert pp["admission"]["checkpoint_step"] == d
+        assert pp["windows"] >= 2  # probation took real probe windows
+        # resume leg: 7→8 through the checkpoint resharder from exactly
+        # the decision snapshot — no step lost across the growth
+        res2 = FleetWorld(8, str(tmp_path), budget_s=SMOKE_BUDGET_S,
+                          label="leg1").launch(
+            "chain_leg",
+            {"n_steps": d + 3, "wave_at": None, "lr": 0.1, "mom": 0.9,
+             "dim": 4, "straggler": False, "report_every": 1},
+            expect_exit={},
+        )
+        for p in res2.payloads().values():
+            assert p["resumed_step"] == d
+            assert p["resized"] == [7, 8]
+            assert p["oracle_match"] is True
+            assert p["iteration"] == d + 3
+        rep = FleetReport.from_scratch(str(tmp_path))
+        rep.assert_order(
+            "host_returned", "probation_pass", "adapt_decision",
+            "adapt_action", "world_reformed", "elastic_reshard",
+            "elastic_restart",
+        )
+        promos = [e for e in rep.events("adapt_decision")
+                  if e["info"].get("action") == "promote"]
+        assert promos
+        assert {e["info"]["host"] for e in promos} == {"h7"}
+        assert {e["info"]["new_world"] for e in promos} == {8}
+        # the committed promote snapshot is the step the world resumed
+        acts = [e for e in rep.events("adapt_action")
+                if e["info"].get("action") == "promote"]
         assert {e["info"]["checkpoint_step"] for e in acts} == {d}

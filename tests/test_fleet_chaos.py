@@ -236,6 +236,247 @@ class TestServingChurnFleet:
         assert sorted(e["process"] for e in dies) == [1, 2]
 
 
+class TestBreathingWorld:
+    def test_breathes_8_6_9_7_on_oracle(self, tmp_path):
+        """ISSUE 16 acceptance: the world BREATHES 8→6→9→7 under a
+        composed fault schedule — a preemption wave shrinks it, three
+        healed hosts re-enter through probation (one of them dirty at
+        first — its early probe windows straggle, the watcher holds it,
+        it heals and clears), a quorum-3 promote grows the world in ONE
+        restart, a second wave shrinks it again, and every leg lands
+        bit-identically on the single-world numpy sgd+momentum oracle.
+        The merged report pins the promote chain host_returned →
+        probation_pass → adapt_decision → world_reformed →
+        elastic_reshard → elastic_restart on the shared timeline."""
+        scratch = str(tmp_path)
+        base = {"lr": 0.1, "mom": 0.9, "dim": 4, "straggler": False,
+                "report_every": 1}
+
+        # -- leg 0: 8 procs, torn rendezvous + wave kills 6,7 at step 4
+        sched0 = (FaultSchedule()
+                  .torn_payload(calls=(1,))
+                  .preemption_wave((6, 7), window=(4, 4)))
+        res0 = FleetWorld(8, scratch, schedule=sched0, budget_s=600,
+                          label="leg0").launch(
+            "chain_leg",
+            dict(base, n_steps=4, wave_at=4, linger_s=1.5),
+            expect_exit={p: (43 if p in (6, 7) else REAPED)
+                         for p in range(8)},
+        )
+        assert all(p["steps_saved"] == 3
+                   for p in res0.payloads().values())
+
+        # -- leg 1: 6 survivors resume THROUGH the resharder (8→6) and
+        # run under the capacity watcher; three healed hosts probe
+        # concurrently — h6 straggles for its first two probe windows
+        # (the heal-then-readmit path), h7/h8 are clean.  promote
+        # quorum 3: ONE restart admits all three.
+        pace = FaultSchedule().pace(window=(1, 200), delay=0.2)
+        grow = FleetWorld(6, scratch, schedule=pace, budget_s=600,
+                          label="leg1").start(
+            "grow_leg",
+            dict(base, n_steps=200, resume=True, probation_windows=2,
+                 promote_quorum=3, linger_s=1.5),
+        )
+        # 5s/step dwarfs the world's 0.2s pace even under timeshared
+        # contention (the 1.5x-median threshold inflates with load —
+        # a 2s delay was judged clean on a single-core CI host), and
+        # each ~15s dirty window spans many watcher scans
+        dirty = FaultSchedule().straggler(0, window=(1, 6), delay=5.0)
+        probes = {}
+        for host, sched in (("h6", dirty), ("h7", None), ("h8", None)):
+            probes[host] = FleetWorld(
+                1, scratch, schedule=sched, budget_s=600,
+                label=f"probe_{host}",
+            ).start("probe_host", {
+                "host": host, "world": 6, "steps_per_window": 3,
+                "window_sleep_s": 0.25, "max_windows": 400,
+            })
+        res1 = grow.wait(expect_exit={p: REAPED for p in range(6)})
+        p1 = res1.payloads()
+        d1 = p1[0]["iteration"]
+        for p in p1.values():
+            assert p["promote"] == {"hosts": ["h6", "h7", "h8"],
+                                    "new_world": 9}
+            assert p["resumed_step"] == 3
+            assert p["iteration"] == d1
+            assert p["oracle_match"] is True
+        for host, w in probes.items():
+            pp = w.wait(expect_exit={}).payloads()[0]
+            assert pp["promoted"] is True, host
+            assert pp["admission"]["new_world"] == 9
+            assert pp["admission"]["checkpoint_step"] == d1
+
+        # -- leg 2: the world GROWS 6→9 from exactly the decision step
+        res2 = FleetWorld(9, scratch, budget_s=600,
+                          label="leg2").launch(
+            "chain_leg",
+            dict(base, n_steps=d1 + 2, wave_at=None),
+            expect_exit={},
+        )
+        for p in res2.payloads().values():
+            assert p["resumed_step"] == d1
+            assert p["resized"] == [6, 9]
+            assert p["oracle_match"] is True
+
+        # -- leg 3: the grown world is preempted AGAIN (resume + wave:
+        # restore through the resharder, then the wave kills 7,8 two
+        # steps later — schedule windows are leg-local call counts)
+        sched3 = FaultSchedule().preemption_wave((7, 8), window=(3, 3))
+        res3 = FleetWorld(9, scratch, schedule=sched3, budget_s=600,
+                          label="leg3").launch(
+            "chain_leg",
+            dict(base, n_steps=d1 + 5, wave_at=d1 + 5,
+                 resume_wave=True, linger_s=1.5),
+            expect_exit={p: (43 if p in (7, 8) else REAPED)
+                         for p in range(9)},
+        )
+        for p in res3.payloads().values():
+            assert p["resumed_step"] == d1 + 2
+            assert p["steps_saved"] == 2  # d1+3, d1+4 saved pre-wave
+        # -- leg 4: 7 survivors reshard 9→7 onto the final oracle step
+        res4 = FleetWorld(7, scratch, budget_s=600,
+                          label="leg4").launch(
+            "chain_leg",
+            dict(base, n_steps=d1 + 7, wave_at=None),
+            expect_exit={},
+        )
+        for p in res4.payloads().values():
+            assert p["resumed_step"] == d1 + 4
+            assert p["resized"] == [9, 7]
+            assert p["oracle_match"] is True
+            assert p["iteration"] == d1 + 7
+
+        # -- the merged post-mortem: pin the promote chain from the
+        # first sighting (leg 1's own 8→6 restore reshard precedes it
+        # on the full timeline, so slice from host_returned)
+        rep = FleetReport.from_scratch(scratch)
+        t0 = rep.first("host_returned")["wall"]
+        rep.between(t0=t0).assert_order(
+            "host_returned", "probation_pass", "adapt_decision",
+            "adapt_action", "world_reformed", "elastic_reshard",
+            "elastic_restart",
+        )
+        # h6's dirty probe windows were HELD (straggler rule), and its
+        # pass came only after the hold
+        holds = [e for e in rep.events("probation_hold")
+                 if e["info"].get("host") == "h6"
+                 and e["info"].get("reason") == "straggler"]
+        assert holds
+        h6_pass = [e for e in rep.events("probation_pass")
+                   if e["info"].get("host") == "h6"]
+        assert h6_pass
+        assert min(e["wall"] for e in holds) < min(
+            e["wall"] for e in h6_pass
+        )
+        # ONE promote decision per host, all in the same window
+        promos = [e for e in rep.events("adapt_decision")
+                  if e["info"].get("action") == "promote"]
+        assert {e["info"]["host"] for e in promos} == {"h6", "h7", "h8"}
+        assert {e["info"]["new_world"] for e in promos} == {9}
+        # both waves' victims left die records
+        dies = sorted((e["leg"], e["process"])
+                      for e in rep.events("fault_injected")
+                      if e["info"].get("fault") == "die")
+        assert dies == [("leg0", 6), ("leg0", 7),
+                        ("leg3", 7), ("leg3", 8)]
+
+
+class TestServingAutoscaleFleet:
+    def test_pool_breathes_2_up_down_from_load(self, tmp_path):
+        """ISSUE 16 acceptance, serving half: a 5-slot replica pool
+        (2 active, 3 standby drain-marked) serves an offered load whose
+        opening burst outruns ``queue_per_replica`` × active — the
+        autoscaler scales UP (clear_draining: the standby re-derives
+        its ``seq % n`` share); the post-burst calm scales back DOWN to
+        ``min_replicas``.  Zero dropped or duplicated results: every
+        request completes bit-identically to a fresh single-engine
+        oracle (asserted in-scenario)."""
+        # a decode pace keeps the burst's backlog real on a fast CPU
+        sched = FaultSchedule().fault(
+            "serving.decode_step", "delay", probability=1.0, delay=0.05
+        )
+        res = FleetWorld(5, str(tmp_path), schedule=sched, budget_s=420,
+                         label="pool").launch(
+            "serving_autoscale",
+            {"n_requests": 30, "burst": 18, "wave": 4,
+             "min_replicas": 2, "queue_per_replica": 4,
+             "scale_after": 2, "cooldown_windows": 1,
+             "observe_s": 0.4},
+            expect_exit={},
+        )
+        p = res.payloads()
+        assert sorted(p) == list(range(5))
+        driver = p[0]
+        assert driver["totals"]["scale_up"] >= 1
+        assert driver["totals"]["scale_down"] >= 1
+        # the pool breathed back down to min_replicas
+        assert len(driver["active_final"]) == 2
+        # up before down, and the first activation was the lowest
+        # standby slot
+        kinds = [a["action"] for a in driver["actions"]]
+        assert kinds.index("scale_up") < kinds.index("scale_down")
+        first_up = next(a for a in driver["actions"]
+                        if a["action"] == "scale_up")
+        assert first_up["replica"] == 2
+        # the activated standby actually served part of the stream
+        standby_served = [rid for q in range(2, 5)
+                          for rid in p[q]["served"]]
+        assert standby_served
+        # no request was served into a missing result: all 30 present
+        # (completeness + bit-identity asserted in-scenario); shares
+        # union to the whole stream
+        all_served = set()
+        for q in range(5):
+            all_served |= set(p[q]["served"])
+        assert all_served == {f"c{i}" for i in range(30)}
+        rep = FleetReport.from_scratch(str(tmp_path))
+        ups = [e for e in rep.events("autoscale_action")
+               if e["info"].get("action") == "scale_up"]
+        downs = [e for e in rep.events("autoscale_action")
+                 if e["info"].get("action") == "scale_down"]
+        assert ups and downs
+        assert min(e["wall"] for e in ups) < min(
+            e["wall"] for e in downs
+        )
+
+
+class TestServingDrainCycleFleet:
+    def test_drain_heal_reclaim_no_dup_no_orphan(self, tmp_path):
+        """ISSUE 16 satellite: ``clear_draining`` + re-claim end to
+        end.  Replica 2 starts drain-marked; the 2 healthy replicas
+        complete batch 1 (the drained slot's reassigned share
+        included); process 0 lifts the marker at a pending-empty
+        instant and submits batch 2 — the returned replica re-derives
+        its pure ``seq % 3`` share.  No request is served twice, none
+        is orphaned (disjoint shares, complete union, bit-identical
+        results — the oracle comparison runs in-scenario)."""
+        res = FleetWorld(3, str(tmp_path), budget_s=420,
+                         label="drain").launch(
+            "serving_drain_cycle",
+            {"batch1": 12, "batch2": 12},
+            expect_exit={},
+        )
+        p = res.payloads()
+        assert sorted(p) == [0, 1, 2]
+        served = {q: set(p[q]["served"]) for q in p}
+        # disjoint shares, complete union — no dup, no orphan
+        assert served[0] & served[1] == set()
+        assert served[0] & served[2] == set()
+        assert served[1] & served[2] == set()
+        assert (served[0] | served[1] | served[2]
+                == {f"c{i}" for i in range(24)})
+        # the healed replica served EXACTLY its seq%3 share of batch 2
+        # and nothing from batch 1 (it was draining then)
+        assert served[2] == {f"c{i}" for i in range(12, 24)
+                             if i % 3 == 2}
+        rep = FleetReport.from_scratch(str(tmp_path))
+        # the decision trail: the drain decision precedes every result
+        drains = [e for e in rep.events("adapt_decision")
+                  if e["info"].get("action") == "drain"]
+        assert drains and drains[0]["info"]["process"] == 2
+
+
 class TestWideWorldFormation:
     @pytest.mark.parametrize("n", [32, 64])
     def test_rendezvous_with_torn_agreement(self, n, tmp_path):

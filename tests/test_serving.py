@@ -991,6 +991,162 @@ class TestAdaptiveDrain:
 
 
 # ----------------------------------------------------------------------
+class TestReplicaAutoscaler:
+    """ISSUE 16: load-driven replica-pool sizing over the journal's
+    drain markers — AdaptPolicy-shaped hysteresis, one decision maker,
+    the markers as the broadcast."""
+
+    def _scaler(self, tmp_path, **kw):
+        from chainermn_tpu.serving import ReplicaAutoscaler
+
+        j = RequestJournal(str(tmp_path))
+        kw.setdefault("scale_after", 2)
+        kw.setdefault("cooldown_windows", 1)
+        return j, ReplicaAutoscaler(j, 4, **kw)
+
+    def test_validation_is_eager(self, tmp_path):
+        from chainermn_tpu.serving import ReplicaAutoscaler
+
+        j = RequestJournal(str(tmp_path))
+        with pytest.raises(ValueError, match="pool_size"):
+            ReplicaAutoscaler(j, 0)
+        with pytest.raises(ValueError, match="min_replicas"):
+            ReplicaAutoscaler(j, 2, min_replicas=3)
+        with pytest.raises(ValueError, match="scale_after"):
+            ReplicaAutoscaler(j, 2, scale_after=0)
+        with pytest.raises(ValueError, match="queue_per_replica"):
+            ReplicaAutoscaler(j, 2, queue_per_replica=0)
+
+    def test_scale_up_needs_sustained_pressure_then_cools_down(
+        self, tmp_path
+    ):
+        from chainermn_tpu.resilience.log import (
+            ResilienceLog, attach, detach,
+        )
+
+        j, a = self._scaler(tmp_path, queue_per_replica=4)
+        j.mark_draining(2)
+        j.mark_draining(3)
+        assert a.active() == [0, 1]
+        slog = ResilienceLog()
+        attach(slog)
+        try:
+            # 2 active * 4/replica = 8 capacity; 20 queued is pressure
+            assert a.observe(queue_depth=20) is None  # streak 1
+            act = a.observe(queue_depth=20)
+            assert act == {"action": "scale_up", "replica": 2,
+                           "active": 3, "queue_depth": 20}
+            assert a.active() == [0, 1, 2]  # marker lifted
+            # cooldown blocks the immediate next window (the streak
+            # keeps accumulating under it — AdaptPolicy's shape)
+            assert a.observe(queue_depth=20) is None
+            act2 = a.observe(queue_depth=20)
+            assert act2["action"] == "scale_up" and act2["replica"] == 3
+        finally:
+            detach(slog)
+        decs = slog.events("autoscale_decision")
+        assert [e.info["action"] for e in decs] == ["scale_up"] * 2
+        assert slog.events("autoscale_action")
+        assert a.totals == {"scale_up": 2, "scale_down": 0}
+        # pool exhausted: pressure can no longer accumulate a streak
+        assert a.observe(queue_depth=99) is None
+        assert a.observe(queue_depth=99) is None
+        assert a.streaks == {"up": 0, "down": 0}
+
+    def test_scale_down_sheds_highest_active_to_min(self, tmp_path):
+        j, a = self._scaler(tmp_path, queue_per_replica=4,
+                            min_replicas=2, cooldown_windows=0)
+        assert a.active() == [0, 1, 2, 3]
+        # queue 4 <= 4 * (4-1): relief
+        assert a.observe(queue_depth=4) is None
+        act = a.observe(queue_depth=4)
+        assert act == {"action": "scale_down", "replica": 3,
+                       "active": 3, "queue_depth": 4}
+        assert j.draining() == [3]
+        a.observe(queue_depth=0)
+        act2 = a.observe(queue_depth=0)
+        assert act2["action"] == "scale_down" and act2["replica"] == 2
+        # at min_replicas the down streak stops accumulating
+        assert a.observe(queue_depth=0) is None
+        assert a.observe(queue_depth=0) is None
+        assert a.active() == [0, 1]
+
+    def test_flapping_load_never_scales(self, tmp_path):
+        j, a = self._scaler(tmp_path, queue_per_replica=4,
+                            min_replicas=1)
+        j.mark_draining(3)
+        # pressure / relief alternating: neither streak survives
+        for depth in (99, 0, 99, 0, 99, 0):
+            assert a.observe(queue_depth=depth) is None
+        assert a.totals == {"scale_up": 0, "scale_down": 0}
+
+    def test_p99_latency_is_a_scale_up_signal(self, tmp_path):
+        j, a = self._scaler(tmp_path, queue_per_replica=100,
+                            p99_high_s=0.5)
+        j.mark_draining(3)
+        # queue is shallow but the pool is slow: p99 drives the streak
+        assert a.observe(queue_depth=1, p99_token_s=2.0) is None
+        act = a.observe(queue_depth=1, p99_token_s=2.0)
+        assert act["action"] == "scale_up" and act["replica"] == 3
+        # hot p99 also vetoes relief
+        a2 = self._scaler(tmp_path, queue_per_replica=100,
+                          p99_high_s=0.5)[1]
+        assert a2.observe(queue_depth=0, p99_token_s=2.0) is None
+        assert a2.streaks["down"] == 0
+
+    def test_queue_depth_defaults_to_journal_pending(self, tmp_path):
+        j, a = self._scaler(tmp_path, queue_per_replica=1,
+                            scale_after=1, cooldown_windows=0)
+        j.mark_draining(3)
+        j.submit_all([Request([1], 1, id=f"q{i}") for i in range(9)])
+        act = a.observe()
+        assert act["action"] == "scale_up"
+        assert act["queue_depth"] == 9
+
+    def test_standby_pool_mode_serves_after_activation(
+        self, lm, tmp_path
+    ):
+        """End-to-end slice of the autoscale loop in one process: a
+        drain-marked standby polls in ``serve(until_complete=...)``
+        without exiting; the autoscaler lifts its marker (scale-up)
+        mid-poll; the standby re-derives its share and completes the
+        stream bit-identically to a fresh oracle engine."""
+        import threading
+
+        from chainermn_tpu.serving import ReplicaAutoscaler
+
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        reqs = [Request(p, 3, id=f"s{i}")
+                for i, p in enumerate(_prompts(17, 4))]
+        j.submit_all(reqs)
+        j.mark_draining(0)  # pool of 1, standby
+        rep = DecodeReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, replica_index=0, n_replicas=1)
+        out = {}
+
+        def _serve():
+            out["served"] = rep.serve(until_complete=len(reqs),
+                                      timeout_s=30.0)
+
+        t = threading.Thread(target=_serve)
+        t.start()
+        a = ReplicaAutoscaler(j, 1, scale_after=1, cooldown_windows=0,
+                              queue_per_replica=1)
+        assert a.observe()["action"] == "scale_up"  # queue 4 > 1*1
+        t.join(timeout=60)
+        assert not t.is_alive()
+        assert len(out["served"]) == len(reqs)
+        res = j.results()
+        oracle = DecodeEngine(model, params, capacity=2, page_size=8)
+        for r in reqs:
+            want = oracle.generate(r.prompt, r.max_new_tokens)
+            assert res[r.id]["tokens"] == want, r.id
+        assert j.pending() == []
+
+
+# ----------------------------------------------------------------------
 # mnlint: serving is NOT part of the sanctioned comm layer
 # ----------------------------------------------------------------------
 class TestServingLint:

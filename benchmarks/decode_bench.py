@@ -15,6 +15,28 @@ Two rungs over the continuous-batching engine (ISSUE 13):
                     is the capacity-bound ceiling the batcher exists
                     to reach.
 
+Two A/B pairs over the same substrate (ISSUE 17):
+
+  decode_prefix_shared / decode_prefix_cold
+                    2C requests sharing a one-page system prefix
+                    (~66% prompt overlap), served with copy-on-write
+                    prefix sharing ON vs OFF.  The shared row carries
+                    ``pages_saved`` (peak distinct-pages delta vs the
+                    cold serve) and ``prefix_hits`` — outputs are
+                    bit-identical by contract, so the fingerprints are
+                    the win, the tokens/sec the cost of earning it.
+  decode_spec_k4 / decode_spec_off
+                    speculative decode (half-width 1-layer draft
+                    proposes 4, target verifies in one batched step)
+                    vs plain decode on identical requests.  The rung
+                    reports ``acceptance_rate`` — with the bench's
+                    RANDOM weights the draft rarely matches, so this
+                    pair prices the speculative MACHINERY at its
+                    acceptance floor; an on-chip run with a trained
+                    draft re-reads the same row at a real acceptance
+                    (the verify program's collective census rides the
+                    row, pinned by ``spec_verify_step``).
+
 Protocol: the serving loop is HOST-driven (admission, argmax, page
 bookkeeping between compiled steps), so each rung times paired
 k / 2k-token serves and reports the min positive paired difference —
@@ -137,16 +159,90 @@ def _fingerprints(model, params, capacity):
     }
 
 
-def _run_rung(name, capacity, n_requests):
-    model, params = _fixture()
-    samples, reports = [], []
-    for _ in range(max(REPEATS, 1)):
-        t1, n1, _ = _serve_tokens(model, params, capacity, n_requests, K)
-        t2, n2, rep2 = _serve_tokens(
-            model, params, capacity, n_requests, 2 * K
+def _overlap_requests(n_requests, max_new):
+    """2C requests over a ONE-PAGE shared system prefix plus a
+    half-page unique tail (~66% prompt overlap, page-aligned so the
+    prefix index can alias it)."""
+    from chainermn_tpu.serving.batcher import Request
+
+    rng = np.random.RandomState(0)
+    sys_prefix = rng.randint(0, VOCAB, PAGE).tolist()
+    return [
+        Request(
+            sys_prefix + rng.randint(0, VOCAB, PAGE // 2).tolist(),
+            max_new,
         )
-        samples.append(t2 - t1)           # seconds for n2 - n1 tokens
-        reports.append((n2 - n1, rep2))
+        for _ in range(n_requests)
+    ]
+
+
+def _serve_overlap(model, params, capacity, n_requests, max_new, share):
+    """Timed leg over the shared-prefix request mix; additionally
+    tracks the peak DISTINCT page count (what sharing shrinks)."""
+    from chainermn_tpu.serving.batcher import ContinuousBatcher
+
+    eng = _engine(model, params, capacity)
+    b = ContinuousBatcher(eng, share_prefixes=share)
+    for r in _overlap_requests(n_requests, max_new):
+        b.submit(r)
+    peak = 0
+    t0 = time.monotonic()
+    while b.step():
+        peak = max(peak, eng.cache.used_pages)
+    dt = time.monotonic() - t0
+    rep = b.latency_report()
+    assert rep["failed"] == 0
+    return dt, b.tokens_generated, rep, peak
+
+
+def _draft_fixture():
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    d_model = max(16, D_MODEL // 2)
+    heads = max(1, HEADS // 2)
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=d_model, n_heads=heads,
+        n_layers=1, max_len=PROMPT + 2 * K + PAGE,
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(2),
+         "dropout": jax.random.PRNGKey(3)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    return model, params
+
+
+def _serve_spec(model, params, draft, dparams, capacity, n_requests,
+                max_new, k):
+    """Timed leg: the speculative batcher over the same request stream
+    as :func:`_serve_tokens` (identical outputs by contract)."""
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.decode import DecodeEngine
+    from chainermn_tpu.serving.speculative import SpeculativeBatcher
+
+    eng = _engine(model, params, capacity)
+    dr = DecodeEngine(
+        draft, dparams, capacity=capacity, page_size=PAGE,
+        pages_per_slot=eng.pages_per_slot,
+        num_pages=eng.cache.num_pages,
+    )
+    b = SpeculativeBatcher(eng, dr, k=k)
+    rng = np.random.RandomState(0)
+    reqs = [
+        Request(rng.randint(0, VOCAB, PROMPT).tolist(), max_new)
+        for _ in range(n_requests)
+    ]
+    t0 = time.monotonic()
+    b.serve(reqs)
+    dt = time.monotonic() - t0
+    rep = b.latency_report()
+    assert rep["failed"] == 0
+    return dt, b.tokens_generated, rep
+
+
+def _emit_row(name, samples, reports, fingerprints, extra=None):
+    """The shared row shape: min-positive paired difference, noise-
+    floor null disclosure, protocol fields, serving fingerprints."""
     dt = min_positive(samples)
     tokens = reports[0][0]
     n_chips = len(jax.devices())
@@ -165,13 +261,111 @@ def _run_rung(name, capacity, n_requests):
         "n_chips": n_chips,
         "samples_s": [round(s, 4) for s in samples],
         **protocol_fields(samples),
-        **_fingerprints(model, params, capacity),
+        **fingerprints,
     }
+    if extra:
+        row.update(extra)
     lat = rep.get("serving.token_latency")
     if lat:
         row["token_latency_p50_ms"] = lat["p50_ms"]
         row["token_latency_p99_ms"] = lat["p99_ms"]
     print(json.dumps(row), flush=True)
+
+
+def _run_rung(name, capacity, n_requests):
+    model, params = _fixture()
+    samples, reports = [], []
+    for _ in range(max(REPEATS, 1)):
+        t1, n1, _ = _serve_tokens(model, params, capacity, n_requests, K)
+        t2, n2, rep2 = _serve_tokens(
+            model, params, capacity, n_requests, 2 * K
+        )
+        samples.append(t2 - t1)           # seconds for n2 - n1 tokens
+        reports.append((n2 - n1, rep2))
+    _emit_row(name, samples, reports,
+              _fingerprints(model, params, capacity))
+
+
+def _run_prefix_rung(name, share):
+    model, params = _fixture()
+    capacity, n_requests = CAPACITY, 2 * CAPACITY
+    samples, reports, peaks = [], [], []
+    for _ in range(max(REPEATS, 1)):
+        t1, n1, _, _ = _serve_overlap(
+            model, params, capacity, n_requests, K, share
+        )
+        t2, n2, rep2, peak2 = _serve_overlap(
+            model, params, capacity, n_requests, 2 * K, share
+        )
+        samples.append(t2 - t1)
+        reports.append((n2 - n1, rep2))
+        peaks.append(peak2)
+    extra = {
+        "share_prefixes": share,
+        "peak_used_pages": max(peaks),
+        "prefix_hits": reports[-1][1].get("prefix_hits", 0),
+        "prefix_tokens_shared":
+            reports[-1][1].get("prefix_tokens_shared", 0),
+    }
+    if share:
+        # the acceptance-criterion fingerprint: distinct pages saved
+        # vs an identical cold serve (outputs bit-identical; pinned
+        # by tests, disclosed here)
+        _, _, _, cold_peak = _serve_overlap(
+            model, params, capacity, n_requests, 2 * K, False
+        )
+        extra["pages_saved"] = cold_peak - max(peaks)
+    _emit_row(name, samples, reports,
+              _fingerprints(model, params, capacity), extra)
+
+
+def _spec_fingerprints(model, params, capacity, k):
+    """The verify program's authored census — the subject of the
+    ``spec_verify_step`` pin — alongside the decode fingerprints."""
+    from chainermn_tpu.analysis import budget_for
+
+    fp = _fingerprints(model, params, capacity)
+    eng = _engine(model, params, capacity)
+    tr = eng.collective_trace("verify", bucket=k)
+    census = tr.census()
+    ceiling = budget_for("spec_verify_step")
+    within = all(census.get(c, 0) <= n for c, n in ceiling.items())
+    fp.update({
+        "verify_census": census,
+        "verify_trace_hash": tr.trace_hash()[:12],
+        "spec_budget": "spec_verify_step",
+        "spec_budget_within": bool(within),
+    })
+    return fp
+
+
+def _run_spec_rung(name, k):
+    model, params = _fixture()
+    capacity, n_requests = CAPACITY, 2 * CAPACITY
+    if k == 0:
+        _run_rung(name, capacity, n_requests)
+        return
+    draft, dparams = _draft_fixture()
+    samples, reports = [], []
+    for _ in range(max(REPEATS, 1)):
+        t1, n1, _ = _serve_spec(
+            model, params, draft, dparams, capacity, n_requests, K, k
+        )
+        t2, n2, rep2 = _serve_spec(
+            model, params, draft, dparams, capacity, n_requests,
+            2 * K, k
+        )
+        samples.append(t2 - t1)
+        reports.append((n2 - n1, rep2))
+    spec = reports[-1][1].get("speculative", {})
+    extra = {
+        "spec_k": k,
+        "acceptance_rate": spec.get("acceptance_rate", 0.0),
+        "verify_steps": spec.get("verify_steps", 0),
+        "draft_model": f"lm1x{max(16, D_MODEL // 2)}",
+    }
+    _emit_row(name, samples, reports,
+              _spec_fingerprints(model, params, capacity, k), extra)
 
 
 def main():
@@ -180,6 +374,14 @@ def main():
         "decode_saturated": lambda: _run_rung(
             "decode_saturated", CAPACITY, 2 * CAPACITY
         ),
+        "decode_prefix_shared": lambda: _run_prefix_rung(
+            "decode_prefix_shared", True
+        ),
+        "decode_prefix_cold": lambda: _run_prefix_rung(
+            "decode_prefix_cold", False
+        ),
+        "decode_spec_k4": lambda: _run_spec_rung("decode_spec_k4", 4),
+        "decode_spec_off": lambda: _run_spec_rung("decode_spec_off", 0),
     }
     for name in (sys.argv[1:] or list(rungs)):
         try:

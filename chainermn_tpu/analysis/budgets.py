@@ -107,6 +107,16 @@ BUDGETS = {
     # program has the identical census (the pin is enforced on both
     # traces in tests/test_serving.py).
     "decode_step": {"all_reduce": 4},
+    # ISSUE 17: the speculative verify program (serving.decode
+    # verify_step, same 2-layer fixture) scores k draft tokens per
+    # slot in ONE batched step — the s=k program runs the SAME two
+    # row-parallel psums per layer as the s=1 decode step, so the k
+    # tokens amortize an unchanged collective count.  That amortization
+    # is speculative decode's entire value on a latency-bound
+    # interconnect, so the ceiling is EXACT like decode_step's: a
+    # verify program that added even one collective would scale its
+    # cost with k and erase the win.
+    "spec_verify_step": {"all_reduce": 4},
 }
 
 # ----------------------------------------------------------------------

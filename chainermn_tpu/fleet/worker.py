@@ -948,6 +948,145 @@ def scenario_serving_drain_cycle(pid, nproc, scratch, label, args):
 
 
 # ----------------------------------------------------------------------
+def _spec_fixture(n_requests: int):
+    """Speculative-burst stream: every prompt opens with the SAME
+    page-aligned 8-token system prefix (page_size is 8, so admission
+    aliases exactly one page cross-request), then a distinct tail.
+    ``max_new`` is staggered so requests retire at different steps and
+    the shared page's refcount walks down one release at a time."""
+    import numpy as np
+
+    model, params, _ = _serving_fixture(0)
+    rng = np.random.RandomState(11)
+    sys_prefix = rng.randint(0, 64, 8).tolist()
+    stream = [
+        ("s%d" % i,
+         sys_prefix + rng.randint(0, 64, 1 + i % 3).tolist(),
+         5 + i % 3)
+        for i in range(n_requests)
+    ]
+    return model, params, stream
+
+
+def _spec_replica(model, params, journal, pid, nproc, k):
+    """A :class:`DecodeReplica` running a :class:`SpeculativeBatcher`:
+    a half-width 1-layer draft (deterministic seed — identical on every
+    process) proposes against the target fixture, with the draft cache
+    built to the target's exact geometry."""
+    import jax
+    import jax.numpy as jnp
+    from chainermn_tpu.models.transformer import TransformerLM
+    from chainermn_tpu.serving.decode import DecodeEngine
+    from chainermn_tpu.serving.replica import DecodeReplica
+    from chainermn_tpu.serving.speculative import SpeculativeBatcher
+
+    engine = _serving_engine(model, params)
+    draft_model = TransformerLM(vocab_size=64, d_model=16, n_heads=2,
+                                n_layers=1, max_len=64)
+    draft_params = draft_model.init(
+        {"params": jax.random.PRNGKey(7),
+         "dropout": jax.random.PRNGKey(8)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    draft = DecodeEngine(
+        draft_model, draft_params,
+        capacity=engine.capacity, page_size=engine.page_size,
+        pages_per_slot=engine.pages_per_slot,
+        num_pages=engine.cache.num_pages,
+    )
+    batcher = SpeculativeBatcher(engine, draft, k=k)
+    return DecodeReplica(engine, journal, replica_index=pid,
+                         n_replicas=nproc, batcher=batcher), batcher
+
+
+def scenario_serving_spec_burst(pid, nproc, scratch, label, args):
+    """ISSUE 17 fleet leg, phase 1: N speculative replicas (draft +
+    target riding one allocator each) partition a shared-prefix stream;
+    the schedule kills one replica at its 2nd ``serving.spec_verify``
+    call — mid-burst, with draft proposals in flight, live shared pages
+    (refcount > 1), and the target cache mid-reservation.  Survivors
+    complete exactly their own shares; each checks its allocator drained
+    clean (refcount invariants hold, every page back on the free list,
+    in BOTH caches) — a speculative crash must not leak the survivors'
+    sharing state."""
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.replica import RequestJournal, claim
+
+    n_requests = int(args.get("n_requests", 12))
+    k = int(args.get("k", 4))
+    model, params, stream = _spec_fixture(n_requests)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    if pid == 0:
+        journal.submit_all([Request(p, m, id=i) for i, p, m in stream])
+    journal.wait_until(len(stream))
+    replica, batcher = _spec_replica(model, params, journal, pid, nproc,
+                                     k)
+    served = replica.serve()  # the victim dies inside (schedule spec)
+    by_id = {r["id"]: r for r in journal.requests()}
+    want = {r["id"] for r in claim(list(by_id.values()), pid, nproc)}
+    assert set(served) == want, (sorted(served), sorted(want))
+    # the speculative path actually ran, and sharing was live
+    assert batcher.verify_steps > 0, "no verify step fired"
+    assert batcher.prefix_hits >= 1, "shared prefix never aliased"
+    # drained clean: refcounts walked back to zero, conservation holds
+    for cache in (replica.engine.cache, batcher.draft.cache):
+        cache.check_invariants()
+        assert cache.used_pages == 0, cache.used_pages
+    finish_and_exit({
+        "served": sorted(served), "replica": pid,
+        "verify_steps": batcher.verify_steps,
+        "prefix_hits": batcher.prefix_hits,
+        "tokens_proposed": batcher.tokens_proposed,
+        "tokens_accepted": batcher.tokens_accepted,
+    }, linger_s=float(args.get("linger_s", 1.5)))
+
+
+def scenario_serving_spec_resume(pid, nproc, scratch, label, args):
+    """Phase 2: the survivors re-form at the new replica count; the
+    victim's pending share re-derives over ``seq % n_survivors`` and
+    each resumed request serves SPECULATIVELY again — and every
+    journaled request, phase-1 and resumed alike, matches a fresh
+    single-engine plain-decode oracle bit-for-bit (greedy-exact
+    acceptance makes the speculative transcript the plain transcript by
+    construction, draft crash or no)."""
+    from chainermn_tpu.serving.replica import RequestJournal, claim
+
+    n_requests = int(args.get("n_requests", 12))
+    k = int(args.get("k", 4))
+    model, params, stream = _spec_fixture(n_requests)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    pending = journal.pending()
+    pending_before = len(pending)
+    assert pending_before > 0, "phase 1 should have left unserved work"
+    my_share = {r["id"] for r in claim(pending, pid, nproc)}
+    replica, batcher = _spec_replica(model, params, journal, pid, nproc,
+                                     k)
+    served = replica.serve()
+    assert set(served) == my_share, (sorted(served), sorted(my_share))
+    journal.wait_until_complete(n_requests)
+    results = journal.results()
+    assert sorted(results) == sorted(i for i, _p, _m in stream)
+    oracle_eng = _serving_engine(model, params)
+    mismatches = [
+        rid for rid, prompt, max_new in stream
+        if results[rid]["tokens"] != oracle_eng.generate(prompt, max_new)
+    ]
+    assert not mismatches, mismatches
+    for cache in (replica.engine.cache, batcher.draft.cache):
+        cache.check_invariants()
+        assert cache.used_pages == 0, cache.used_pages
+    return {
+        "served": sorted(served), "replica": pid,
+        "pending_before": pending_before,
+        "completed": len(results),
+        "bit_identical": True,
+        "verify_steps": batcher.verify_steps,
+        "prefix_hits": batcher.prefix_hits,
+        "acceptance_rate": batcher.acceptance_rate,
+    }
+
+
+# ----------------------------------------------------------------------
 def main():
     scenario, port, pid, nproc, scratch, label, args_json = sys.argv[1:8]
     pid, nproc = int(pid), int(nproc)

@@ -18,7 +18,11 @@ worlds re-form through ``resilience.elastic``.
   Pallas fast path (``ops.flash_decode``).
 * :mod:`.batcher` — :class:`ContinuousBatcher`: the request queue and
   the padded-slot iteration loop (join/leave between decode steps,
-  request retry/timeout, per-token latency histograms).
+  request retry/timeout, per-token latency histograms), with
+  copy-on-write prefix sharing across requests by default.
+* :mod:`.speculative` — :class:`SpeculativeBatcher`: draft-propose /
+  target-verify decode (k tokens per 2-psum/layer verify step,
+  greedy-exact acceptance, bit-identical to plain decode).
 * :mod:`.replica` — elastic decode replicas over a shared-FS request
   journal: deterministic request claiming, drain on preemption,
   ``serve_elastic`` world re-formation, KV-page warm start.
@@ -31,6 +35,7 @@ from .kv_cache import (  # noqa: F401
     CacheAdmissionError,
     NULL_PAGE,
     PagedKVCache,
+    PrefixMatch,
     pages_needed,
     reshard_kv_state,
 )
@@ -43,6 +48,7 @@ from .batcher import (  # noqa: F401
     ContinuousBatcher,
     Request,
 )
+from .speculative import SpeculativeBatcher  # noqa: F401
 from .replica import (  # noqa: F401
     DecodeReplica,
     ReplicaAutoscaler,

@@ -72,6 +72,9 @@ class Request:
         self.state = QUEUED
         self.tokens: List[int] = []
         self.slot: Optional[int] = None
+        # cache positions inherited from a prefix-shared admission
+        # (0 = cold prefill of the whole prompt)
+        self.shared_len = 0
         self.retries = 0
         self.error: Optional[str] = None
         self.submitted_at: Optional[float] = None
@@ -102,7 +105,8 @@ class ContinuousBatcher:
     slot set, retire leaves — repeat."""
 
     def __init__(self, engine, *, max_retries: int = 1,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 share_prefixes: bool = True):
         comm = getattr(engine, "comm", None)
         if (timeout_s is not None and comm is not None
                 and getattr(comm, "process_count", 1) > 1):
@@ -123,12 +127,22 @@ class ContinuousBatcher:
         self.engine = engine
         self.max_retries = int(max_retries)
         self.timeout_s = timeout_s
+        # prefix sharing is pure deterministic allocator bookkeeping,
+        # so it is on by default — except under the dense-oracle
+        # layout, whose per-slot contiguous cache has no block table
+        # to alias (the oracle must stay the UNSHARED reference)
+        self.share_prefixes = (
+            bool(share_prefixes)
+            and getattr(engine, "layout", "paged") == "paged"
+        )
         self.queue: deque = deque()
         self.active: Dict[int, Request] = {}
         self.finished: Dict[str, Request] = {}
         self.registry = MetricsRegistry()
         self.steps = 0
         self.tokens_generated = 0
+        self.prefix_hits = 0
+        self.prefix_tokens_shared = 0
 
     # -- submission -----------------------------------------------------
     def submit(self, request: Request) -> Request:
@@ -164,22 +178,46 @@ class ContinuousBatcher:
                 r.submitted_at = now
 
     # -- one iteration --------------------------------------------------
-    def _admit_joins(self) -> List[Request]:
+    def _admit_joins(self, limit: Optional[int] = None) -> List[Request]:
         joins = []
-        while self.queue:
+        while self.queue and (limit is None or len(joins) < limit):
             r = self.queue[0]
-            if not self.engine.cache.can_admit(r.total_tokens):
+            prefix = (
+                self.engine.cache.lookup_prefix(r.prompt)
+                if self.share_prefixes else None
+            )
+            if not self.engine.cache.can_admit(r.total_tokens,
+                                               prefix=prefix):
                 break
             self.queue.popleft()
-            r.slot = self.engine.admit(r.total_tokens)
+            r.slot = self.engine.admit(r.total_tokens, prefix=prefix)
+            r.shared_len = prefix.shared_len if prefix else 0
+            if prefix is not None:
+                self.prefix_hits += 1
+                self.prefix_tokens_shared += prefix.shared_len
             r.state = RUNNING
             self.active[r.slot] = r
             joins.append(r)
         return joins
 
+    # -- engine hooks (SpeculativeBatcher mirrors these onto its draft
+    # engine's allocator, so the hook is the ONLY place slots move) ----
+    def _release_slot(self, slot: int) -> None:
+        self.engine.release(slot)
+
+    def _evict_slot(self, slot: int) -> None:
+        self.engine.cache.evict(slot)
+
+    def _prefill_one(self, r: Request) -> np.ndarray:
+        logits = self.engine.prefill(r.slot, r.prompt)
+        if self.share_prefixes:
+            self.engine.cache.register_prefix(r.slot, r.prompt)
+        return logits
+
     def _retire(self, r: Request) -> None:
-        self.engine.release(r.slot)
-        del self.active[r.slot]
+        slot = r.slot
+        self._release_slot(slot)
+        del self.active[slot]
         r.slot = None
         r.state = DONE
         r.done_at = time.monotonic()
@@ -187,8 +225,9 @@ class ContinuousBatcher:
 
     def _fail(self, r: Request, why: str) -> None:
         if r.slot is not None and r.slot in self.active:
-            self.engine.cache.evict(r.slot)
-            del self.active[r.slot]
+            slot = r.slot
+            self._evict_slot(slot)
+            del self.active[slot]
             r.slot = None
         r.state = FAILED
         r.error = why
@@ -201,8 +240,9 @@ class ContinuousBatcher:
         replays bit-identically from the prompt) and re-queue at the
         front — bounded by ``max_retries``."""
         if r.slot is not None and r.slot in self.active:
-            self.engine.cache.evict(r.slot)
-            del self.active[r.slot]
+            slot = r.slot
+            self._evict_slot(slot)
+            del self.active[slot]
             r.slot = None
         r.retries += 1
         if r.retries > self.max_retries:
@@ -230,6 +270,25 @@ class ContinuousBatcher:
                  waited=round(now - r.submitted_at, 3))
             self._fail(r, f"timeout after {self.timeout_s}s")
 
+    def _decode_once(self) -> None:
+        """One compiled decode step for the whole slot set, appending
+        one token per active request (``SpeculativeBatcher`` overrides
+        this with the draft-propose / target-verify iteration)."""
+        toks = np.zeros((self.engine.capacity,), np.int32)
+        for slot, r in self.active.items():
+            toks[slot] = r.tokens[-1] if r.tokens else 0
+        t0 = time.monotonic()
+        logits = self.engine.decode_step(toks)
+        t1 = time.monotonic()
+        # every active request received one token this iteration: the
+        # iteration wall IS the per-token latency sample
+        for slot, r in list(self.active.items()):
+            self.registry.histogram(
+                "serving.token_latency").observe(t1 - t0)
+            self._append_token(r, int(np.argmax(logits[slot])), t1)
+            if r._finished():
+                self._retire(r)
+
     def _append_token(self, r: Request, tok: int, t_now: float) -> None:
         r.tokens.append(int(tok))
         self.tokens_generated += 1
@@ -247,35 +306,27 @@ class ContinuousBatcher:
         with _obs.span("serving.step", queued=len(self.queue),
                        active=len(self.active)):
             self._check_timeouts()
-            joins = self._admit_joins()
             try:
-                for r in joins:
+                # admit-and-prefill ONE request at a time: the prefill
+                # registers the prompt's prefix chains, so later
+                # requests in the same join wave already alias them
+                # (a batch of identical system prompts shares from the
+                # second request on, not from the next iteration)
+                while True:
+                    joins = self._admit_joins(limit=1)
+                    if not joins:
+                        break
+                    r = joins[0]
                     t0 = time.monotonic()
-                    logits = self.engine.prefill(r.slot, r.prompt)
+                    logits = self._prefill_one(r)
                     t1 = time.monotonic()
                     self.registry.histogram(
                         "serving.prefill_latency").observe(t1 - t0)
                     self._append_token(r, int(np.argmax(logits)), t1)
-                for r in [r for r in joins if r._finished()]:
-                    self._retire(r)
+                    if r._finished():
+                        self._retire(r)
                 if self.active:
-                    toks = np.zeros((self.engine.capacity,), np.int32)
-                    for slot, r in self.active.items():
-                        toks[slot] = r.tokens[-1] if r.tokens else 0
-                    t0 = time.monotonic()
-                    logits = self.engine.decode_step(toks)
-                    t1 = time.monotonic()
-                    # every active request received one token this
-                    # iteration: the iteration wall IS the per-token
-                    # latency sample
-                    for slot, r in list(self.active.items()):
-                        self.registry.histogram(
-                            "serving.token_latency").observe(t1 - t0)
-                        self._append_token(
-                            r, int(np.argmax(logits[slot])), t1
-                        )
-                        if r._finished():
-                            self._retire(r)
+                    self._decode_once()
                     self.steps += 1
             except PreemptionError:
                 # a preemption NOTICE is not a retryable fault — it is
@@ -324,6 +375,8 @@ class ContinuousBatcher:
                         if r.state == DONE),
             "failed": sum(1 for r in self.finished.values()
                           if r.state == FAILED),
+            "prefix_hits": self.prefix_hits,
+            "prefix_tokens_shared": self.prefix_tokens_shared,
         }
         for name in ("serving.token_latency", "serving.ttft",
                      "serving.prefill_latency"):

@@ -48,7 +48,7 @@ from jax import lax
 from ..models.transformer import MlpBlock, TpMlpBlock, TransformerLM
 from ..observability import timeline as _obs
 from ..resilience import fault_injection as _fi
-from .kv_cache import PagedKVCache, pages_needed
+from .kv_cache import NULL_PAGE, PagedKVCache, pages_needed
 
 _LAYOUTS = ("paged", "dense")
 _ATTENTION_IMPLS = ("dense", "flash")
@@ -61,7 +61,15 @@ def _write_paged(kl, vl, k, v, tables, lengths, page_size):
     the null page — in-bounds garbage nothing ever reads."""
     b, s = k.shape[0], k.shape[1]
     pos = lengths[:, None] + jnp.arange(s)[None, :]          # (b, s)
-    page = jnp.take_along_axis(tables, pos // page_size, axis=1)
+    idx = pos // page_size
+    # positions past the table width (a speculative verify near the end
+    # of a slot's reservation) must land on the null page — the default
+    # clamping gather would silently redirect them into the slot's LAST
+    # real page and clobber live history
+    page = jnp.take_along_axis(
+        tables, jnp.clip(idx, 0, tables.shape[1] - 1), axis=1
+    )
+    page = jnp.where(idx >= tables.shape[1], NULL_PAGE, page)
     off = pos % page_size
     flat = lambda a: a.reshape(b * s, *a.shape[2:])
     kl = kl.at[flat(page), flat(off)].set(flat(k))
@@ -389,14 +397,15 @@ class DecodeEngine:
         return max(pages_needed(prompt_len, self.page_size)
                    * self.page_size, self.page_size)
 
-    def admit(self, total_tokens: int) -> int:
+    def admit(self, total_tokens: int, prefix=None,
+              slot: Optional[int] = None) -> int:
         if total_tokens > self.max_total:
             raise ValueError(
                 f"request needs {total_tokens} cache positions > "
                 f"max_total={self.max_total} (pages_per_slot * "
                 "page_size, capped by model.max_len)"
             )
-        return self.cache.admit(total_tokens)
+        return self.cache.admit(total_tokens, prefix=prefix, slot=slot)
 
     def release(self, slot: int) -> None:
         self.cache.release(slot)
@@ -415,16 +424,38 @@ class DecodeEngine:
         slot's pages; returns the next-token logits row (vocab,).
         The prompt is padded to its page bucket — padded positions hold
         garbage k/v that the masked attend never reads and the next
-        writes overwrite."""
+        writes overwrite.
+
+        A slot admitted over a shared prefix starts with
+        ``cache.lengths[slot] > 0``: only the TAIL ``prompt[start:]`` is
+        run (bucketed on the tail length), reading the aliased pages
+        through the block table.  The attend math, mask, and
+        contraction length are those of the full prefill, so the
+        returned logits row is bit-identical to prefilling the whole
+        prompt fresh."""
         prompt = np.asarray(prompt, np.int32)
         n = int(prompt.shape[0])
         if n < 1:
             raise ValueError("empty prompt")
+        start = int(self.cache.lengths[slot])
+        if start >= n:
+            raise ValueError(
+                f"slot {slot} already holds {start} positions >= "
+                f"prompt length {n} (a shared prefix is capped at "
+                "len(prompt)-1 so the tail is never empty)"
+            )
+        nt = n - start
         _fi.fire("serving.prefill")
-        with _obs.span("serving.prefill", slot=slot, prompt=n):
-            bucket = self.prompt_bucket(n)
+        with _obs.span("serving.prefill", slot=slot, prompt=n,
+                       shared=start):
+            bucket = self.prompt_bucket(nt)
             toks = np.zeros((1, bucket), np.int32)
-            toks[0, :n] = prompt
+            toks[0, :nt] = prompt[start:]
+            if self.layout == "paged":
+                # copy-on-write BEFORE the compiled write: a capped
+                # shared prefix puts the first written position inside
+                # a still-shared page
+                self.cache.cow_for_write(slot, bucket)
             if self.layout == "dense":
                 k_in = self.cache.k_pages[:, slot: slot + 1]
                 v_in = self.cache.v_pages[:, slot: slot + 1]
@@ -432,7 +463,8 @@ class DecodeEngine:
                 k_in, v_in = self.cache.k_pages, self.cache.v_pages
             logits, k_out, v_out = self._fn(
                 self.params, jnp.asarray(toks), k_in, v_in,
-                self._tables_for([slot]), jnp.zeros((1,), jnp.int32),
+                self._tables_for([slot]),
+                jnp.asarray(np.array([start], np.int32)),
             )
             if self.layout == "dense":
                 self.cache.k_pages = self.cache.k_pages.at[
@@ -441,8 +473,8 @@ class DecodeEngine:
                     :, slot: slot + 1].set(v_out)
             else:
                 self.cache.set_pages(k_out, v_out)
-            self.cache.advance(slot, n)
-            return np.asarray(logits[0, n - 1])
+            self.cache.advance(slot, nt)
+            return np.asarray(logits[0, nt - 1])
 
     def decode_step(self, tokens: np.ndarray) -> np.ndarray:
         """One token for every slot (the padded slot model: inactive
@@ -456,6 +488,9 @@ class DecodeEngine:
             toks = jnp.asarray(
                 np.asarray(tokens, np.int32).reshape(self.capacity, 1)
             )
+            if self.layout == "paged":
+                for s in active:
+                    self.cache.cow_for_write(s, 1)
             if self.layout == "dense":
                 tables = self._tables_for(list(range(self.capacity)))
             else:
@@ -470,6 +505,43 @@ class DecodeEngine:
                 self.cache.advance(s, 1)
             self.steps += 1
             return np.asarray(logits[:, 0])
+
+    def verify_step(self, tokens: np.ndarray) -> np.ndarray:
+        """Speculative verify: score ``k`` pending tokens per slot in
+        ONE batched step over the same compiled program family as
+        :meth:`decode_step` (shape ``(capacity, k)`` — fixed across
+        join/leave, so no retrace).  ``tokens[s, j]`` is the j-th
+        pending token of slot ``s``; returns ``(capacity, k, vocab)``
+        logits where row ``j`` conditions on tokens ``0..j``.  Cache
+        lengths do NOT advance — the caller commits the accepted count
+        via :meth:`PagedKVCache.advance` (and rewinds a mirrored draft
+        with :meth:`PagedKVCache.rollback`); positions written past the
+        commit are overwritten by the next step's writes before its
+        masked attend can read them."""
+        toks = np.asarray(tokens, np.int32)
+        if toks.ndim != 2 or toks.shape[0] != self.capacity:
+            raise ValueError(
+                f"verify_step wants (capacity, k) tokens, got {toks.shape}"
+            )
+        k = int(toks.shape[1])
+        _fi.fire("serving.spec_verify")
+        active = [s for s in range(self.capacity) if self.cache.active[s]]
+        with _obs.span("serving.spec_verify", active=len(active), k=k):
+            if self.layout == "paged":
+                for s in active:
+                    self.cache.cow_for_write(s, k)
+            if self.layout == "dense":
+                tables = self._tables_for(list(range(self.capacity)))
+            else:
+                tables = self.cache.tables_array()
+            logits, k_out, v_out = self._fn(
+                self.params, jnp.asarray(toks), self.cache.k_pages,
+                self.cache.v_pages, tables,
+                self.cache.lengths_array(),
+            )
+            self.cache.set_pages(k_out, v_out)
+            self.steps += 1
+            return np.asarray(logits)
 
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
                  eos_id: Optional[int] = None) -> list:
@@ -497,14 +569,19 @@ class DecodeEngine:
 
     # -- analysis hooks -------------------------------------------------
     def _example_args(self, phase: str = "decode", bucket: int = 0):
-        s = 1 if phase == "decode" else (bucket or self.page_size)
-        b = self.capacity if phase == "decode" else 1
+        if phase == "decode":
+            b, s = self.capacity, 1
+        elif phase == "verify":
+            # the speculative verify program: full capacity, k tokens
+            b, s = self.capacity, (bucket or 4)
+        else:
+            b, s = 1, (bucket or self.page_size)
         toks = jnp.zeros((b, s), jnp.int32)
         if self.layout == "dense":
             tables = jnp.zeros((b, 1), jnp.int32)
-            k = self.cache.k_pages[:, :b] if phase != "decode" else \
+            k = self.cache.k_pages[:, :b] if b < self.capacity else \
                 self.cache.k_pages
-            v = self.cache.v_pages[:, :b] if phase != "decode" else \
+            v = self.cache.v_pages[:, :b] if b < self.capacity else \
                 self.cache.v_pages
         else:
             tables = jnp.zeros((b, self.pages_per_slot), jnp.int32)

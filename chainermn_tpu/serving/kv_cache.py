@@ -27,11 +27,29 @@ Design points:
   admission, where the batcher can queue.  Pages are unit-granularity,
   so the pool cannot fragment: ``can_admit`` is exactly "enough free
   pages and a free slot" (pinned by test).
+* **Copy-on-write prefix sharing.**  Pages carry refcounts.  A request
+  whose prompt's page-aligned prefix hashes to an already-prefilled
+  page run (``lookup_prefix`` over the ``register_prefix`` index) is
+  admitted with its block table ALIASING those pages (refcount++) and
+  only the tail freshly allocated — ``lengths`` starts at the shared
+  length, so the batcher prefills only the remainder.  Writes into a
+  still-shared page (the capped final page of a fully-matched prompt)
+  go through ``cow_for_write``: the page is copied to a page reserved
+  at admission, the writer's table entry swaps to the copy, and the
+  original's refcount drops — a reader never observes another
+  request's writes.  ``release``/``evict`` decrement and return a page
+  to the free list only at refcount 0.  Sharing is pure host
+  bookkeeping over the same deterministic allocator, so SPMD replicas
+  stay in lockstep and the shared-prefix serve is bit-identical to the
+  unshared oracle while ``used_pages`` (distinct pages) drops.
 * **Deterministic eviction.**  ``choose_victim()`` names the most
-  recently admitted active slot (LIFO — the request that joined last
-  has done the least work).  ``evict()`` releases a slot's pages and
-  returns them to the sorted free list; the batcher re-queues the
-  request (greedy decode replays bit-identically from the prompt).
+  recently admitted active slot whose pages are ALL unshared
+  (refcount 1) — LIFO over unshared slots only, so evicting the
+  victim can never free or disturb a page a live request still reads
+  (``check_invariants`` pins that a victim holds no refcount>1 page).
+  ``evict()`` releases a slot's pages and returns them to the sorted
+  free list; the batcher re-queues the request (greedy decode replays
+  bit-identically from the prompt).
 * **Checkpoint round-trip.**  ``state_dict()`` is a flat dict of
   arrays that the existing checkpoint layer
   (``extensions.checkpoint``) snapshots as-is; ``load_state_dict``
@@ -47,13 +65,37 @@ Design points:
 
 from __future__ import annotations
 
+import hashlib
 import math
-from typing import Dict, List, Optional, Sequence
+from typing import Dict, List, NamedTuple, Optional, Sequence, Tuple
 
 import jax.numpy as jnp
 import numpy as np
 
 NULL_PAGE = 0
+
+
+class PrefixMatch(NamedTuple):
+    """A prefix-index hit: the page run to alias at admission.
+
+    ``pages``: the existing pages, in table order.  ``shared_len``:
+    cache positions the aliasing slot starts with (its ``lengths``
+    value at admit — capped at one BELOW the new prompt's length so the
+    tail prefill always has a token to produce logits from).  ``cow``:
+    the cap landed mid-page, so the final aliased page will be written
+    and a copy-on-write page must be reserved at admission."""
+
+    pages: Tuple[int, ...]
+    shared_len: int
+    cow: bool
+
+
+def _chain_hash(prev: str, chunk: Sequence[int]) -> str:
+    """Deterministic cumulative hash of page-aligned token chunks
+    (sha1, not ``hash()`` — PYTHONHASHSEED must not desynchronize SPMD
+    replicas' admission schedules)."""
+    data = prev + ":" + ",".join(str(int(t)) for t in chunk)
+    return hashlib.sha1(data.encode()).hexdigest()
 
 
 class CacheAdmissionError(RuntimeError):
@@ -118,6 +160,19 @@ class PagedKVCache:
         # admission order (slot ids, oldest first) — the deterministic
         # eviction victim is the tail
         self._admit_order: List[int] = []
+        # per-page refcounts: 0 = free, 1 = privately owned, >1 =
+        # prefix-shared across slots.  Pages return to the free list
+        # only at refcount 0.
+        self._refcounts = np.zeros((self.num_pages,), np.int32)
+        # prefix index: chain hash of page-aligned prompt chunks ->
+        # (page run, token count).  Entries drop when any of their
+        # pages is freed (the content is gone).
+        self._prefix_index: Dict[str, Tuple[Tuple[int, ...], int]] = {}
+        # per-slot page reserved at a capped alias-admission for the
+        # inevitable copy-on-write into the final shared page —
+        # earmarked so a running request never hits mid-stream
+        # out-of-pages (the allocator's no-midstream-failure contract)
+        self._cow_reserve: Dict[int, int] = {}
 
     # -- pool accounting ------------------------------------------------
     @property
@@ -126,7 +181,10 @@ class PagedKVCache:
 
     @property
     def used_pages(self) -> int:
-        return sum(len(p) for p in self._slot_pages.values())
+        """DISTINCT pages currently allocated (a prefix-shared page
+        counts once however many block tables alias it) — the quantity
+        prefix sharing exists to shrink."""
+        return self.num_pages - 1 - len(self._free_pages)
 
     @property
     def free_slots(self) -> List[int]:
@@ -138,33 +196,135 @@ class PagedKVCache:
 
     def check_invariants(self) -> None:
         """Allocator invariants, asserted by tests after every op mix:
-        page sets disjoint, null page never owned, conservation (free +
-        used == pool), free list sorted (determinism), tables consistent
-        with ownership."""
-        owned: List[int] = []
+        refcounts match table ownership exactly, null page never owned,
+        conservation (distinct owned + CoW reserves + free == pool),
+        free list sorted (determinism), tables consistent with
+        ownership, the prefix index only names live pages — and the
+        deterministic eviction victim never holds a shared page, so
+        evicting it can never free a refcount>1 page."""
+        owner_count: Dict[int, int] = {}
         for slot, pages in self._slot_pages.items():
             assert self.active[slot], f"slot {slot} owns pages inactive"
             assert NULL_PAGE not in pages, "null page allocated"
+            assert len(set(pages)) == len(pages), "page twice in a slot"
             assert list(self.block_tables[slot][: len(pages)]) == pages
-            owned += pages
-        assert len(set(owned)) == len(owned), "page double-owned"
-        assert not set(owned) & set(self._free_pages), "free page owned"
-        assert len(owned) + len(self._free_pages) == self.num_pages - 1
+            for p in pages:
+                owner_count[p] = owner_count.get(p, 0) + 1
+        reserved = set(self._cow_reserve.values())
+        assert len(reserved) == len(self._cow_reserve)
+        for slot, p in self._cow_reserve.items():
+            assert slot in self._slot_pages, "CoW reserve w/o slot"
+            assert p != NULL_PAGE and p not in owner_count
+            assert int(self._refcounts[p]) == 1
+        for p, n in owner_count.items():
+            assert int(self._refcounts[p]) == n, f"refcount drift: {p}"
+        free = set(self._free_pages)
+        assert not free & set(owner_count), "free page owned"
+        assert not free & reserved, "free page reserved"
+        assert all(int(self._refcounts[p]) == 0 for p in free)
+        assert (len(owner_count) + len(reserved) + len(free)
+                == self.num_pages - 1)
         assert self._free_pages == sorted(self._free_pages)
         assert sorted(self._admit_order) == sorted(self._slot_pages)
+        victim = self.choose_victim()
+        if victim is not None:
+            assert all(int(self._refcounts[p]) == 1
+                       for p in self._slot_pages[victim]), \
+                "eviction victim holds a shared page"
+        for h, (pages, ntok) in self._prefix_index.items():
+            assert ntok % self.page_size == 0
+            assert len(pages) == ntok // self.page_size
+            assert all(int(self._refcounts[p]) >= 1 for p in pages), \
+                "prefix index names a freed page"
+
+    # -- prefix index ---------------------------------------------------
+    def register_prefix(self, slot: int, tokens: Sequence[int]) -> int:
+        """Index ``slot``'s page-aligned prompt prefixes for future
+        cross-request sharing (call after the prompt is prefilled, so
+        the pages actually hold the hashed tokens).  Every fully
+        page-aligned prefix is registered — all such pages sit strictly
+        below the slot's write frontier (prefill writes all
+        ``len(tokens)`` prompt positions; decode writes continue AT
+        position ``len(tokens)``), so registered pages are immutable
+        for the registrant's lifetime and only ALIASING slots — which
+        carry a CoW reserve from admission — can ever need
+        copy-on-write.  First registration of a chain wins; returns
+        the number of NEW chain entries."""
+        if slot not in self._slot_pages:
+            raise KeyError(f"slot {slot} owns no pages")
+        tokens = [int(t) for t in tokens]
+        pages = self._slot_pages[slot]
+        added, h = 0, ""
+        for m in range(1, len(tokens) // self.page_size + 1):
+            h = _chain_hash(
+                h, tokens[(m - 1) * self.page_size: m * self.page_size]
+            )
+            if h not in self._prefix_index:
+                self._prefix_index[h] = (
+                    tuple(pages[:m]), m * self.page_size
+                )
+                added += 1
+        return added
+
+    def lookup_prefix(self, tokens: Sequence[int]) -> Optional[PrefixMatch]:
+        """Longest indexed page-aligned prefix of ``tokens``, capped at
+        ``len(tokens) - 1`` so the tail prefill always has at least one
+        token (a fully-matched prompt aliases ALL its pages but starts
+        one position short and copy-on-writes the final page).  Chains
+        are prefix-closed (``register_prefix`` adds every prefix), so
+        the scan stops at the first missing link."""
+        tokens = [int(t) for t in tokens]
+        if len(tokens) < 2 or not self._prefix_index:
+            return None
+        best, h = None, ""
+        for m in range(1, len(tokens) // self.page_size + 1):
+            h = _chain_hash(
+                h, tokens[(m - 1) * self.page_size: m * self.page_size]
+            )
+            hit = self._prefix_index.get(h)
+            if hit is None:
+                break
+            best = hit
+        if best is None:
+            return None
+        pages, ntok = best
+        shared_len = min(ntok, len(tokens) - 1)
+        return PrefixMatch(tuple(pages), shared_len,
+                           shared_len % self.page_size != 0)
+
+    def _drop_index_entries(self, freed: Sequence[int]) -> None:
+        gone = set(freed)
+        if not gone:
+            return
+        self._prefix_index = {
+            h: e for h, e in self._prefix_index.items()
+            if not gone & set(e[0])
+        }
 
     # -- admission ------------------------------------------------------
-    def can_admit(self, total_tokens: int) -> bool:
+    def can_admit(self, total_tokens: int,
+                  prefix: Optional[PrefixMatch] = None) -> bool:
         need = pages_needed(total_tokens, self.page_size)
         if need > self.pages_per_slot:
             return False
+        if prefix is not None:
+            need = need - len(prefix.pages) + (1 if prefix.cow else 0)
         return bool(self.free_slots) and need <= len(self._free_pages)
 
-    def admit(self, total_tokens: int) -> int:
+    def admit(self, total_tokens: int,
+              prefix: Optional[PrefixMatch] = None,
+              slot: Optional[int] = None) -> int:
         """Reserve a slot and its pages; returns the slot id.  The
         lowest free slot and the lowest free pages are taken (sorted
         free list), so admission is a pure function of allocator
-        state."""
+        state.  With ``prefix`` (a :meth:`lookup_prefix` hit), the
+        slot's table ALIASES the matched pages (refcount++), only the
+        tail is freshly allocated, and ``lengths`` starts at the
+        shared length — the caller prefills just the remainder.  A
+        capped match additionally earmarks one copy-on-write page.
+        An explicit ``slot`` (must be free) overrides the lowest-free
+        choice — the speculative batcher uses it to mirror a
+        warm-started target's slot layout onto its draft cache."""
         need = pages_needed(total_tokens, self.page_size)
         if need > self.pages_per_slot:
             raise CacheAdmissionError(
@@ -174,28 +334,70 @@ class PagedKVCache:
         free = self.free_slots
         if not free:
             raise CacheAdmissionError("no free decode slot")
-        if need > len(self._free_pages):
+        if slot is not None:
+            if slot not in free:
+                raise CacheAdmissionError(f"slot {slot} is not free")
+            free = [int(slot)]
+        shared: List[int] = []
+        shared_len = 0
+        reserve: Optional[int] = None
+        if prefix is not None:
+            shared = list(prefix.pages)
+            shared_len = int(prefix.shared_len)
+            if shared_len >= total_tokens or len(shared) > need:
+                raise CacheAdmissionError(
+                    f"prefix ({len(shared)} pages / {shared_len} "
+                    f"tokens) does not fit total_tokens={total_tokens}"
+                )
+            if any(int(self._refcounts[p]) < 1 for p in shared):
+                raise CacheAdmissionError(
+                    "stale prefix: an aliased page was freed"
+                )
+        n_fresh = need - len(shared)
+        n_take = n_fresh + (1 if prefix is not None and prefix.cow else 0)
+        if n_take > len(self._free_pages):
             raise CacheAdmissionError(
-                f"need {need} pages, {len(self._free_pages)} free"
+                f"need {n_take} pages, {len(self._free_pages)} free"
             )
         slot = free[0]
-        pages, self._free_pages = (
-            self._free_pages[:need], self._free_pages[need:]
-        )
+        fresh = self._free_pages[:n_fresh]
+        if prefix is not None and prefix.cow:
+            reserve = self._free_pages[n_fresh]
+        self._free_pages = self._free_pages[n_take:]
+        pages = shared + fresh
+        for p in shared:
+            self._refcounts[p] += 1
+        for p in fresh:
+            self._refcounts[p] = 1
+        if reserve is not None:
+            self._cow_reserve[slot] = reserve
+            self._refcounts[reserve] = 1
         self._slot_pages[slot] = pages
         self.block_tables[slot, :] = NULL_PAGE
         self.block_tables[slot, : len(pages)] = pages
-        self.lengths[slot] = 0
+        self.lengths[slot] = shared_len
         self.active[slot] = True
         self._admit_order.append(slot)
         return slot
 
     def release(self, slot: int) -> None:
-        """Return a slot's pages to the pool (request finished)."""
+        """Decrement the slot's pages; return refcount-0 pages (and the
+        slot's unspent CoW reserve) to the pool.  Prefix-index entries
+        naming a freed page are dropped — the content is gone."""
         if not self.active[slot]:
             raise KeyError(f"slot {slot} is not active")
         pages = self._slot_pages.pop(slot)
-        self._free_pages = sorted(self._free_pages + pages)
+        freed: List[int] = []
+        for p in pages:
+            self._refcounts[p] -= 1
+            if int(self._refcounts[p]) == 0:
+                freed.append(p)
+        reserve = self._cow_reserve.pop(slot, None)
+        if reserve is not None:
+            self._refcounts[reserve] = 0
+            freed.append(reserve)
+        self._free_pages = sorted(self._free_pages + freed)
+        self._drop_index_entries(freed)
         self.block_tables[slot, :] = NULL_PAGE
         self.lengths[slot] = 0
         self.active[slot] = False
@@ -203,25 +405,94 @@ class PagedKVCache:
 
     def choose_victim(self) -> Optional[int]:
         """Deterministic eviction victim: the most recently admitted
-        active slot (least progress lost on replay)."""
-        return self._admit_order[-1] if self._admit_order else None
+        active slot whose pages are ALL unshared (refcount 1) — LIFO
+        over unshared slots only, so eviction never disturbs a page
+        another live request reads.  ``None`` when every active slot
+        holds a shared page (the batcher queues instead)."""
+        for slot in reversed(self._admit_order):
+            if all(int(self._refcounts[p]) == 1
+                   for p in self._slot_pages[slot]):
+                return slot
+        return None
 
     def evict(self, slot: int) -> None:
         """Same pool effect as :meth:`release`; named separately so the
         batcher's logs distinguish retire from preempt."""
         self.release(slot)
 
-    def advance(self, slot: int, n: int = 1) -> None:
-        """Account ``n`` more cache positions written for ``slot``."""
+    def cow_for_write(self, slot: int, n: int = 1) -> bool:
+        """Copy-on-write hook: call BEFORE a compiled step writes ``n``
+        cache positions at ``lengths[slot]``.  If any written position
+        lands in a refcount>1 page, that page is copied into the
+        reserve earmarked at admission, the slot's table entry swaps to
+        the copy, and the original's refcount drops — other aliasing
+        slots keep reading the original untouched.  Returns True if a
+        copy happened.  Only the capped final page of an aliased run
+        can ever be shared at write time (fresh tail pages are private
+        by construction), so one reserve per slot suffices."""
         if not self.active[slot]:
             raise KeyError(f"slot {slot} is not active")
-        new = int(self.lengths[slot]) + n
-        if new > len(self._slot_pages[slot]) * self.page_size:
+        pages = self._slot_pages[slot]
+        start = int(self.lengths[slot])
+        first_pg = start // self.page_size
+        last_pg = min((start + int(n) - 1) // self.page_size,
+                      len(pages) - 1)
+        copied = False
+        for i in range(first_pg, last_pg + 1):
+            p = pages[i]
+            if int(self._refcounts[p]) <= 1:
+                continue
+            q = self._cow_reserve.pop(slot, None)
+            if q is None:
+                raise CacheAdmissionError(
+                    f"slot {slot} must write shared page {p} but holds "
+                    "no CoW reserve"
+                )
+            self.k_pages = self.k_pages.at[:, q].set(self.k_pages[:, p])
+            self.v_pages = self.v_pages.at[:, q].set(self.v_pages[:, p])
+            pages[i] = q
+            self.block_tables[slot, i] = q
+            self._refcounts[p] -= 1
+            copied = True
+        return copied
+
+    def advance(self, slot: int, n: int = 1) -> None:
+        """Account ``n`` more cache positions written for ``slot``.
+        Tripwire: the written range must not cover a still-shared page
+        (the engine calls :meth:`cow_for_write` before the step)."""
+        if not self.active[slot]:
+            raise KeyError(f"slot {slot} is not active")
+        old = int(self.lengths[slot])
+        new = old + n
+        pages = self._slot_pages[slot]
+        if new > len(pages) * self.page_size:
             raise CacheAdmissionError(
-                f"slot {slot} advanced past its {len(self._slot_pages[slot])}"
+                f"slot {slot} advanced past its {len(pages)}"
                 f"-page reservation ({new} tokens)"
             )
+        for i in range(old // self.page_size,
+                       (max(new - 1, old)) // self.page_size + 1):
+            if int(self._refcounts[pages[i]]) > 1:
+                raise CacheAdmissionError(
+                    f"slot {slot} wrote into shared page {pages[i]} "
+                    "without copy-on-write"
+                )
         self.lengths[slot] = new
+
+    def rollback(self, slot: int, length: int) -> None:
+        """Rewind ``lengths[slot]`` to ``length`` (< current) —
+        speculative decode discards rejected draft positions.  Pages
+        are NOT freed (the reservation is untouched; stale positions
+        are simply overwritten by the next write, exactly as the padded
+        decode program already overwrites junk past ``lengths``)."""
+        if not self.active[slot]:
+            raise KeyError(f"slot {slot} is not active")
+        length = int(length)
+        if length < 0 or length > int(self.lengths[slot]):
+            raise ValueError(
+                f"rollback to {length} outside [0, {int(self.lengths[slot])}]"
+            )
+        self.lengths[slot] = length
 
     # -- arrays for the compiled step ----------------------------------
     def tables_array(self) -> jnp.ndarray:
@@ -246,6 +517,12 @@ class PagedKVCache:
             np.int32,
         )
         order = np.array(self._admit_order, np.int32)
+        # fixed (capacity, 2) shape — NEVER zero-size (a 0-row array
+        # fails the orbax backend, silently degrading the checkpoint
+        # to the npz fallback, which cannot round-trip bfloat16 pages)
+        reserve = np.full((self.capacity, 2), -1, np.int32)
+        for i, (s, p) in enumerate(sorted(self._cow_reserve.items())):
+            reserve[i] = (s, p)
         return {
             "k_pages": self.k_pages,
             "v_pages": self.v_pages,
@@ -254,6 +531,12 @@ class PagedKVCache:
             "active": self.active.astype(np.int8),
             "slot_page_counts": counts,
             "admit_order": order,
+            # prefix sharing: refcounts are derivable from table
+            # multiplicity + reserves, but saved anyway so warm start
+            # cross-checks the snapshot (and readers can inspect
+            # sharing without replaying the allocator)
+            "page_refcounts": self._refcounts.copy(),
+            "cow_reserve": reserve,
         }
 
     def load_state_dict(self, state: dict) -> None:
@@ -283,13 +566,40 @@ class PagedKVCache:
             s: [int(p) for p in self.block_tables[s, : int(counts[s])]]
             for s in range(self.capacity) if self.active[s]
         }
+        reserve = np.asarray(
+            state.get("cow_reserve", np.zeros((0, 2))), np.int32
+        ).reshape(-1, 2)
+        self._cow_reserve = {int(s): int(p) for s, p in reserve
+                             if int(s) >= 0}
+        # refcounts are DERIVED from table multiplicity + reserves (the
+        # tables are the ground truth a legacy snapshot also carries);
+        # a snapshot that saved them is cross-checked below
+        self._refcounts = np.zeros((self.num_pages,), np.int32)
+        for pages in self._slot_pages.values():
+            for p in pages:
+                self._refcounts[p] += 1
+        for p in self._cow_reserve.values():
+            self._refcounts[p] = 1
+        if "page_refcounts" in state:
+            saved = np.asarray(
+                state["page_refcounts"], np.int32
+            ).reshape(self.num_pages)
+            if not np.array_equal(saved, self._refcounts):
+                raise ValueError(
+                    "snapshot page_refcounts disagree with block tables"
+                )
         used = {p for pages in self._slot_pages.values() for p in pages}
+        used |= set(self._cow_reserve.values())
         self._free_pages = sorted(
             set(range(1, self.num_pages)) - used
         )
         self._admit_order = [
             int(s) for s in np.asarray(state["admit_order"], np.int32)
         ]
+        # the prefix index is NOT snapshotted: entries are an optimistic
+        # lookup structure over live pages, and a warm-started replica
+        # rebuilds them as adopted requests re-register (replica layer)
+        self._prefix_index = {}
         self.check_invariants()
 
 

@@ -255,15 +255,26 @@ class DecodeReplica:
     def __init__(self, engine, journal: RequestJournal, *,
                  replica_index: int = 0, n_replicas: int = 1,
                  checkpointer=None, max_retries: int = 1,
-                 timeout_s: Optional[float] = None):
+                 timeout_s: Optional[float] = None,
+                 batcher=None):
         self.engine = engine
         self.journal = journal
         self.replica_index = int(replica_index)
         self.n_replicas = int(n_replicas)
         self.checkpointer = checkpointer
-        self.batcher = ContinuousBatcher(
-            engine, max_retries=max_retries, timeout_s=timeout_s
-        )
+        # an injected batcher (e.g. a SpeculativeBatcher with its
+        # draft engine) replaces the default; it must wrap this same
+        # engine so drain/warm-start snapshots stay coherent
+        if batcher is not None:
+            if batcher.engine is not engine:
+                raise ValueError(
+                    "injected batcher must wrap the replica's engine"
+                )
+            self.batcher = batcher
+        else:
+            self.batcher = ContinuousBatcher(
+                engine, max_retries=max_retries, timeout_s=timeout_s
+            )
         self.drained = False
 
     def _claimed(self) -> List[dict]:
@@ -343,6 +354,16 @@ class DecodeReplica:
         for slot in range(cache.capacity):
             if cache.active[slot] and slot not in self.batcher.active:
                 cache.release(slot)
+        # sharing state does not ride the snapshot: re-register adopted
+        # prompts (their pages hold exactly that content), so requests
+        # claimed AFTER the warm start alias the restored pages too
+        if getattr(self.batcher, "share_prefixes", False):
+            for slot, r in self.batcher.active.items():
+                cache.register_prefix(slot, r.prompt)
+        # a speculative batcher re-admits adopted slots into its draft
+        # cache (same slot ids) to restore draft/target lockstep
+        if hasattr(self.batcher, "mirror_adopted"):
+            self.batcher.mirror_adopted()
         return step
 
     def _flush_finished(self, served: dict) -> None:

@@ -1,0 +1,251 @@
+"""Speculative decode over the continuous batcher.
+
+A small **draft** model proposes ``k`` greedy tokens per active slot;
+the **target** model scores all ``k`` in ONE batched
+:meth:`~chainermn_tpu.serving.decode.DecodeEngine.verify_step` over the
+padded-slot program (shape ``(capacity, k)`` — fixed across request
+join/leave, so membership churn never retraces, exactly like the plain
+decode step).  Acceptance is **greedy-exact**: per slot the target's
+argmax chain ``g_0..g_{k-1}`` is compared against the draft's
+proposals, and the committed tokens are the longest matching prefix
+plus the target's one corrected token — every committed token is a
+TARGET argmax, so the served output is bit-identical to plain decode
+**by construction**, whatever the draft proposes.  The draft only
+moves the ACCEPTANCE RATE, i.e. how many of the 2-psum/layer verify
+steps each output token amortizes.
+
+Mechanics:
+
+* **The draft rides the same allocator.**  The draft engine's
+  :class:`~chainermn_tpu.serving.kv_cache.PagedKVCache` has the same
+  geometry (capacity / page_size / pages_per_slot / num_pages) and
+  receives the SAME deterministic op sequence (admit with the same
+  prefix shape, release, evict) through the batcher's slot hooks, so
+  draft and target agree on slot ids at every point — including under
+  prefix sharing, where both caches maintain their own (structurally
+  identical) prefix index.
+* **Proposal.**  ``k`` single-token draft steps, run against the draft
+  engine's compiled program directly; each step's advance is CLAMPED
+  to the draft slot's reservation (a proposal past the reservation
+  writes the null page — harmless garbage that verification simply
+  rejects or truncation discards).
+* **Rollback.**  The draft wrote ``[pending, proposals[:-1]]`` at
+  positions ``base..base+k-1``; after the target commits ``a`` tokens
+  the draft rewinds to ``base + a`` via
+  :meth:`~chainermn_tpu.serving.kv_cache.PagedKVCache.rollback` —
+  committed positions hold exactly the committed tokens (a committed
+  token beyond the first IS its matching proposal), rejected positions
+  are overwritten by the next iteration's writes before any masked
+  attend can read them.  Target lengths advance by ``a`` the same way
+  (``verify_step`` never auto-advances), keeping both caches in
+  lockstep: ``lengths = prompt + len(tokens) - 1`` on both sides.
+* **Warm start.**  A replica that warm-started its target cache from a
+  drain snapshot calls :meth:`SpeculativeBatcher.mirror_adopted` —
+  adopted slots are re-admitted into the draft cache AT the same slot
+  id and re-prefilled with their committed token history, restoring
+  the lockstep invariant without touching the target's bit-exact
+  state.
+
+The verify program's collective cost is pinned in
+``analysis.budgets`` as ``spec_verify_step`` — still exactly 2
+all-reduces per layer (the k tokens amortize the same psums), which is
+the entire point: one verify step's collectives buy up to ``k``
+tokens.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..resilience import fault_injection as _fi
+from .batcher import ContinuousBatcher, Request
+
+_GEOMETRY = ("capacity", "page_size", "pages_per_slot")
+
+
+class SpeculativeBatcher(ContinuousBatcher):
+    """Continuous batching with draft-propose / target-verify decode.
+
+    ``engine``: the target :class:`DecodeEngine` (paged layout).
+    ``draft``: a second, typically much smaller ``DecodeEngine`` whose
+    cache geometry matches the target's exactly.  ``k``: draft tokens
+    proposed (and verify rows scored) per iteration; ``k=1`` degrades
+    to plain decode plus a wasted draft step (useful as an A/B
+    control).
+    """
+
+    def __init__(self, engine, draft, *, k: int = 4, **kw):
+        if k < 1:
+            raise ValueError(f"k must be >= 1, got {k}")
+        if getattr(engine, "layout", "paged") != "paged" or \
+                getattr(draft, "layout", "paged") != "paged":
+            raise ValueError(
+                "speculative decode serves the paged layout (the dense "
+                "oracle stays the plain-decode reference)"
+            )
+        for name in _GEOMETRY:
+            a, b = getattr(engine, name), getattr(draft, name)
+            if a != b:
+                raise ValueError(
+                    f"draft cache geometry must match target: "
+                    f"{name}={b} vs target {a}"
+                )
+        if engine.cache.num_pages != draft.cache.num_pages:
+            raise ValueError(
+                f"draft cache geometry must match target: num_pages="
+                f"{draft.cache.num_pages} vs target "
+                f"{engine.cache.num_pages}"
+            )
+        super().__init__(engine, **kw)
+        self.draft = draft
+        self.k = int(k)
+        # acceptance accounting: of the k proposals per slot-iteration,
+        # k-1 are verifiable (row j checks proposal j-1); `accepted`
+        # counts matches, so a draft that equals the target scores 1.0
+        self.tokens_proposed = 0
+        self.tokens_accepted = 0
+        self.verify_steps = 0
+
+    @property
+    def acceptance_rate(self) -> float:
+        return self.tokens_accepted / max(self.tokens_proposed, 1)
+
+    # -- mirrored allocator hooks --------------------------------------
+    def _admit_joins(self, limit: Optional[int] = None):
+        joins = super()._admit_joins(limit=limit)
+        for r in joins:
+            prefix = (
+                self.draft.cache.lookup_prefix(r.prompt)
+                if self.share_prefixes else None
+            )
+            dslot = self.draft.admit(r.total_tokens, prefix=prefix)
+            if dslot != r.slot:
+                raise AssertionError(
+                    f"draft allocator desynchronized: slot {dslot} "
+                    f"vs target {r.slot}"
+                )
+        return joins
+
+    def _release_slot(self, slot: int) -> None:
+        super()._release_slot(slot)
+        self.draft.release(slot)
+
+    def _evict_slot(self, slot: int) -> None:
+        super()._evict_slot(slot)
+        self.draft.cache.evict(slot)
+
+    def _prefill_one(self, r: Request) -> np.ndarray:
+        logits = super()._prefill_one(r)
+        self.draft.prefill(r.slot, r.prompt)
+        if self.share_prefixes:
+            self.draft.cache.register_prefix(r.slot, r.prompt)
+        return logits
+
+    def mirror_adopted(self) -> int:
+        """Restore draft/target lockstep after a replica warm start:
+        every target slot adopted from the drain snapshot is admitted
+        into the draft cache at the SAME slot id and re-prefilled with
+        its prompt + committed tokens (all but the pending last, which
+        the next iteration feeds).  Returns the number of slots
+        mirrored.  The target cache is not touched — its warm pages
+        stay bit-exact."""
+        mirrored = 0
+        for slot in self.engine.cache._admit_order:
+            r = self.active.get(slot)
+            if r is None or self.draft.cache.active[slot]:
+                continue
+            self.draft.admit(r.total_tokens, slot=slot)
+            history = r.prompt + r.tokens[:-1] if r.tokens else r.prompt
+            self.draft.prefill(slot, history)
+            if self.share_prefixes:
+                self.draft.cache.register_prefix(slot, r.prompt)
+            mirrored += 1
+        return mirrored
+
+    # -- the speculative iteration -------------------------------------
+    def _draft_propose(self, cur: np.ndarray, active) -> np.ndarray:
+        """One single-token draft step (direct program call: the
+        advance is clamped to each slot's reservation, so end-of-
+        request proposals overflow into the null page instead of
+        raising — their garbage is rejected or truncated anyway)."""
+        _fi.fire("serving.draft_step")
+        d = self.draft
+        toks = jnp.asarray(cur.reshape(d.capacity, 1))
+        if d.layout == "paged":
+            for s in active:
+                d.cache.cow_for_write(s, 1)
+        logits, k_out, v_out = d._fn(
+            d.params, toks, d.cache.k_pages, d.cache.v_pages,
+            d.cache.tables_array(), d.cache.lengths_array(),
+        )
+        d.cache.set_pages(k_out, v_out)
+        for s in active:
+            room = (len(d.cache._slot_pages[s]) * d.cache.page_size
+                    - int(d.cache.lengths[s]))
+            if room > 0:
+                d.cache.advance(s, 1)
+        return np.asarray(logits[:, 0])
+
+    def _decode_once(self) -> None:
+        active = dict(self.active)
+        cap, k = self.engine.capacity, self.k
+        dbase = {s: int(self.draft.cache.lengths[s]) for s in active}
+        t0 = time.monotonic()
+        # 1. draft proposes k greedy tokens per slot
+        pending = np.zeros((cap,), np.int32)
+        for s, r in active.items():
+            pending[s] = r.tokens[-1] if r.tokens else 0
+        proposals = np.zeros((cap, k), np.int32)
+        cur = pending.copy()
+        for j in range(k):
+            dlogits = self._draft_propose(cur, active)
+            for s in active:
+                cur[s] = int(np.argmax(dlogits[s]))
+                proposals[s, j] = cur[s]
+        # 2. target scores all k rows in one batched step: row j
+        #    conditions on [pending, proposals[:j]]
+        ver = np.zeros((cap, k), np.int32)
+        ver[:, 0] = pending
+        if k > 1:
+            ver[:, 1:] = proposals[:, : k - 1]
+        logits = self.engine.verify_step(ver)
+        t1 = time.monotonic()
+        self.verify_steps += 1
+        # 3. greedy-exact acceptance + lockstep advance/rollback
+        for s, r in list(active.items()):
+            g = [int(np.argmax(logits[s, j])) for j in range(k)]
+            commit = [g[0]]
+            for j in range(1, k):
+                if int(proposals[s, j - 1]) != g[j - 1]:
+                    break
+                commit.append(g[j])
+            self.tokens_proposed += k - 1
+            self.tokens_accepted += len(commit) - 1
+            appended = 0
+            for tok in commit:
+                if r._finished():
+                    break
+                self.registry.histogram(
+                    "serving.token_latency").observe(t1 - t0)
+                self._append_token(r, tok, t1)
+                appended += 1
+            self.engine.cache.advance(s, appended)
+            self.draft.cache.rollback(s, dbase[s] + appended)
+            if r._finished():
+                self._retire(r)
+
+    # -- reporting ------------------------------------------------------
+    def latency_report(self) -> dict:
+        out = super().latency_report()
+        out["speculative"] = {
+            "k": self.k,
+            "verify_steps": self.verify_steps,
+            "tokens_proposed": self.tokens_proposed,
+            "tokens_accepted": self.tokens_accepted,
+            "acceptance_rate": round(self.acceptance_rate, 4),
+        }
+        return out

@@ -477,6 +477,55 @@ class TestServingDrainCycleFleet:
         assert drains and drains[0]["info"]["process"] == 2
 
 
+class TestSpeculativeBurstFleet:
+    def test_replica_dies_mid_burst_survivors_reclaim(self, tmp_path):
+        """ISSUE 17 fleet leg: 3 speculative replicas (half-width draft
+        + target riding one allocator each) partition a shared-prefix
+        stream; the schedule kills replica 1 at its 2nd
+        ``serving.spec_verify`` — mid-burst, with draft proposals in
+        flight and shared pages at refcount > 1.  Survivors complete
+        exactly their own shares with their allocators drained clean
+        (refcount invariants + every page freed, both caches, asserted
+        in-scenario); phase 2 re-forms at 2 replicas, the victim's
+        share re-derives over ``seq % 2`` and serves speculatively —
+        and EVERY journaled result matches a fresh plain-decode oracle
+        bit-for-bit (greedy-exact acceptance survives the crash)."""
+        sched = FaultSchedule().preemption_wave(
+            (1,), window=(2, 2), site="serving.spec_verify")
+        w1 = FleetWorld(3, str(tmp_path), schedule=sched, budget_s=420,
+                        label="spec0")
+        res1 = w1.launch("serving_spec_burst", {"n_requests": 12,
+                                                "k": 4},
+                         expect_exit={0: REAPED, 1: 43, 2: REAPED})
+        p1 = res1.payloads()
+        # seq-mod shares, whole and nothing else; speculative + sharing
+        # machinery demonstrably live on each survivor
+        assert p1[0]["served"] == ["s0", "s3", "s6", "s9"]
+        assert p1[2]["served"] == ["s11", "s2", "s5", "s8"]
+        for q in (0, 2):
+            assert p1[q]["verify_steps"] > 0
+            assert p1[q]["prefix_hits"] >= 1
+            assert p1[q]["tokens_proposed"] > 0
+        w2 = FleetWorld(2, str(tmp_path), budget_s=420, label="spec1")
+        res2 = w2.launch("serving_spec_resume", {"n_requests": 12,
+                                                 "k": 4},
+                         expect_exit={})
+        p2 = res2.payloads()
+        for pid, p in p2.items():
+            assert p["completed"] == 12
+            assert p["pending_before"] == 4  # the victim's share
+            assert p["bit_identical"] is True
+            assert p["verify_steps"] > 0
+        # the migrated partition re-derived over seq % 2
+        assert p2[0]["served"] == ["s10", "s4"]
+        assert p2[1]["served"] == ["s1", "s7"]
+        rep = FleetReport.from_scratch(str(tmp_path))
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        assert [e["process"] for e in dies] == [1]
+        assert dies[0]["site"] == "serving.spec_verify"
+
+
 class TestWideWorldFormation:
     @pytest.mark.parametrize("n", [32, 64])
     def test_rendezvous_with_torn_agreement(self, n, tmp_path):

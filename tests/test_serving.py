@@ -34,9 +34,11 @@ from chainermn_tpu.serving.kv_cache import (
     CacheAdmissionError,
     NULL_PAGE,
     PagedKVCache,
+    PrefixMatch,
     pages_needed,
     reshard_kv_state,
 )
+from chainermn_tpu.serving.speculative import SpeculativeBatcher
 from chainermn_tpu.serving.replica import (
     DecodeReplica,
     RequestJournal,
@@ -49,6 +51,41 @@ from chainermn_tpu.resilience.fault_injection import (
 
 
 VOCAB, D, HEADS, LAYERS, MAXLEN = 64, 32, 4, 2, 64
+
+
+def _cache(capacity=3, page_size=4, pages_per_slot=4, num_pages=None):
+    return PagedKVCache(n_layers=LAYERS, n_heads=HEADS,
+                        d_head=D // HEADS, capacity=capacity,
+                        page_size=page_size,
+                        pages_per_slot=pages_per_slot,
+                        num_pages=num_pages)
+
+
+def _shared_prompts(n, seed=17, page=8):
+    """Prompts over one page-aligned shared system prefix + unique
+    tails — the high-overlap mix prefix sharing exists for."""
+    rng = np.random.RandomState(seed)
+    head = rng.randint(0, VOCAB, page).tolist()
+    return [head + rng.randint(0, VOCAB, 2 + rng.randint(3)).tolist()
+            for _ in range(n)]
+
+
+def _draft_engine(eng, seed=7, zero=False):
+    """A half-width 1-layer draft engine built to ``eng``'s exact cache
+    geometry (the SpeculativeBatcher contract)."""
+    dm = TransformerLM(vocab_size=VOCAB, d_model=16, n_heads=2,
+                       n_layers=1, max_len=MAXLEN)
+    dp = dm.init(
+        {"params": jax.random.PRNGKey(seed),
+         "dropout": jax.random.PRNGKey(seed + 1)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    if zero:
+        dp = jax.tree_util.tree_map(jnp.zeros_like, dp)
+    return DecodeEngine(dm, dp, capacity=eng.capacity,
+                        page_size=eng.page_size,
+                        pages_per_slot=eng.pages_per_slot,
+                        num_pages=eng.cache.num_pages)
 
 
 @pytest.fixture(scope="module")
@@ -1147,6 +1184,462 @@ class TestReplicaAutoscaler:
 
 
 # ----------------------------------------------------------------------
+# prefix-sharing KV cache (ISSUE 17)
+# ----------------------------------------------------------------------
+class TestPrefixSharing:
+    def test_alias_admission_shares_pages(self):
+        """A page-aligned prompt prefix registered by one slot admits a
+        second slot ALIASING those pages — refcount 2, lengths start at
+        the shared length, one fresh tail page only."""
+        c = _cache()
+        toks = list(range(8))  # two full pages at page_size 4
+        a = c.admit(9)
+        c.advance(a, 8)  # prompt prefilled
+        assert c.register_prefix(a, toks) == 2  # prefix-closed chains
+        m = c.lookup_prefix(toks + [9, 10])
+        assert m == PrefixMatch(tuple(c._slot_pages[a][:2]), 8, False)
+        used0 = c.used_pages
+        b = c.admit(11, prefix=m)
+        assert int(c.lengths[b]) == 8  # only the tail prefills
+        assert c._slot_pages[b][:2] == c._slot_pages[a][:2]
+        assert c.used_pages == used0 + 1  # one fresh page, not three
+        assert all(int(c._refcounts[p]) == 2 for p in m.pages)
+        c.check_invariants()
+
+    def test_fully_matched_prompt_caps_and_copies_on_write(self):
+        """An identical resubmitted prompt matches ALL its pages; the
+        shared length caps one short (the tail prefill needs a token),
+        which marks the final page copy-on-write: the reserve earmarked
+        at admission absorbs the write and the original page — still
+        read by the registrant — is never touched."""
+        c = _cache()
+        toks = list(range(8))
+        a = c.admit(8)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        m = c.lookup_prefix(toks)
+        assert m.shared_len == 7 and m.cow
+        b = c.admit(12, prefix=m)
+        assert b in c._cow_reserve
+        c.check_invariants()
+        shared_last = c._slot_pages[b][1]
+        assert shared_last == c._slot_pages[a][1]
+        # position 7 lands in the still-shared page: the copy happens
+        assert c.cow_for_write(b, 1) is True
+        assert c._slot_pages[b][1] != shared_last
+        assert int(c._refcounts[shared_last]) == 1  # a's again, alone
+        c.advance(b, 1)
+        c.check_invariants()
+        # now private: no further copies on this slot
+        assert c.cow_for_write(b, 1) is False
+
+    def test_advance_into_shared_page_without_cow_trips(self):
+        """The tripwire behind the bit-identity guarantee: accounting a
+        write into a refcount>1 page without ``cow_for_write`` raises
+        instead of corrupting another request's history."""
+        c = _cache()
+        toks = list(range(8))
+        a = c.admit(8)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        b = c.admit(12, prefix=c.lookup_prefix(toks))
+        with pytest.raises(CacheAdmissionError, match="copy-on-write"):
+            c.advance(b, 1)
+
+    def test_release_frees_only_at_refcount_zero(self):
+        """Shared pages survive their registrant's release (the alias
+        still reads them) and return to the pool — with their index
+        entries dropped — only when the LAST reader releases."""
+        c = _cache()
+        toks = list(range(8))
+        a = c.admit(9)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        b = c.admit(10, prefix=c.lookup_prefix(toks + [3]))
+        shared = set(c._slot_pages[b][:2])
+        c.release(a)
+        assert all(int(c._refcounts[p]) == 1 for p in shared)
+        assert not shared & set(c._free_pages)
+        assert c.lookup_prefix(toks + [5]) is not None  # content live
+        c.check_invariants()
+        c.release(b)
+        assert c.used_pages == 0
+        assert c.lookup_prefix(toks + [5]) is None  # entries dropped
+        c.check_invariants()
+
+    def test_victim_never_holds_a_shared_page(self):
+        """choose_victim is LIFO over UNSHARED slots only: with every
+        active slot holding a refcount>1 page there is no victim (the
+        batcher queues); an unshared slot is picked even when a shared
+        one was admitted later."""
+        c = _cache(capacity=3)
+        toks = list(range(8))
+        u = c.admit(5)  # private, admitted first
+        c.advance(u, 5)
+        a = c.admit(9)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        b = c.admit(10, prefix=c.lookup_prefix(toks + [1]))
+        # b is newest but aliases a's pages; a shares them too — only
+        # u is evictable despite being oldest
+        assert c.choose_victim() == u
+        c.check_invariants()
+        c.evict(u)
+        assert c.choose_victim() is None  # all-shared: nobody evicts
+        c.check_invariants()
+        c.release(b)
+        assert c.choose_victim() == a  # a's pages are private again
+
+    def test_refcount_invariants_under_op_mix(self):
+        """Churn: admit (aliased and cold), tail prefill with CoW,
+        decode writes, release, evict — ``check_invariants`` (refcount
+        == table multiplicity, conservation, victim-never-shared, index
+        liveness) holds after EVERY op, and the drained pool is empty."""
+        c = _cache(capacity=4, num_pages=24)
+        rng = np.random.RandomState(3)
+        base = [list(range(8)), list(range(40, 48))]
+        live = set()
+        for _ in range(160):
+            op = rng.randint(3)
+            if op == 0 and len(live) < c.capacity:
+                prompt = (base[rng.randint(2)]
+                          + rng.randint(0, VOCAB,
+                                        1 + rng.randint(3)).tolist())
+                total = len(prompt) + 4
+                m = c.lookup_prefix(prompt)
+                if c.can_admit(total, prefix=m):
+                    s = c.admit(total, prefix=m)
+                    start = int(c.lengths[s])
+                    c.cow_for_write(s, len(prompt) - start)
+                    c.advance(s, len(prompt) - start)
+                    c.register_prefix(s, prompt)
+                    live.add(s)
+            elif op == 1 and live:
+                s = sorted(live)[rng.randint(len(live))]
+                room = (len(c._slot_pages[s]) * c.page_size
+                        - int(c.lengths[s]))
+                if room > 0:
+                    c.cow_for_write(s, 1)
+                    c.advance(s, 1)
+            elif op == 2 and live:
+                if rng.randint(2):
+                    s = sorted(live)[rng.randint(len(live))]
+                    c.release(s)
+                    live.discard(s)
+                else:
+                    v = c.choose_victim()
+                    if v is not None:
+                        c.evict(v)
+                        live.discard(v)
+            c.check_invariants()
+        for s in sorted(live):
+            c.release(s)
+        assert c.used_pages == 0
+        c.check_invariants()
+
+    def test_shared_serve_bit_identical_with_fewer_pages(self, lm):
+        """The tentpole acceptance: a high-overlap serve with sharing
+        ON is bit-identical to the sharing-OFF serve AND to the
+        unbatched oracle, while the peak DISTINCT page count drops."""
+        model, params = lm
+        prompts = _shared_prompts(6)
+
+        def serve(share):
+            eng = DecodeEngine(model, params, capacity=3, page_size=8)
+            b = ContinuousBatcher(eng, share_prefixes=share)
+            for i, p in enumerate(prompts):
+                b.submit(Request(p, 3 + i % 3, id=f"r{i}"))
+            peak = 0
+            while b.step():
+                peak = max(peak, eng.cache.used_pages)
+                eng.cache.check_invariants()
+            return b, peak
+
+        hot, peak_hot = serve(True)
+        cold, peak_cold = serve(False)
+        assert hot.prefix_hits >= 1 and cold.prefix_hits == 0
+        assert hot.prefix_tokens_shared >= 8
+        assert peak_hot < peak_cold
+        solo = DecodeEngine(model, params, capacity=1, page_size=8)
+        for rid in hot.finished:
+            r1, r0 = hot.finished[rid], cold.finished[rid]
+            assert r1.state == "done"
+            assert r1.output == r0.output
+            assert r1.output == solo.generate(r1.prompt,
+                                              r1.max_new_tokens)
+
+    def test_checkpoint_round_trip_with_live_shared_pages(self):
+        """state_dict/load_state_dict carry refcounts and the CoW
+        reserve: a snapshot taken mid-share reloads with identical
+        allocator state, a tampered refcount row refuses to load, and
+        a legacy snapshot (no sharing keys) still loads with refcounts
+        derived from table multiplicity."""
+        c = _cache()
+        toks = list(range(8))
+        a = c.admit(9)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        c.admit(8, prefix=c.lookup_prefix(toks))  # capped: live reserve
+        sd = c.state_dict()
+        c2 = _cache()
+        c2.load_state_dict(sd)  # runs check_invariants itself
+        np.testing.assert_array_equal(c2._refcounts, c._refcounts)
+        assert c2._cow_reserve == c._cow_reserve
+        np.testing.assert_array_equal(c2.block_tables, c.block_tables)
+        assert c2.used_pages == c.used_pages
+        bad = dict(sd)
+        bad["page_refcounts"] = np.roll(sd["page_refcounts"], 1)
+        with pytest.raises(ValueError, match="refcounts"):
+            _cache().load_state_dict(bad)
+        legacy = {k: v for k, v in sd.items()
+                  if k not in ("page_refcounts", "cow_reserve")}
+        c3 = _cache()
+        c3.load_state_dict(legacy)
+        # tables alone reconstruct the sharing (the reserve earmark is
+        # a new-format refinement a legacy snapshot never carried)
+        owned = {p for pages in c3._slot_pages.values() for p in pages}
+        for p in owned:
+            assert c3._refcounts[p] == c._refcounts[p]
+
+    def test_reshard_kv_state_preserves_sharing(self):
+        """reshard_kv_state re-cuts heads only: the host allocator state
+        — refcounts and CoW reserves included — rides through a 2→1
+        reshard and the merged cache passes invariants with the same
+        sharing structure."""
+        c = PagedKVCache(n_layers=LAYERS, n_heads=2, d_head=4,
+                         capacity=2, page_size=4, pages_per_slot=4)
+        toks = list(range(8))
+        a = c.admit(9)
+        c.advance(a, 8)
+        c.register_prefix(a, toks)
+        c.admit(8, prefix=c.lookup_prefix(toks))
+        sd = c.state_dict()
+        merged = reshard_kv_state([sd, sd], 1)
+        big = PagedKVCache(n_layers=LAYERS, n_heads=4, d_head=4,
+                           capacity=2, page_size=4, pages_per_slot=4)
+        big.load_state_dict(merged[0])
+        np.testing.assert_array_equal(big._refcounts, c._refcounts)
+        assert big._cow_reserve == c._cow_reserve
+        np.testing.assert_array_equal(big.block_tables, c.block_tables)
+
+    def test_warm_start_re_registers_shared_prefixes(self, lm, tmp_path):
+        """Journal replica warm start with shared prefixes: a replica
+        preempted mid-share drains pages + refcounts; the rejoining
+        replica adopts the in-flight requests, RE-REGISTERS their
+        prompts (the index itself never snapshots), and the still-
+        pending requests alias the restored pages — completing the
+        stream bit-identically to a no-fault oracle."""
+        model, params = lm
+        comm = cmn.create_communicator("single_node")
+        ckpt = cmn.create_multi_node_checkpointer(
+            "share", comm, path=str(tmp_path / "ck"))
+        j = RequestJournal(str(tmp_path / "j"))
+        docs = [Request(p, 4, id=f"s{i}")
+                for i, p in enumerate(_shared_prompts(4, seed=23))]
+        j.submit_all(docs)
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        rep = DecodeReplica(eng, j, checkpointer=ckpt)
+        assert rep.batcher.share_prefixes
+        with inject_faults(
+            [FaultSpec("serving.decode_step", "preempt", at=[2])]
+        ):
+            rep.serve()
+        assert rep.drained
+        ckpt.wait_until_finished()
+        oracle_eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        oracle = {r.id: oracle_eng.generate(r.prompt, r.max_new_tokens)
+                  for r in docs}
+        eng2 = DecodeEngine(model, params, capacity=2, page_size=8)
+        rep2 = DecodeReplica(eng2, j, checkpointer=ckpt)
+        assert rep2.warm_start() is not None
+        # adopted prompts re-indexed over the restored pages
+        assert rep2.batcher.active
+        assert eng2.cache._prefix_index
+        eng2.cache.check_invariants()
+        rep2.serve()
+        # the pending claims aliased the restored pages
+        assert rep2.batcher.prefix_hits >= 1
+        res = j.results()
+        for rid, want in oracle.items():
+            assert res[rid]["tokens"] == want, rid
+
+
+# ----------------------------------------------------------------------
+# speculative decode (ISSUE 17)
+# ----------------------------------------------------------------------
+class TestSpeculative:
+    @pytest.mark.parametrize("k", [1, 2, 4])
+    def test_spec_serve_bit_identical(self, k, lm):
+        """Greedy-exact acceptance makes the speculative transcript the
+        plain transcript BY CONSTRUCTION: every committed token is a
+        target argmax, so outputs equal the unbatched oracle at any k
+        (k=1 is the degenerate plain-decode control)."""
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = SpeculativeBatcher(eng, _draft_engine(eng), k=k)
+        out = b.serve([Request(p, 2 + i % 4)
+                       for i, p in enumerate(_prompts(61, 5))])
+        assert b.verify_steps > 0
+        solo = DecodeEngine(model, params, capacity=1, page_size=8)
+        for r in out:
+            assert r.state == "done", r
+            assert r.output == solo.generate(r.prompt, r.max_new_tokens)
+        # both allocators drained clean and in lockstep
+        for cache in (eng.cache, b.draft.cache):
+            assert cache.used_pages == 0
+            cache.check_invariants()
+
+    def test_all_accepted_when_draft_equals_target(self, lm):
+        """A draft that IS the target proposes exactly the target's
+        argmax chain: every verifiable proposal accepted (rate 1.0) and
+        the outputs still bit-identical."""
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        draft = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = SpeculativeBatcher(eng, draft, k=4)
+        out = b.serve([Request(p, 6) for p in _prompts(62, 3)])
+        assert b.tokens_proposed > 0
+        assert b.acceptance_rate == 1.0
+        solo = DecodeEngine(model, params, capacity=1, page_size=8)
+        for r in out:
+            assert r.output == solo.generate(r.prompt, r.max_new_tokens)
+
+    def test_all_rejected_zero_params_draft(self, lm):
+        """The other extreme: a zeroed draft proposes a constant token
+        the target (nearly) never emits — every verify step commits via
+        the all-rejected path (one corrected token) and the outputs are
+        STILL bit-identical; only the acceptance rate collapses."""
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = SpeculativeBatcher(eng, _draft_engine(eng, zero=True), k=4)
+        out = b.serve([Request(p, 5) for p in _prompts(63, 3)])
+        assert b.tokens_proposed > 0
+        assert b.acceptance_rate < 0.5
+        solo = DecodeEngine(model, params, capacity=1, page_size=8)
+        for r in out:
+            assert r.state == "done"
+            assert r.output == solo.generate(r.prompt, r.max_new_tokens)
+
+    def test_eos_retires_inside_a_speculative_commit(self, lm):
+        """An eos landing mid-commit truncates exactly where plain
+        decode stops — speculative over-proposal never leaks tokens
+        past the stop."""
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        probe = eng.generate([5, 9, 11], 6)
+        eos = probe[4]  # the 2nd generated token
+        eng2 = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = SpeculativeBatcher(eng2, _draft_engine(eng2), k=4)
+        out = b.serve([Request([5, 9, 11], 6, eos_id=eos)])[0]
+        assert out.state == "done"
+        assert out.tokens[-1] == eos
+        assert len(out.tokens) == 2
+
+    def test_rollback_rewinds_lengths_only(self):
+        c = _cache()
+        s = c.admit(12)
+        c.advance(s, 8)
+        pages = list(c._slot_pages[s])
+        c.rollback(s, 5)
+        assert int(c.lengths[s]) == 5
+        assert c._slot_pages[s] == pages  # reservation untouched
+        c.advance(s, 3)  # stale positions simply overwritten
+        c.check_invariants()
+        with pytest.raises(ValueError, match="rollback"):
+            c.rollback(s, 9)
+        with pytest.raises(ValueError, match="rollback"):
+            c.rollback(s, -1)
+
+    def test_construction_validates_geometry_and_layout(self, lm):
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        with pytest.raises(ValueError, match="k must be"):
+            SpeculativeBatcher(eng, _draft_engine(eng), k=0)
+        dm = TransformerLM(vocab_size=VOCAB, d_model=16, n_heads=2,
+                           n_layers=1, max_len=MAXLEN)
+        dp = dm.init(
+            {"params": jax.random.PRNGKey(7),
+             "dropout": jax.random.PRNGKey(8)},
+            jnp.zeros((1, 8), jnp.int32),
+        )
+        mismatched = DecodeEngine(dm, dp, capacity=2, page_size=4)
+        with pytest.raises(ValueError, match="geometry"):
+            SpeculativeBatcher(eng, mismatched, k=2)
+        dense = DecodeEngine(model, params, capacity=2, page_size=8,
+                             layout="dense")
+        with pytest.raises(ValueError, match="paged"):
+            SpeculativeBatcher(dense, _draft_engine(eng), k=2)
+
+    def test_spec_verify_budget_pin(self, tp_setup):
+        """The spec_verify_step ceiling: the k-row verify program runs
+        the SAME 2 row-parallel psums per layer as single-token decode
+        (the amortization that makes speculation pay on a latency-bound
+        interconnect) — exact on the authored trace, zero partitioner
+        insertions on the compiled program."""
+        from chainermn_tpu.analysis import assert_attributed, enforce
+
+        comm, model, params, specs = tp_setup
+        eng = DecodeEngine(model, params, capacity=2, page_size=8,
+                           comm=comm, param_specs=specs)
+        tr = eng.collective_trace("verify", bucket=4)
+        census = enforce("spec_verify_step", tr)
+        assert census.get("all_reduce") == 2 * LAYERS  # exact
+        rep = assert_attributed(tr, eng.compiled_text("verify", bucket=4),
+                                name="spec_verify_step")
+        assert rep["all_reduce"]["implicit"] == []
+        assert rep["all_reduce"]["authored"] == 2 * LAYERS
+
+    def test_warm_start_mirrors_draft_slots(self, lm, tmp_path):
+        """A speculative replica preempted mid-burst drains its TARGET
+        cache; the rejoining replica warm-starts it and
+        ``mirror_adopted`` re-admits every adopted slot into the draft
+        at the SAME slot id, re-prefilled to length lockstep — the
+        resumed serve completes bit-identically to a plain oracle."""
+        model, params = lm
+        comm = cmn.create_communicator("single_node")
+        ckpt = cmn.create_multi_node_checkpointer(
+            "spec", comm, path=str(tmp_path / "ck"))
+        j = RequestJournal(str(tmp_path / "j"))
+        docs = [Request(p, 4, id=f"v{i}")
+                for i, p in enumerate(_prompts(91, 4))]
+        j.submit_all(docs)
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        spec = SpeculativeBatcher(eng, _draft_engine(eng), k=2)
+        rep = DecodeReplica(eng, j, checkpointer=ckpt, batcher=spec)
+        with inject_faults(
+            [FaultSpec("serving.spec_verify", "preempt", at=[2])]
+        ):
+            rep.serve()
+        assert rep.drained
+        ckpt.wait_until_finished()
+        oracle_eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        oracle = {r.id: oracle_eng.generate(r.prompt, r.max_new_tokens)
+                  for r in docs}
+        eng2 = DecodeEngine(model, params, capacity=2, page_size=8)
+        spec2 = SpeculativeBatcher(eng2, _draft_engine(eng2), k=2)
+        rep2 = DecodeReplica(eng2, j, checkpointer=ckpt, batcher=spec2)
+        assert rep2.warm_start() is not None
+        assert spec2.active  # adopted mid-flight
+        for s in spec2.active:
+            assert spec2.draft.cache.active[s]
+            assert (int(spec2.draft.cache.lengths[s])
+                    == int(eng2.cache.lengths[s]))  # lockstep restored
+        rep2.serve()
+        res = j.results()
+        for rid, want in oracle.items():
+            assert res[rid]["tokens"] == want, rid
+
+    def test_batcher_injection_requires_same_engine(self, lm):
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=2, page_size=8)
+        other = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = SpeculativeBatcher(other, _draft_engine(other), k=2)
+        with pytest.raises(ValueError, match="engine"):
+            DecodeReplica(eng, RequestJournal(tempfile.mkdtemp()),
+                          batcher=b)
+
+
+# ----------------------------------------------------------------------
 # mnlint: serving is NOT part of the sanctioned comm layer
 # ----------------------------------------------------------------------
 class TestServingLint:
@@ -1242,7 +1735,11 @@ class TestDecodeBenchCI:
                 assert "error" not in r, r
                 recs[r["metric"]] = r
         want = {"decode_bs1_tokens_per_sec_per_chip",
-                "decode_saturated_tokens_per_sec_per_chip"}
+                "decode_saturated_tokens_per_sec_per_chip",
+                "decode_prefix_shared_tokens_per_sec_per_chip",
+                "decode_prefix_cold_tokens_per_sec_per_chip",
+                "decode_spec_k4_tokens_per_sec_per_chip",
+                "decode_spec_off_tokens_per_sec_per_chip"}
         assert want <= set(recs), sorted(recs)
         for name in want:
             r = recs[name]
@@ -1271,3 +1768,29 @@ class TestDecodeBenchCI:
         assert recs["decode_bs1_tokens_per_sec_per_chip"]["capacity"] == 1
         assert recs[
             "decode_saturated_tokens_per_sec_per_chip"]["capacity"] == 2
+        # prefix-sharing A/B pair: the shared rung actually aliased
+        # pages and fingerprints the distinct-page saving vs its own
+        # cold leg; the cold rung shares nothing (deterministic serve,
+        # so the two rungs' peaks reconcile exactly)
+        shared = recs["decode_prefix_shared_tokens_per_sec_per_chip"]
+        cold = recs["decode_prefix_cold_tokens_per_sec_per_chip"]
+        assert shared["share_prefixes"] is True
+        assert cold["share_prefixes"] is False
+        assert shared["prefix_hits"] >= 1
+        assert cold["prefix_hits"] == 0
+        assert shared["pages_saved"] >= 1
+        assert (shared["peak_used_pages"] + shared["pages_saved"]
+                == cold["peak_used_pages"])
+        # speculative A/B pair: the k=4 rung reports its acceptance
+        # rate and the verify program's pinned budget verdict; the off
+        # rung is the plain-decode control (no spec fields)
+        spec = recs["decode_spec_k4_tokens_per_sec_per_chip"]
+        assert spec["spec_k"] == 4
+        assert 0.0 <= spec["acceptance_rate"] <= 1.0
+        assert spec["verify_steps"] > 0
+        assert spec["spec_budget"] == "spec_verify_step"
+        assert spec["spec_budget_within"] is True
+        assert spec["verify_census"] == {}  # non-TP smoke: authored 0
+        assert len(spec["verify_trace_hash"]) == 12
+        assert "spec_k" not in recs[
+            "decode_spec_off_tokens_per_sec_per_chip"]

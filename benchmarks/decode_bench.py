@@ -37,6 +37,24 @@ Two A/B pairs over the same substrate (ISSUE 17):
                     (the verify program's collective census rides the
                     row, pinned by ``spec_verify_step``).
 
+A third A/B pair over one MIXED stream (ISSUE 18):
+
+  decode_disagg_on / decode_disagg_off
+                    2C requests alternating long (3-page) and short
+                    (half-page) prompts — the mixed load where one
+                    prefill steals decode iterations from every
+                    in-flight request.  The off leg serves unified;
+                    the on leg splits into a prefill pool (publishes
+                    codec-packed KV handoffs through the journal) and
+                    a decode pool (ingests them).  Rows carry the
+                    handoff codec + exact wire bytes + handoff count,
+                    and TTFT p50/p99 split into queue/prefill
+                    components — the headline is whether
+                    disaggregation moved queue time or prefill time
+                    at unchanged (bit-identical) outputs.
+                    HUNT_HANDOFF_CODEC selects the wire (default
+                    bf16 — lossless on the bf16 cache).
+
 Protocol: the serving loop is HOST-driven (admission, argmax, page
 bookkeeping between compiled steps), so each rung times paired
 k / 2k-token serves and reports the min positive paired difference —
@@ -193,6 +211,142 @@ def _serve_overlap(model, params, capacity, n_requests, max_new, share):
     rep = b.latency_report()
     assert rep["failed"] == 0
     return dt, b.tokens_generated, rep, peak
+
+
+def _disagg_fixture():
+    """The mixed-stream fixture: max_len sized for the LONG prompts
+    (3 pages) plus the 2k generation leg."""
+    from chainermn_tpu.models.transformer import TransformerLM
+
+    max_len = 3 * PAGE + 2 * K + PAGE
+    model = TransformerLM(
+        vocab_size=VOCAB, d_model=D_MODEL, n_heads=HEADS,
+        n_layers=LAYERS, max_len=max_len,
+    )
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 8), jnp.int32),
+    )
+    return model, params
+
+
+def _mixed_requests(n_requests, max_new):
+    """The one mixed stream both disagg legs serve: alternating 3-page
+    long prompts and half-page short ones, fixed seed."""
+    from chainermn_tpu.serving.batcher import Request
+
+    rng = np.random.RandomState(4)
+    long_len, short_len = 3 * PAGE, max(2, PAGE // 2)
+    return [
+        Request(
+            rng.randint(0, VOCAB,
+                        long_len if i % 2 == 0 else short_len).tolist(),
+            max_new, id=f"mix{i}",
+        )
+        for i in range(n_requests)
+    ]
+
+
+def _serve_mixed_unified(model, params, capacity, n_requests, max_new):
+    """The off leg: one unified batcher serves the mixed stream."""
+    from chainermn_tpu.serving.batcher import ContinuousBatcher
+
+    eng = _engine(model, params, capacity)
+    b = ContinuousBatcher(eng)
+    t0 = time.monotonic()
+    b.serve(_mixed_requests(n_requests, max_new))
+    dt = time.monotonic() - t0
+    rep = b.latency_report()
+    assert rep["failed"] == 0
+    return dt, b.tokens_generated, rep
+
+
+def _serve_mixed_disagg(model, params, capacity, n_requests, max_new,
+                        codec):
+    """The on leg: prefill pool publishes handoffs through a journal,
+    decode pool ingests — same stream, bit-identical outputs for
+    lossless codecs (pinned in tests; this leg prices it)."""
+    import tempfile
+
+    from chainermn_tpu.serving import (
+        DisaggDecodeReplica, PrefillReplica, RequestJournal,
+    )
+
+    with tempfile.TemporaryDirectory() as td:
+        journal = RequestJournal(td)
+        journal.submit_all(_mixed_requests(n_requests, max_new))
+        pr = PrefillReplica(
+            _engine(model, params, capacity), journal, codec=codec
+        )
+        dr = DisaggDecodeReplica(
+            _engine(model, params, capacity), journal,
+            handoff_timeout_s=600.0,
+        )
+        t0 = time.monotonic()
+        pr.serve()
+        dr.serve(until_complete=n_requests, timeout_s=600.0)
+        dt = time.monotonic() - t0
+        rep = dr.batcher.latency_report()
+        assert rep["failed"] == 0
+        assert dr.local_prefills == 0  # every request rode a handoff
+        return dt, dr.batcher.tokens_generated, rep, pr.wire_bytes, \
+            pr.published
+
+
+def _run_disagg_rung(name, on):
+    model, params = _disagg_fixture()
+    capacity, n_requests = CAPACITY, 2 * CAPACITY
+    codec = os.environ.get("HUNT_HANDOFF_CODEC", "bf16")
+    samples, reports = [], []
+    extra = {"disagg": bool(on),
+             "handoff_codec": codec if on else None}
+    for _ in range(max(REPEATS, 1)):
+        if on:
+            t1, n1, _, _, _ = _serve_mixed_disagg(
+                model, params, capacity, n_requests, K, codec
+            )
+            t2, n2, rep2, wire2, pubs2 = _serve_mixed_disagg(
+                model, params, capacity, n_requests, 2 * K, codec
+            )
+            extra["handoff_bytes"] = wire2
+            extra["n_handoffs"] = pubs2
+        else:
+            t1, n1, _ = _serve_mixed_unified(
+                model, params, capacity, n_requests, K
+            )
+            t2, n2, rep2 = _serve_mixed_unified(
+                model, params, capacity, n_requests, 2 * K
+            )
+        samples.append(t2 - t1)
+        reports.append((n2 - n1, rep2))
+    # TTFT and its queue/prefill split: WHICH term disaggregation
+    # moved is the pair's entire story
+    for key, label in (("serving.ttft", "ttft"),
+                       ("serving.ttft.queue", "ttft_queue"),
+                       ("serving.ttft.prefill", "ttft_prefill"),
+                       ("serving.ingest_latency", "ingest")):
+        h = reports[-1][1].get(key)
+        if h:
+            extra[f"{label}_p50_ms"] = h["p50_ms"]
+            extra[f"{label}_p99_ms"] = h["p99_ms"]
+    fp = _fingerprints(model, params, capacity)
+    # the prefill program's census rides too — the prefill_step pin's
+    # subject is what a prefill POOL runs all day
+    from chainermn_tpu.analysis import budget_for
+
+    eng = _engine(model, params, capacity)
+    tr = eng.collective_trace("prefill", bucket=PAGE)
+    census = tr.census()
+    ceiling = budget_for("prefill_step")
+    fp.update({
+        "prefill_census": census,
+        "prefill_budget": "prefill_step",
+        "prefill_budget_within": all(
+            census.get(c, 0) <= n for c, n in ceiling.items()
+        ),
+    })
+    _emit_row(name, samples, reports, fp, extra)
 
 
 def _draft_fixture():
@@ -382,6 +536,12 @@ def main():
         ),
         "decode_spec_k4": lambda: _run_spec_rung("decode_spec_k4", 4),
         "decode_spec_off": lambda: _run_spec_rung("decode_spec_off", 0),
+        "decode_disagg_on": lambda: _run_disagg_rung(
+            "decode_disagg_on", True
+        ),
+        "decode_disagg_off": lambda: _run_disagg_rung(
+            "decode_disagg_off", False
+        ),
     }
     for name in (sys.argv[1:] or list(rungs)):
         try:

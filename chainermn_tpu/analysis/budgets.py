@@ -117,6 +117,15 @@ BUDGETS = {
     # verify program that added even one collective would scale its
     # cost with k and erase the win.
     "spec_verify_step": {"all_reduce": 4},
+    # ISSUE 18: the prefill program under disaggregation (serving.
+    # decode prefill phase, same 2-layer fixture).  TP prefill is the
+    # same 2-row-parallel-psums-per-layer family as decode_step — the
+    # prompt bucket rides the batch/seq dims, never the collective
+    # count — so a PREFILL pool's cost per request is bucket-shaped
+    # compute over a fixed collective floor.  EXACT like decode_step;
+    # the KV handoff path itself (export -> codec pack -> import) is
+    # separately pinned to ZERO collectives in tests/test_serving.py.
+    "prefill_step": {"all_reduce": 4},
 }
 
 # ----------------------------------------------------------------------

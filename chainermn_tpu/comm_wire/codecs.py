@@ -224,6 +224,101 @@ def reduce_buckets(
     raise ValueError(f"unknown wire codec {codec!r}")
 
 
+# -- buffer-shaped pack (one-shot transfers, e.g. KV handoff) ----------
+#
+# ``reduce_buckets`` above is the COLLECTIVE path: codec around a psum,
+# error feedback carrying the rounding loss into the next step.  A KV
+# handoff (serving.disagg) is a transfer-ONCE buffer: there is no next
+# step to carry a residual into, and no reduction — just "what do the
+# bytes look like in flight".  These entry points reuse the exact same
+# wire formats (cast codecs; int8 per-buffer absmax/127 round-to-
+# nearest) with ZERO collectives: encode/decode are jnp-pure so the
+# analysis tier can trace the round trip and pin an empty census.
+# int8 accuracy on KV is gated by greedy-token divergence (see
+# tests/test_serving.py), not a loss pin — EF does not apply.
+
+HANDOFF_CODECS = ("none", "bf16", "f16", "int8")
+
+
+class PackedBuffer(NamedTuple):
+    """One buffer in wire form.
+
+    ``data`` is the payload in the wire dtype (int8 for the ``int8``
+    codec), ``scale`` the f32 absmax/127 dequant scale (``None`` for
+    cast codecs — it is the int8 codec's +4 bytes of extra state),
+    ``shape``/``dtype`` the native geometry ``unpack_buffer`` restores.
+    """
+
+    codec: str
+    data: Any
+    scale: Any
+    shape: Tuple[int, ...]
+    dtype: str
+
+
+def encode_buffer(x: jnp.ndarray, codec: str) -> PackedBuffer:
+    """Encode one buffer for the wire.  Pure jnp, no collectives."""
+    if codec not in HANDOFF_CODECS:
+        raise ValueError(
+            f"unknown handoff codec {codec!r}; one of {HANDOFF_CODECS}"
+        )
+    native = jnp.dtype(x.dtype).name
+    shape = tuple(int(s) for s in x.shape)
+    if codec == "none":
+        return PackedBuffer("none", x, None, shape, native)
+    if codec in _CAST_WIRE:
+        return PackedBuffer(
+            codec, x.astype(_CAST_WIRE[codec]), None, shape, native
+        )
+    # int8: per-buffer absmax grid, round-to-nearest, clip — the same
+    # grid reduce_buckets quantizes on, minus the pmax agreement (a
+    # one-shot transfer has no peers to agree with)
+    absmax = jnp.max(jnp.abs(_f32(x)))
+    scale = absmax / _INT8_MAX
+    safe = jnp.where(scale > 0, scale, 1.0)
+    q = jnp.clip(
+        jnp.round(_f32(x) / safe), -_INT8_MAX, _INT8_MAX
+    ).astype(jnp.int8)
+    return PackedBuffer("int8", q, scale.astype(jnp.float32), shape, native)
+
+
+def decode_buffer(pb: PackedBuffer) -> jnp.ndarray:
+    """Invert :func:`encode_buffer` back to the native dtype/shape.
+    Pure jnp, no collectives."""
+    native = jnp.dtype(pb.dtype)
+    data = jnp.asarray(pb.data).reshape(pb.shape)
+    if pb.codec == "int8":
+        return (_f32(data) * pb.scale).astype(native)
+    return data.astype(native)
+
+
+def packed_wire_bytes(pb: PackedBuffer) -> int:
+    """Exact bytes this buffer occupies in flight: payload in the wire
+    dtype plus the int8 codec's 4-byte scale."""
+    n = int(pb.data.size) * jnp.dtype(pb.data.dtype).itemsize
+    if pb.scale is not None:
+        n += 4
+    return n
+
+
+def pack_buffer(x, codec: str) -> PackedBuffer:
+    """Host-side pack: :func:`encode_buffer` with the payload pulled
+    off-device, ready for serialization (obj store / journal file)."""
+    import numpy as np
+
+    pb = encode_buffer(jnp.asarray(x), codec)
+    scale = None if pb.scale is None else float(pb.scale)
+    return PackedBuffer(pb.codec, np.asarray(pb.data), scale, pb.shape,
+                        pb.dtype)
+
+
+def unpack_buffer(pb: PackedBuffer):
+    """Host-side unpack of :func:`pack_buffer` output."""
+    import numpy as np
+
+    return np.asarray(decode_buffer(pb))
+
+
 def zero_residuals(plan, leaves_or_tree) -> Tuple[jnp.ndarray, ...]:
     """Zero error-feedback carry matching ``plan``'s bucket layout."""
     return tuple(

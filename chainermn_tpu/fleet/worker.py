@@ -1086,6 +1086,102 @@ def scenario_serving_spec_resume(pid, nproc, scratch, label, args):
     }
 
 
+def scenario_serving_disagg(pid, nproc, scratch, label, args):
+    """ISSUE 18 fleet leg: disaggregated role pools under a prefill
+    death.  4 processes: pids 0/1 are the DECODE pool
+    (``DisaggDecodeReplica``, ingesting published handoffs), pids 2/3
+    the PREFILL pool (``seq % 2`` over the pool-scoped drain markers)
+    — the victim must NOT be process 0, whose death would take the
+    ``jax.distributed`` coordinator (and so every survivor) down with
+    it.  The schedule kills prefill replica 0 (process 2) at its 4th
+    ``serving.prefill`` call — mid-share, with handoffs published and
+    the rest of its share unpublished.  Prefill replica 1 finishes its
+    own share, then (after an idle grace with uncovered requests still
+    pending) marks the dead replica draining in the PREFILL namespace
+    and re-derives its share; publishing is idempotent, so a racing
+    duplicate overwrites with identical bytes.  The decode pool never
+    orphans (generous ``handoff_timeout_s``) — every request completes
+    FROM A HANDOFF, bit-identical to the fresh single-engine oracle."""
+    from chainermn_tpu.serving.batcher import Request
+    from chainermn_tpu.serving.disagg import (
+        DisaggDecodeReplica,
+        PrefillReplica,
+    )
+    from chainermn_tpu.serving.replica import RequestJournal, claim
+
+    assert nproc == 4, "scenario is shaped for 2 decode + 2 prefill"
+    n_requests = int(args.get("n_requests", 12))
+    grace_s = float(args.get("grace_s", 1.5))
+    serve_timeout = float(args.get("serve_timeout_s", 240.0))
+    model, params, stream = _serving_fixture(n_requests)
+    journal = RequestJournal(os.path.join(scratch, "serve_journal"))
+    if pid == 0:
+        journal.submit_all([Request(p, m, id=i) for i, p, m in stream])
+    journal.wait_until(len(stream))
+
+    if pid in (2, 3):
+        pr = PrefillReplica(
+            _serving_engine(model, params), journal,
+            replica_index=pid - 2, n_replicas=2, codec="bf16",
+        )
+        # process 2 dies inside (schedule spec); process 3 loops until
+        # every still-pending request is covered by a handoff, marking
+        # the dead replica draining after the idle grace
+        marked = False
+        deadline = time.monotonic() + grace_s
+        while True:
+            n = pr.prefill_round()
+            todo = [d for d in journal.pending()
+                    if not journal.has_handoff(d["id"])]
+            if not todo:
+                break
+            if n > 0:
+                deadline = time.monotonic() + grace_s
+            elif not marked and time.monotonic() > deadline:
+                # replica 0's share is uncovered and nothing claims it:
+                # declare it dead in the prefill marker namespace
+                journal.mark_draining(0, pool=pr.pool)
+                marked = True
+            else:
+                time.sleep(0.05)
+        finish_and_exit({
+            "replica": pid - 2, "pool": "prefill",
+            "published": pr.published, "rederived": marked,
+            "wire_bytes": pr.wire_bytes,
+        }, linger_s=float(args.get("linger_s", 1.5)))
+
+    dr = DisaggDecodeReplica(
+        _serving_engine(model, params), journal,
+        replica_index=pid, n_replicas=2,
+        handoff_timeout_s=float(args.get("handoff_timeout_s", 300.0)),
+    )
+    served = dr.serve(until_complete=n_requests, timeout_s=serve_timeout)
+    by_id = {r["id"]: r for r in journal.requests()}
+    want = {r["id"] for r in claim(list(by_id.values()), pid, 2)}
+    assert set(served) == want, (sorted(served), sorted(want))
+    # every request rode a handoff — the death never forced an orphan
+    # fallback, and the allocator drained clean
+    assert dr.local_prefills == 0, dr.local_prefills
+    assert dr.ingested == len(served), (dr.ingested, len(served))
+    dr.engine.cache.check_invariants()
+    assert dr.engine.cache.used_pages == 0
+    journal.wait_until_complete(n_requests)
+    results = journal.results()
+    assert sorted(results) == sorted(i for i, _p, _m in stream)
+    oracle_eng = _serving_engine(model, params)
+    mismatches = [
+        rid for rid, prompt, max_new in stream
+        if results[rid]["tokens"] != oracle_eng.generate(prompt, max_new)
+    ]
+    assert not mismatches, mismatches
+    finish_and_exit({
+        "replica": pid, "pool": "decode",
+        "served": sorted(served), "ingested": dr.ingested,
+        "local_prefills": dr.local_prefills,
+        "completed": len(results), "bit_identical": True,
+    }, linger_s=float(args.get("linger_s", 1.5)))
+
+
 # ----------------------------------------------------------------------
 def main():
     scenario, port, pid, nproc, scratch, label, args_json = sys.argv[1:8]

@@ -256,6 +256,43 @@ def attribute(timeline, trace) -> AttributionReport:
 
 
 # ----------------------------------------------------------------------
+# KV handoff pricing (serving.disagg's transfer-once wire tier)
+# ----------------------------------------------------------------------
+# The disaggregated serving tier's handoff is not a collective — there
+# is no CollectiveRecord to join against — but its spans carry exact
+# wire bytes the same way bucket psums do, so the same pricing question
+# applies: what did the transfer achieve against the link ceiling?
+
+KV_SPANS = ("kv.export", "kv.ship", "kv.import")
+
+
+def kv_transfer_points(timeline) -> List[tuple]:
+    """``(name, wire_bytes, achieved_bytes_per_sec, duration_s)`` per
+    byte-carrying ``kv.*`` span — the handoff analogue of
+    :meth:`AttributionReport.bandwidth_points`, ready to compare a
+    disaggregated pool's KV shipping against the bandwidth profile.
+    Spans without a ``bytes`` arg (a ship that failed before packing)
+    are skipped; zero-duration spans price at ``None`` rather than inf.
+    """
+    tl = getattr(timeline, "timeline", timeline)
+    out = []
+    for sp in tl.spans():
+        if sp["name"] not in KV_SPANS:
+            continue
+        b = sp["args"].get("bytes")
+        if not isinstance(b, (int, float)) or b <= 0:
+            continue
+        dur = float(sp["dur"])
+        out.append((
+            sp["name"],
+            int(b),
+            (float(b) / dur) if dur > 0 else None,
+            dur,
+        ))
+    return out
+
+
+# ----------------------------------------------------------------------
 # measured issue delays (the runtime analogue of check_overlap's delay)
 # ----------------------------------------------------------------------
 @dataclass(frozen=True)

@@ -26,6 +26,11 @@ worlds re-form through ``resilience.elastic``.
 * :mod:`.replica` — elastic decode replicas over a shared-FS request
   journal: deterministic request claiming, drain on preemption,
   ``serve_elastic`` world re-formation, KV-page warm start.
+* :mod:`.disagg` — disaggregated prefill/decode role pools:
+  :class:`PrefillReplica` prefills and publishes codec-packed KV
+  handoffs into the journal's ``kv_handoff/`` area;
+  :class:`DisaggDecodeReplica` ingests them instead of prefilling
+  (bit-identical for lossless codecs, orphan-safe local re-prefill).
 
 See docs/serving.md for the architecture and the latency-attribution
 recipe.
@@ -33,6 +38,7 @@ recipe.
 
 from .kv_cache import (  # noqa: F401
     CacheAdmissionError,
+    KVExport,
     NULL_PAGE,
     PagedKVCache,
     PrefixMatch,
@@ -54,4 +60,13 @@ from .replica import (  # noqa: F401
     ReplicaAutoscaler,
     RequestJournal,
     serve_elastic,
+)
+from .disagg import (  # noqa: F401
+    DisaggDecodeReplica,
+    PrefillReplica,
+    load_handoff,
+    pack_handoff,
+    publish_handoff,
+    transfer_kv,
+    unpack_handoff,
 )

@@ -78,6 +78,7 @@ class Request:
         self.retries = 0
         self.error: Optional[str] = None
         self.submitted_at: Optional[float] = None
+        self.prefill_started_at: Optional[float] = None
         self.first_token_at: Optional[float] = None
         self.done_at: Optional[float] = None
 
@@ -298,6 +299,17 @@ class ContinuousBatcher:
                 self.registry.histogram("serving.ttft").observe(
                     t_now - r.submitted_at
                 )
+                # TTFT decomposes into time-in-queue (submit -> the
+                # prefill/ingest starting) and time-in-prefill — the
+                # split that tells disaggregation's A/B bench WHICH
+                # term a role-pool change moved
+                if r.prefill_started_at is not None:
+                    self.registry.histogram(
+                        "serving.ttft.queue").observe(max(
+                            r.prefill_started_at - r.submitted_at, 0.0))
+                    self.registry.histogram(
+                        "serving.ttft.prefill").observe(max(
+                            t_now - r.prefill_started_at, 0.0))
 
     def step(self) -> bool:
         """One serving iteration; returns True while work remains."""
@@ -318,6 +330,7 @@ class ContinuousBatcher:
                         break
                     r = joins[0]
                     t0 = time.monotonic()
+                    r.prefill_started_at = t0
                     logits = self._prefill_one(r)
                     t1 = time.monotonic()
                     self.registry.histogram(
@@ -341,6 +354,44 @@ class ContinuousBatcher:
                 for r in list(self.active.values()):
                     self._requeue(r, f"{type(err).__name__}: {err}")
         return bool(self.queue or self.active)
+
+    # -- handoff ingest (disaggregated serving) -------------------------
+    def can_ingest(self, r: Request) -> bool:
+        """Can a published handoff for ``r`` be admitted right now?
+        (Fresh pages for the full reservation — imports never alias the
+        exporter's pool, so there is no prefix discount to probe.)"""
+        return self.engine.cache.can_admit(r.total_tokens)
+
+    def ingest(self, r: Request, kv, first_token: int) -> Request:
+        """Admit a prefill-pool handoff instead of prefilling: fresh
+        pages, the exported KV copied in (prefix chain re-registered by
+        the cache), and the prefill-produced first token appended — the
+        request starts decoding exactly where a local prefill would
+        have left it, so greedy decode is bit-identical from here.
+
+        The ingest is the decode pool's prefill-phase analogue, so it
+        stamps ``prefill_started_at`` (the TTFT split's second term)
+        and lands in ``serving.ingest_latency``."""
+        if r.total_tokens > self.engine.max_total:
+            raise ValueError(
+                f"{r.id}: needs {r.total_tokens} cache positions > "
+                f"engine max_total={self.engine.max_total}"
+            )
+        t0 = time.monotonic()
+        if r.submitted_at is None:
+            r.submitted_at = t0
+        r.prefill_started_at = t0
+        slot = self.engine.ingest_kv(kv, r.total_tokens)
+        r.slot = slot
+        r.shared_len = 0
+        r.state = RUNNING
+        self.active[slot] = r
+        t1 = time.monotonic()
+        self.registry.histogram("serving.ingest_latency").observe(t1 - t0)
+        self._append_token(r, int(first_token), t1)
+        if r._finished():
+            self._retire(r)
+        return r
 
     # -- driving --------------------------------------------------------
     def run(self, max_steps: Optional[int] = None) -> Dict[str, Request]:
@@ -379,7 +430,9 @@ class ContinuousBatcher:
             "prefix_tokens_shared": self.prefix_tokens_shared,
         }
         for name in ("serving.token_latency", "serving.ttft",
-                     "serving.prefill_latency"):
+                     "serving.ttft.queue", "serving.ttft.prefill",
+                     "serving.prefill_latency",
+                     "serving.ingest_latency"):
             if not self.registry.has_histogram(name):
                 continue
             h = self.registry.histogram(name)

@@ -543,6 +543,43 @@ class DecodeEngine:
             self.steps += 1
             return np.asarray(logits)
 
+    # -- KV handoff (disaggregated prefill/decode) ----------------------
+    def export_kv(self, slot: int):
+        """Gather ``slot``'s cache state into a dense handoff buffer
+        (:meth:`PagedKVCache.export_kv`) under a ``kv.export`` span
+        carrying the buffer's native bytes.  Paged layout only — the
+        dense oracle has no block table to gather through and never
+        participates in a role pool."""
+        if self.layout != "paged":
+            raise ValueError(
+                "KV handoff is a paged-layout feature; the dense "
+                "oracle serves unified"
+            )
+        with _obs.span("kv.export", slot=int(slot)) as sp:
+            kv = self.cache.export_kv(slot)
+            sp.set(tokens=kv.length,
+                   bytes=int(kv.k.nbytes) + int(kv.v.nbytes))
+        return kv
+
+    def ingest_kv(self, kv, total_tokens: int,
+                  slot: Optional[int] = None) -> int:
+        """Admit a handoff buffer (:meth:`PagedKVCache.import_kv`) —
+        fresh pages, prefixes re-registered — under a ``kv.import``
+        span carrying the buffer's native bytes.  Returns the slot."""
+        if self.layout != "paged":
+            raise ValueError(
+                "KV handoff is a paged-layout feature; the dense "
+                "oracle serves unified"
+            )
+        if total_tokens > self.max_total:
+            raise ValueError(
+                f"handoff needs {total_tokens} cache positions > "
+                f"max_total={self.max_total}"
+            )
+        with _obs.span("kv.import", tokens=int(kv.length),
+                       bytes=int(kv.k.nbytes) + int(kv.v.nbytes)):
+            return self.cache.import_kv(kv, int(total_tokens), slot=slot)
+
     def generate(self, prompt: Sequence[int], max_new_tokens: int,
                  eos_id: Optional[int] = None) -> list:
         """Single-request greedy decode (admit -> prefill -> decode

@@ -1,0 +1,465 @@
+"""Disaggregated prefill/decode: role pools with codec-streamed KV
+handoff.
+
+The mixed-load problem: a long prompt's prefill steals decode
+iterations from every in-flight request on the same replica — one
+prefill-heavy request inflates every other request's inter-token
+latency.  The attack ("Understanding and Improving Communication
+Performance in Multi-node LLM Inference", PAPERS.md) is to split the
+fleet into a PREFILL pool (prompt-bucket prefill only, publishes each
+request's KV) and a DECODE pool (ingests published KV instead of
+prefilling), with the handoff streamed through the existing wire
+codecs.
+
+The handoff lifecycle::
+
+    client         prefill pool              decode pool
+      |  submit        |                          |
+      |--------------> |  claim (seq % n_prefill, |
+      |                |   pool="prefill" drains) |
+      |                |  prefill -> export_kv    |
+      |                |  pack (codec) -> publish |
+      |                |  kv_handoff/kv_<id>.npz  |
+      |                |------------------------->|  claim (seq % n_decode)
+      |                |                          |  load -> import_kv
+      |                |                          |  decode to completion
+      | <--------------------------------------- |  res_<id>.json
+
+Three invariants make this safe:
+
+* **Bit-identity** — ``export_kv`` copies the slot's pages by value
+  (prefix-shared pages included) and ``import_kv`` admits fresh pages,
+  so the decode pool's cache state after ingest equals local prefill's
+  exactly; the cache dtype is bf16, so the ``none``/``bf16`` codecs are
+  lossless on the wire and the served tokens are bit-identical to the
+  unified oracle at 0 tolerance.  ``int8`` (per-buffer absmax, one
+  scale per layer per tensor) is a measured accuracy question gated by
+  greedy-token agreement, NOT a loss pin — KV ships once, so there is
+  no next step for an error-feedback residual to ride.
+* **Atomicity** — handoffs write tmp+rename into the journal's
+  ``kv_handoff/`` area (the results contract), so a decode replica
+  sees a complete handoff or none.  Publishing is idempotent: greedy
+  prefill is deterministic, so two prefill replicas racing on a
+  re-derived share overwrite each other with identical bytes.
+* **Recoverability** — a dead prefill replica's share re-derives onto
+  the healthy prefill replicas via the pool-scoped drain markers
+  (``mark_draining(i, pool="prefill")``); a handoff orphaned past
+  ``handoff_timeout_s`` is re-prefilled LOCALLY by the decode replica
+  (greedy replay from the prompt — bit-identical), so the decode pool
+  completes the stream even if the whole prefill pool dies.
+
+Telemetry: ``kv.export`` / ``kv.ship`` / ``kv.import`` spans carry
+exact byte counts, priced by ``observability.attribute.
+kv_transfer_points``.  The handoff path itself issues ZERO collectives
+(pinned in tests): encode/decode are jnp-pure casts and the transfer
+is a file or host copy.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+import zipfile
+from collections import deque
+from typing import List, NamedTuple, Optional, Tuple
+
+import jax.numpy as jnp
+import numpy as np
+
+from ..comm_wire.codecs import (
+    HANDOFF_CODECS,
+    PackedBuffer,
+    pack_buffer,
+    packed_wire_bytes,
+    unpack_buffer,
+)
+from ..observability import timeline as _obs
+from ..resilience.log import emit
+from .batcher import FAILED, Request
+from .kv_cache import KVExport
+from .replica import DecodeReplica, RequestJournal, claim
+
+
+# ----------------------------------------------------------------------
+# wire form: per-layer codec packing of a KVExport
+# ----------------------------------------------------------------------
+class PackedHandoff(NamedTuple):
+    """A :class:`~chainermn_tpu.serving.kv_cache.KVExport` in wire
+    form: ``k``/``v`` are the per-layer codec payloads concatenated
+    into one flat byte buffer each; ``meta`` carries everything needed
+    to invert the pack (codec, geometry, dtypes, int8 scales) plus the
+    request-level fields that ride the handoff (valid length, prefix
+    chain, the prefill-produced first token)."""
+
+    meta: dict
+    k: np.ndarray
+    v: np.ndarray
+
+
+def _wire_dtype(codec: str, native: str) -> np.dtype:
+    if codec == "none":
+        return np.dtype(jnp.dtype(native))
+    if codec == "int8":
+        return np.dtype(np.int8)
+    return np.dtype(jnp.dtype({"bf16": jnp.bfloat16,
+                               "f16": jnp.float16}[codec]))
+
+
+def _pack_tensor(x: np.ndarray, codec: str):
+    """Per-LAYER packing of one ``(n_layers, ...)`` tensor: the int8
+    codec gets one absmax grid per layer per tensor (KV magnitudes
+    differ wildly across layers — a single global scale would waste
+    most of the 8-bit grid on the loudest layer)."""
+    payloads, scales = [], []
+    for i in range(x.shape[0]):
+        pb = pack_buffer(x[i], codec)
+        payloads.append(np.asarray(pb.data).reshape(-1).view(np.uint8))
+        scales.append(pb.scale)
+    return np.concatenate(payloads), scales
+
+
+def _unpack_tensor(raw: np.ndarray, codec: str, shape, native: str,
+                   scales) -> np.ndarray:
+    wd = _wire_dtype(codec, native)
+    per = int(np.prod(shape[1:])) * wd.itemsize
+    out = np.empty(tuple(shape), np.dtype(jnp.dtype(native)))
+    for i in range(shape[0]):
+        data = raw[i * per:(i + 1) * per].view(wd).reshape(shape[1:])
+        out[i] = unpack_buffer(PackedBuffer(
+            codec, data, scales[i], tuple(shape[1:]), native
+        ))
+    return out
+
+
+def pack_handoff(kv: KVExport, first_token: int,
+                 codec: str = "none") -> PackedHandoff:
+    """Pack an exported KV buffer for the wire."""
+    if codec not in HANDOFF_CODECS:
+        raise ValueError(
+            f"unknown handoff codec {codec!r}; one of {HANDOFF_CODECS}"
+        )
+    k = np.asarray(kv.k)
+    raw_k, scales_k = _pack_tensor(k, codec)
+    raw_v, scales_v = _pack_tensor(np.asarray(kv.v), codec)
+    n_scales = sum(1 for s in scales_k + scales_v if s is not None)
+    meta = {
+        "codec": codec,
+        "shape": [int(s) for s in k.shape],
+        "dtype": kv.dtype,
+        "length": int(kv.length),
+        "page_size": int(kv.page_size),
+        "prefix_chain": list(kv.prefix_chain),
+        "first_token": int(first_token),
+        "scales_k": scales_k,
+        "scales_v": scales_v,
+        # exact bytes in flight: both payloads plus 4 per int8 scale
+        "wire_bytes": int(raw_k.size + raw_v.size + 4 * n_scales),
+    }
+    return PackedHandoff(meta, raw_k, raw_v)
+
+
+def unpack_handoff(ph: PackedHandoff) -> Tuple[KVExport, int]:
+    """Invert :func:`pack_handoff`: ``(KVExport, first_token)``."""
+    m = ph.meta
+    kv = KVExport(
+        k=_unpack_tensor(ph.k, m["codec"], m["shape"], m["dtype"],
+                         m["scales_k"]),
+        v=_unpack_tensor(ph.v, m["codec"], m["shape"], m["dtype"],
+                         m["scales_v"]),
+        length=int(m["length"]),
+        page_size=int(m["page_size"]),
+        dtype=m["dtype"],
+        prefix_chain=tuple(m["prefix_chain"]),
+    )
+    return kv, int(m["first_token"])
+
+
+def transfer_kv(kv: KVExport, first_token: int,
+                codec: str = "none") -> Tuple[KVExport, int]:
+    """In-process handoff (co-located pools, no filesystem): the full
+    pack -> ship -> unpack round trip under a ``kv.ship`` span with the
+    exact wire bytes — what a same-host pool pair pays instead of the
+    journal file."""
+    with _obs.span("kv.ship", codec=codec, transport="memory") as sp:
+        ph = pack_handoff(kv, first_token, codec)
+        sp.set(bytes=ph.meta["wire_bytes"])
+    return unpack_handoff(ph)
+
+
+# ----------------------------------------------------------------------
+# journal shipping (co-scheduled pools on the shared FS)
+# ----------------------------------------------------------------------
+def publish_handoff(journal: RequestJournal, request_id: str,
+                    kv: KVExport, first_token: int,
+                    codec: str = "none") -> int:
+    """Pack and atomically publish a handoff into the journal's
+    ``kv_handoff/`` area; returns the exact wire bytes shipped.
+    tmp+rename (with fsync) — a reader sees a complete handoff or
+    none.  Overwrite-safe: greedy prefill is deterministic, so a
+    re-derived share republishing an id writes identical content."""
+    with _obs.span("kv.ship", codec=codec, transport="journal") as sp:
+        ph = pack_handoff(kv, first_token, codec)
+        path = journal.handoff_path(request_id)
+        tmp = os.path.join(
+            os.path.dirname(path),
+            f".tmp_{os.getpid()}_kv_{request_id}.npz",
+        )
+        meta_raw = np.frombuffer(
+            json.dumps(ph.meta).encode(), np.uint8
+        )
+        with open(tmp, "wb") as f:
+            np.savez(f, meta=meta_raw, k=ph.k, v=ph.v)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, path)
+        sp.set(bytes=ph.meta["wire_bytes"])
+    return int(ph.meta["wire_bytes"])
+
+
+def load_handoff(journal: RequestJournal,
+                 request_id: str) -> Optional[Tuple[KVExport, int, int]]:
+    """Load a published handoff: ``(KVExport, first_token,
+    wire_bytes)``, or ``None`` when no (complete) handoff exists —
+    a missing file and a torn/corrupt one read the same, pending."""
+    path = journal.handoff_path(request_id)
+    try:
+        with np.load(path) as z:
+            meta = json.loads(z["meta"].tobytes().decode())
+            ph = PackedHandoff(meta, z["k"], z["v"])
+            kv, first = unpack_handoff(ph)
+    except (OSError, ValueError, KeyError, zipfile.BadZipFile):
+        return None
+    return kv, first, int(meta.get("wire_bytes", 0))
+
+
+# ----------------------------------------------------------------------
+# role pools
+# ----------------------------------------------------------------------
+class PrefillReplica:
+    """One prefill-pool member: claims its ``seq % n`` share of the
+    journal (pool-scoped drains: ``draining(pool="prefill")``), runs
+    prompt-bucket prefill ONLY, and publishes each request's KV + first
+    token as a handoff.  It never decodes — its cache reservation is
+    the prompt bucket, not the full generation budget, so a prefill
+    slot is several times cheaper than a decode slot for long prompts.
+
+    Exported slots stay RESIDENT (a sliding window over the cache
+    capacity) so consecutive prompts sharing a prefix alias pages
+    through the normal copy-on-write machinery; the oldest resident is
+    released when admission needs room.  A claimed request that can
+    never be prefilled (oversize, malformed) fails LOUDLY in the
+    journal, exactly like the decode replica's contract."""
+
+    pool = "prefill"
+
+    def __init__(self, engine, journal: RequestJournal, *,
+                 replica_index: int = 0, n_replicas: int = 1,
+                 codec: str = "none"):
+        if getattr(engine, "layout", "paged") != "paged":
+            raise ValueError(
+                "a prefill pool exports paged KV; the dense oracle "
+                "serves unified"
+            )
+        if codec not in HANDOFF_CODECS:
+            raise ValueError(
+                f"unknown handoff codec {codec!r}; one of "
+                f"{HANDOFF_CODECS}"
+            )
+        self.engine = engine
+        self.journal = journal
+        self.replica_index = int(replica_index)
+        self.n_replicas = int(n_replicas)
+        self.codec = codec
+        self._resident: deque = deque()  # (slot, request_id), oldest first
+        self.published = 0
+        self.wire_bytes = 0
+        self.drained = False
+
+    def _claimed(self) -> List[dict]:
+        todo = [d for d in self.journal.pending()
+                if not self.journal.has_handoff(d["id"])]
+        return claim(todo, self.replica_index, self.n_replicas,
+                     draining=self.journal.draining(pool=self.pool))
+
+    def _make_room(self, total: int, prompt) -> object:
+        """Release oldest resident exports until ``total`` admits;
+        returns the (re-derived) prefix match for the prompt."""
+        cache = self.engine.cache
+        prefix = cache.lookup_prefix(prompt)
+        while (not cache.can_admit(total, prefix=prefix)
+               and self._resident):
+            slot, _ = self._resident.popleft()
+            self.engine.release(slot)
+            # releasing can drop index entries the match aliased
+            prefix = cache.lookup_prefix(prompt)
+        return prefix
+
+    def prefill_one(self, d: dict) -> bool:
+        """Prefill one claimed request and publish its handoff; False
+        when the request failed loudly instead."""
+        rid = d["id"]
+        prompt = [int(t) for t in d["prompt"]]
+        try:
+            r = Request(prompt, d["max_new_tokens"], id=rid,
+                        eos_id=d.get("eos_id"))
+            # reserve the PROMPT bucket only: the decode pool owns the
+            # generation budget; a too-big total still fails here so
+            # the stream never wedges on it downstream
+            if r.total_tokens > self.engine.max_total:
+                raise ValueError(
+                    f"{rid}: needs {r.total_tokens} cache positions > "
+                    f"engine max_total={self.engine.max_total}"
+                )
+            bucket = self.engine.prompt_bucket(len(prompt))
+            prefix = self._make_room(bucket, prompt)
+            slot = self.engine.admit(bucket, prefix=prefix)
+        except ValueError as err:
+            r = Request([0], 1, id=rid)
+            r.state = FAILED
+            r.error = str(err)
+            self.journal.write_result(r)
+            emit("request_failed", "serving.disagg", request=rid,
+                 why=str(err))
+            return False
+        logits = self.engine.prefill(slot, prompt)
+        self.engine.cache.register_prefix(slot, prompt)
+        kv = self.engine.export_kv(slot)
+        first = int(np.argmax(logits))
+        self.wire_bytes += publish_handoff(
+            self.journal, rid, kv, first, codec=self.codec
+        )
+        self._resident.append((slot, rid))
+        self.published += 1
+        emit("handoff_published", "serving.disagg", request=rid,
+             replica=self.replica_index, tokens=kv.length,
+             codec=self.codec)
+        return True
+
+    def prefill_round(self) -> int:
+        """One claim pass: prefill + publish every claimed request;
+        returns how many were taken (published or failed loudly)."""
+        n = 0
+        for d in self._claimed():
+            self.prefill_one(d)
+            n += 1
+        return n
+
+    def serve(self, max_rounds: Optional[int] = None, *,
+              until_complete: Optional[int] = None,
+              poll_s: float = 0.05,
+              timeout_s: float = 120.0) -> int:
+        """Drive claim->prefill->publish rounds; returns handoffs
+        published.  Same loop contract as :meth:`DecodeReplica.serve`:
+        an empty share exits (or polls, in ``until_complete`` pool
+        mode), and a preemption notice drains cleanly — published
+        handoffs are durable, the unpublished share re-derives onto
+        the healthy prefill replicas."""
+        from ..resilience.errors import PreemptionError
+
+        rounds = 0
+        deadline = (time.monotonic() + timeout_s
+                    if until_complete is not None else None)
+        while True:
+            try:
+                n = self.prefill_round()
+            except PreemptionError as err:
+                emit("replica_preempted", "serving.disagg",
+                     replica=self.replica_index, pool=self.pool,
+                     error=f"{type(err).__name__}: {err}")
+                self.drained = True
+                return self.published
+            rounds += 1
+            if max_rounds is not None and rounds >= max_rounds:
+                break
+            if n == 0:
+                if until_complete is None:
+                    break
+                if len(self.journal.results()) >= until_complete:
+                    break
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"prefill replica {self.replica_index}: "
+                        f"{len(self.journal.results())}/"
+                        f"{until_complete} results after "
+                        f"{timeout_s:.0f}s in pool mode"
+                    )
+                time.sleep(poll_s)
+        return self.published
+
+
+class DisaggDecodeReplica(DecodeReplica):
+    """A decode-pool member: the full :class:`DecodeReplica` contract
+    (claiming, drain/retry/preemption, warm start) with the admission
+    path swapped — a claimed request is INGESTED from its published
+    handoff instead of prefilled.
+
+    A request whose handoff has not appeared yet stays pending (the
+    serve loop polls); past ``handoff_timeout_s`` it is declared
+    orphaned — its prefill replica died before publishing — and falls
+    back to LOCAL prefill through the base path, which is bit-identical
+    (greedy replay from the prompt).  So the decode pool completes the
+    stream even if the whole prefill pool is gone; the handoff is an
+    optimization with a correctness-preserving failure mode."""
+
+    def __init__(self, engine, journal: RequestJournal, *,
+                 handoff_timeout_s: float = 30.0, **kw):
+        super().__init__(engine, journal, **kw)
+        if getattr(engine, "layout", "paged") != "paged":
+            raise ValueError(
+                "a disaggregated decode pool ingests paged KV; the "
+                "dense oracle serves unified"
+            )
+        self.handoff_timeout_s = float(handoff_timeout_s)
+        self._first_seen: dict = {}
+        self.ingested = 0
+        self.local_prefills = 0
+
+    def _enqueue(self, d: dict, served: dict) -> bool:
+        rid = d["id"]
+        got = load_handoff(self.journal, rid)
+        if got is not None:
+            kv, first, wire = got
+            r = None
+            try:
+                r = Request(d["prompt"], d["max_new_tokens"], id=rid,
+                            eos_id=d.get("eos_id"))
+                if not self.batcher.can_ingest(r):
+                    return False  # no pages free yet; next round
+                self.batcher.ingest(r, kv, first)
+            except ValueError as err:
+                if r is None:
+                    r = Request([0], 1, id=rid)
+                r.state = FAILED
+                r.error = str(err)
+                self.journal.write_result(r)
+                served[rid] = r
+                emit("request_failed", "serving.replica",
+                     request=rid, why=str(err))
+                return True
+            self._first_seen.pop(rid, None)
+            self.ingested += 1
+            emit("handoff_ingested", "serving.disagg", request=rid,
+                 replica=self.replica_index, tokens=kv.length,
+                 wire_bytes=wire)
+            return True
+        now = time.monotonic()
+        seen = self._first_seen.setdefault(rid, now)
+        if now - seen >= self.handoff_timeout_s:
+            # orphaned: the prefill share owner died before publishing
+            # — re-prefill locally (bit-identical greedy replay)
+            self._first_seen.pop(rid, None)
+            self.local_prefills += 1
+            emit("handoff_orphan_reprefill", "serving.disagg",
+                 request=rid, replica=self.replica_index,
+                 waited=round(now - seen, 3))
+            return super()._enqueue(d, served)
+        return False
+
+    def _flush_finished(self, served: dict) -> None:
+        before = set(served)
+        super()._flush_finished(served)
+        for rid in set(served) - before:
+            # results are the durable record; a consumed handoff is
+            # journal litter once its result exists
+            self.journal.clear_handoff(rid)

@@ -75,6 +75,28 @@ import numpy as np
 NULL_PAGE = 0
 
 
+class KVExport(NamedTuple):
+    """One request's KV state, gathered out of the page pool into a
+    dense contiguous handoff buffer (:meth:`PagedKVCache.export_kv`).
+
+    ``k``/``v`` are page-major ``(n_layers, n_pages, page_size,
+    n_heads, d_head)`` host arrays holding the slot's pages BY VALUE in
+    table order — prefix-shared pages are copied like private ones, so
+    the buffer is self-contained and the importer owes the exporter's
+    pool nothing.  ``length`` is the valid cache positions (positions
+    past it are prefill-bucket padding the masked attend never reads).
+    ``prefix_chain`` is the page-aligned sha1 chain-hash run registered
+    for this slot's prompt (possibly empty, always prefix-closed), so
+    an importer can re-register sharing without re-hashing tokens."""
+
+    k: "np.ndarray"
+    v: "np.ndarray"
+    length: int
+    page_size: int
+    dtype: str
+    prefix_chain: Tuple[str, ...]
+
+
 class PrefixMatch(NamedTuple):
     """A prefix-index hit: the page run to alias at admission.
 
@@ -493,6 +515,103 @@ class PagedKVCache:
                 f"rollback to {length} outside [0, {int(self.lengths[slot])}]"
             )
         self.lengths[slot] = length
+
+    # -- prefill/decode handoff (disaggregated serving) ----------------
+    def export_kv(self, slot: int) -> KVExport:
+        """Gather ``slot``'s pages — through the block table, prefix-
+        shared pages included by value — into a dense contiguous
+        :class:`KVExport` handoff buffer.  The slot itself is untouched
+        (still active, still owning its pages): export is a read, so a
+        prefill replica can publish the handoff and only then release.
+
+        The prefix chain rides along so the importer can re-register
+        page-aligned sharing (:meth:`import_kv`): for each page-aligned
+        prefix depth of the slot's valid positions, the chain hash this
+        cache's index maps to exactly that page run.  The scan stops at
+        the first unindexed depth — chains must stay prefix-closed or
+        ``lookup_prefix``'s first-missing-link scan would never reach
+        the deeper entries."""
+        if not self.active[slot]:
+            raise KeyError(f"slot {slot} is not active")
+        pages = self._slot_pages[slot]
+        length = int(self.lengths[slot])
+        idx = np.asarray(pages, np.int64)
+        k = np.asarray(self.k_pages[:, idx])
+        v = np.asarray(self.v_pages[:, idx])
+        by_entry = {e: h for h, e in self._prefix_index.items()}
+        chain: List[str] = []
+        for m in range(1, length // self.page_size + 1):
+            h = by_entry.get((tuple(pages[:m]), m * self.page_size))
+            if h is None:
+                break
+            chain.append(h)
+        return KVExport(
+            k=k, v=v, length=length, page_size=self.page_size,
+            dtype=jnp.dtype(self.dtype).name,
+            prefix_chain=tuple(chain),
+        )
+
+    def import_kv(self, kv: KVExport, total_tokens: int,
+                  slot: Optional[int] = None) -> int:
+        """Admit a handoff into THIS cache: a fresh reservation for
+        ``total_tokens`` (the request's prompt + max_new budget, same
+        number the exporter admitted with), the buffer's pages copied
+        in by value, ``lengths`` set to the exported valid positions —
+        bit-identical to having prefilled locally.  The exported prefix
+        chain re-registers against the NEW pages (first registration
+        wins, exactly like :meth:`register_prefix`), so later requests
+        admitted here alias the imported pages without re-prefilling.
+        Returns the slot id; raises :class:`CacheAdmissionError` via
+        :meth:`admit` when the pool cannot take it (callers gate on
+        :meth:`can_admit`)."""
+        if int(kv.page_size) != self.page_size:
+            raise ValueError(
+                f"handoff page_size {kv.page_size} != this cache's "
+                f"{self.page_size} (role pools must share page geometry)"
+            )
+        if jnp.dtype(kv.dtype) != jnp.dtype(self.dtype):
+            raise ValueError(
+                f"handoff dtype {kv.dtype} != cache dtype "
+                f"{jnp.dtype(self.dtype).name}"
+            )
+        want = (self.n_layers, self.page_size, self.n_heads, self.d_head)
+        got = tuple(np.shape(kv.k))
+        if len(got) != 5 or (got[0], got[2], got[3], got[4]) != want:
+            raise ValueError(
+                f"handoff buffer shape {got} does not match cache "
+                f"geometry (n_layers, *, page_size, n_heads, d_head)="
+                f"{want}"
+            )
+        length = int(kv.length)
+        if length > int(total_tokens):
+            raise ValueError(
+                f"handoff holds {length} positions > total_tokens="
+                f"{total_tokens}"
+            )
+        if pages_needed(length, self.page_size) > got[1]:
+            raise ValueError(
+                f"handoff claims {length} positions but ships only "
+                f"{got[1]} pages"
+            )
+        slot = self.admit(int(total_tokens), slot=slot)
+        pages = self._slot_pages[slot]
+        n_copy = min(len(pages), got[1])
+        idx = np.asarray(pages[:n_copy], np.int64)
+        self.k_pages = self.k_pages.at[:, idx].set(
+            jnp.asarray(kv.k[:, :n_copy], self.dtype)
+        )
+        self.v_pages = self.v_pages.at[:, idx].set(
+            jnp.asarray(kv.v[:, :n_copy], self.dtype)
+        )
+        self.lengths[slot] = length
+        for m, h in enumerate(kv.prefix_chain, start=1):
+            if m > n_copy or m * self.page_size > length:
+                break
+            if h not in self._prefix_index:
+                self._prefix_index[h] = (
+                    tuple(pages[:m]), m * self.page_size
+                )
+        return slot
 
     # -- arrays for the compiled step ----------------------------------
     def tables_array(self) -> jnp.ndarray:

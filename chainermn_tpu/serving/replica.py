@@ -66,6 +66,10 @@ class RequestJournal:
     def __init__(self, root: str):
         self.root = root
         os.makedirs(root, exist_ok=True)
+        # pending() memo: (request names, result names) -> pending list
+        self._pending_sig: Optional[tuple] = None
+        self._pending_cache: List[dict] = []
+        self._pending_scans = 0  # full rescans (the call-count pin)
 
     def submit(self, request: Request) -> None:
         # next seq = max existing + 1, parsed from the COMMITTED
@@ -123,9 +127,30 @@ class RequestJournal:
 
     def pending(self) -> List[dict]:
         """Journaled requests with no result yet — what the surviving
-        world still owes, submission order."""
-        done = self.results()
-        return [r for r in self.requests() if r["id"] not in done]
+        world still owes, submission order.
+
+        Memoized by directory signature (the checkpoint layer's
+        ``_is_complete`` trick): request/result files are write-once-
+        by-rename, so the sorted NAME sets fully determine the pending
+        list — one ``listdir`` per call, a full re-read of every
+        request file only when a name appears or disappears.  Without
+        this, every replica's claim poll re-parses the whole journal,
+        and large streams make polling quadratic."""
+        names = os.listdir(self.root)
+        sig = (
+            tuple(sorted(n for n in names
+                         if n.startswith("req_") and n.endswith(".json"))),
+            tuple(sorted(n for n in names
+                         if n.startswith("res_") and n.endswith(".json"))),
+        )
+        if sig != self._pending_sig:
+            self._pending_scans += 1
+            done = self.results()
+            self._pending_cache = [
+                r for r in self.requests() if r["id"] not in done
+            ]
+            self._pending_sig = sig
+        return list(self._pending_cache)
 
     # -- adaptive drain -------------------------------------------------
     # The straggler-adaptive escalation for serving (resilience.
@@ -133,32 +158,90 @@ class RequestJournal:
     # so every replica observes the same draining set on its next claim
     # pass — the slow replica's seq-mod share migrates to the healthy
     # ones with no coordination beyond the shared filesystem.
-    def mark_draining(self, replica_index: int) -> None:
+    @staticmethod
+    def _drain_name(replica_index: int, pool: str) -> str:
+        # ``pool`` scopes the marker to one role pool (disaggregated
+        # serving): a draining PREFILL replica must redirect prefill-
+        # pool claims without also re-routing the decode pool's —
+        # each pool reads only its own marker namespace.  The default
+        # "" keeps the unified pool's historical filenames.
+        if pool:
+            if not re.fullmatch(r"[A-Za-z]+", pool):
+                raise ValueError(
+                    f"pool must be alphabetic (it embeds in the marker "
+                    f"filename), got {pool!r}"
+                )
+            return f"drain_{pool}_{int(replica_index)}.json"
+        return f"drain_{int(replica_index)}.json"
+
+    def mark_draining(self, replica_index: int, *,
+                      pool: str = "") -> None:
         """Mark a replica draining: it claims nothing new and its
         pending share re-derives onto the healthy replicas
-        (:func:`claim` with ``draining=``)."""
+        (:func:`claim` with ``draining=``).  ``pool`` scopes the
+        marker to one role pool (``"prefill"``/``"decode"``)."""
         _atomic_write(
-            {"replica": int(replica_index)},
-            os.path.join(self.root, f"drain_{int(replica_index)}.json"),
+            {"replica": int(replica_index), "pool": pool},
+            os.path.join(self.root,
+                         self._drain_name(replica_index, pool)),
         )
 
-    def clear_draining(self, replica_index: int) -> None:
+    def clear_draining(self, replica_index: int, *,
+                       pool: str = "") -> None:
         """Lift a drain marker (the replica recovered or rejoined)."""
         try:
             os.remove(os.path.join(
-                self.root, f"drain_{int(replica_index)}.json"
+                self.root, self._drain_name(replica_index, pool)
             ))
         except OSError:
             pass
 
-    def draining(self) -> List[int]:
-        """Sorted indices of replicas currently marked draining."""
+    def draining(self, *, pool: str = "") -> List[int]:
+        """Sorted indices of replicas currently marked draining in
+        ``pool`` (the unified pool by default)."""
+        if pool:
+            pat = rf"drain_{re.escape(pool)}_(\d+)\.json"
+        else:
+            pat = r"drain_(\d+)\.json"
         out = []
         for name in os.listdir(self.root):
-            m = re.fullmatch(r"drain_(\d+)\.json", name)
+            m = re.fullmatch(pat, name)
             if m:
                 out.append(int(m.group(1)))
         return sorted(out)
+
+    # -- KV handoff area (disaggregated prefill/decode) -----------------
+    # Handoffs live beside the queue under ``kv_handoff/`` with the
+    # journal's atomicity contract (tmp+rename — serving.disagg writes
+    # them via publish_handoff): a decode replica either sees a
+    # complete handoff or none, never a torn one.
+    def handoff_dir(self) -> str:
+        d = os.path.join(self.root, "kv_handoff")
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def handoff_path(self, request_id: str) -> str:
+        return os.path.join(self.handoff_dir(), f"kv_{request_id}.npz")
+
+    def handoffs(self) -> List[str]:
+        """Request ids with a published handoff."""
+        out = []
+        for name in os.listdir(self.handoff_dir()):
+            m = re.fullmatch(r"kv_(.+)\.npz", name)
+            if m:
+                out.append(m.group(1))
+        return sorted(out)
+
+    def has_handoff(self, request_id: str) -> bool:
+        return os.path.exists(self.handoff_path(request_id))
+
+    def clear_handoff(self, request_id: str) -> None:
+        """Drop a consumed handoff (decode-pool hygiene after ingest —
+        results are the durable record, the KV buffer is not)."""
+        try:
+            os.remove(self.handoff_path(request_id))
+        except OSError:
+            pass
 
     # -- fleet rendezvous ----------------------------------------------
     # The journal is the replicas' only shared state, so it is also
@@ -366,6 +449,32 @@ class DecodeReplica:
             self.batcher.mirror_adopted()
         return step
 
+    def _enqueue(self, d: dict, served: dict) -> bool:
+        """Admit one claimed journal request into the batcher; returns
+        True when the request was taken this round (queued, or failed
+        loudly).  The disaggregated decode replica overrides this with
+        its handoff-ingest path and returns False to leave a request
+        pending when its handoff has not been published yet."""
+        r = None
+        try:
+            r = Request(d["prompt"], d["max_new_tokens"],
+                        id=d["id"], eos_id=d.get("eos_id"))
+            self.batcher.submit(r)
+        except ValueError as err:
+            # a journaled request this replica can never serve
+            # (outsizes its cache, malformed) fails LOUDLY in the
+            # journal — wedging the claim loop or crashing the
+            # replica would take the whole share down with it
+            if r is None:
+                r = Request([0], 1, id=d["id"])
+            r.state = FAILED
+            r.error = str(err)
+            self.journal.write_result(r)
+            served[r.id] = r
+            emit("request_failed", "serving.replica",
+                 request=r.id, why=str(err))
+        return True
+
     def _flush_finished(self, served: dict) -> None:
         """Write every newly finished request's result (covers both
         this round's claims and warm-start-resumed in-flight ones)."""
@@ -417,26 +526,10 @@ class DecodeReplica:
             with _obs.span("serving.replica_round",
                            replica=self.replica_index,
                            n=len(todo) + len(in_flight)):
+                admitted = 0
                 for d in todo:
-                    r = None
-                    try:
-                        r = Request(d["prompt"], d["max_new_tokens"],
-                                    id=d["id"], eos_id=d.get("eos_id"))
-                        self.batcher.submit(r)
-                    except ValueError as err:
-                        # a journaled request this replica can never
-                        # serve (outsizes its cache, malformed) fails
-                        # LOUDLY in the journal — wedging the claim
-                        # loop or crashing the replica would take the
-                        # whole share down with it
-                        if r is None:
-                            r = Request([0], 1, id=d["id"])
-                        r.state = FAILED
-                        r.error = str(err)
-                        self.journal.write_result(r)
-                        served[r.id] = r
-                        emit("request_failed", "serving.replica",
-                             request=r.id, why=str(err))
+                    if self._enqueue(d, served):
+                        admitted += 1
                 try:
                     self.batcher.run()
                 except PreemptionError as err:
@@ -447,6 +540,18 @@ class DecodeReplica:
                          error=f"{type(err).__name__}: {err}")
                     return served
                 self._flush_finished(served)
+            if todo and not admitted and not self.batcher.active \
+                    and not self.batcher.queue:
+                # claimed requests exist but none could be taken this
+                # round (a disaggregated decode replica waiting on its
+                # handoffs): poll instead of spinning the claim loop
+                if deadline is not None and time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"replica {self.replica_index}: "
+                        f"{len(todo)} claimed requests unadmittable "
+                        f"after {timeout_s:.0f}s"
+                    )
+                time.sleep(poll_s)
             rounds += 1
             if max_rounds is not None and rounds >= max_rounds:
                 break

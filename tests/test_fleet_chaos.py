@@ -236,6 +236,50 @@ class TestServingChurnFleet:
         assert sorted(e["process"] for e in dies) == [1, 2]
 
 
+class TestDisaggFleet:
+    def test_prefill_death_mid_handoff_decode_completes(self, tmp_path):
+        """ISSUE 18 acceptance: disaggregated role pools (2 decode +
+        2 prefill) under a prefill death mid-handoff.  The schedule
+        kills prefill replica 0 (process 2 — never process 0, the
+        coordinator) at its 4th ``serving.prefill`` call — three
+        handoffs published, the rest of its share unpublished.
+        Prefill replica 1 re-derives the dead share via the
+        pool-scoped drain marker; the decode pool completes EVERY
+        request from a handoff (zero orphan fallbacks), bit-identical
+        to the unified oracle (asserted in-scenario), with no lost or
+        duplicated results."""
+        sched = FaultSchedule().preemption_wave(
+            (2,), window=(4, 4), site="serving.prefill")
+        w = FleetWorld(4, str(tmp_path), schedule=sched, budget_s=420,
+                       label="disagg0")
+        res = w.launch("serving_disagg", {"n_requests": 12},
+                       expect_exit={0: REAPED, 1: REAPED, 2: 43,
+                                    3: REAPED})
+        p = res.payloads()
+        # the healthy prefill replica declared the death and took over
+        assert p[3]["rederived"] is True
+        # its own share (6) plus the dead replica's unpublished rest
+        # (3; >= allows a benign idempotent duplicate at the race)
+        assert p[3]["published"] >= 9
+        assert p[3]["wire_bytes"] > 0
+        served = []
+        for d in (0, 1):
+            assert p[d]["local_prefills"] == 0
+            assert p[d]["ingested"] == len(p[d]["served"])
+            assert p[d]["completed"] == 12
+            assert p[d]["bit_identical"] is True
+            served += p[d]["served"]
+        # no lost or duplicated requests across the decode pool
+        assert sorted(served) == sorted(f"c{i}" for i in range(12))
+        rep = FleetReport.from_scratch(str(tmp_path))
+        dies = [e for e in rep.events("fault_injected")
+                if e["info"].get("fault") == "die"]
+        assert [e["process"] for e in dies] == [2]
+        # both prefill replicas published (the victim got some out)
+        pubs = rep.events("handoff_published")
+        assert {e["process"] for e in pubs} == {2, 3}
+
+
 class TestBreathingWorld:
     def test_breathes_8_6_9_7_on_oracle(self, tmp_path):
         """ISSUE 16 acceptance: the world BREATHES 8→6→9→7 under a

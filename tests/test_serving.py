@@ -32,6 +32,7 @@ from chainermn_tpu.serving.batcher import ContinuousBatcher, Request
 from chainermn_tpu.serving.decode import DecodeEngine, engine_from_trained
 from chainermn_tpu.serving.kv_cache import (
     CacheAdmissionError,
+    KVExport,
     NULL_PAGE,
     PagedKVCache,
     PrefixMatch,
@@ -43,6 +44,15 @@ from chainermn_tpu.serving.replica import (
     DecodeReplica,
     RequestJournal,
     claim,
+)
+from chainermn_tpu.serving.disagg import (
+    DisaggDecodeReplica,
+    PrefillReplica,
+    load_handoff,
+    pack_handoff,
+    publish_handoff,
+    transfer_kv,
+    unpack_handoff,
 )
 from chainermn_tpu.resilience.fault_injection import (
     FaultSpec,
@@ -92,6 +102,21 @@ def _draft_engine(eng, seed=7, zero=False):
 def lm():
     model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
                           n_layers=LAYERS, max_len=MAXLEN)
+    params = model.init(
+        {"params": jax.random.PRNGKey(0),
+         "dropout": jax.random.PRNGKey(1)},
+        jnp.zeros((1, 16), jnp.int32),
+    )
+    return model, params
+
+
+@pytest.fixture(scope="module")
+def lm_long():
+    """A longer-context twin of ``lm`` for the int8 handoff gate: the
+    greedy-token-divergence test needs >= 64 generated tokens, which
+    MAXLEN=64 cannot hold on top of a prompt."""
+    model = TransformerLM(vocab_size=VOCAB, d_model=D, n_heads=HEADS,
+                          n_layers=LAYERS, max_len=96)
     params = model.init(
         {"params": jax.random.PRNGKey(0),
          "dropout": jax.random.PRNGKey(1)},
@@ -527,6 +552,25 @@ class TestTensorParallelDecode:
         assert census.get("all_reduce") == 2 * LAYERS  # exact, not just <=
         tr_p = eng.collective_trace("prefill", bucket=8)
         assert enforce("decode_step", tr_p).get("all_reduce") == 2 * LAYERS
+
+    def test_prefill_step_budget_pin(self, tp_setup):
+        """ISSUE 18: the prefill program gets its OWN pinned name — a
+        disaggregated prefill pool runs nothing else all day, so its
+        ceiling must not ride along as a decode_step footnote.  Same
+        exact 2-row-parallel-psums-per-layer family, zero partitioner
+        insertions on the compiled program."""
+        from chainermn_tpu.analysis import assert_attributed, enforce
+
+        comm, model, params, specs = tp_setup
+        eng = DecodeEngine(model, params, capacity=2, page_size=8,
+                           comm=comm, param_specs=specs)
+        tr = eng.collective_trace("prefill", bucket=8)
+        census = enforce("prefill_step", tr)
+        assert census.get("all_reduce") == 2 * LAYERS  # exact
+        rep = assert_attributed(tr, eng.compiled_text("prefill", bucket=8),
+                                name="prefill_step")
+        assert rep["all_reduce"]["implicit"] == []
+        assert rep["all_reduce"]["authored"] == 2 * LAYERS
 
     def test_decode_step_attributes_with_zero_insertions(self, tp_setup):
         """Shardlint acceptance: every collective in the COMPILED
@@ -1640,6 +1684,403 @@ class TestSpeculative:
 
 
 # ----------------------------------------------------------------------
+# disaggregated prefill/decode: role pools + codec-streamed KV handoff
+# ----------------------------------------------------------------------
+def _bits(x):
+    """Raw bytes of an array for 0-tolerance comparison (bf16 pages
+    compare as bits, not floats — NaN payloads and signed zeros count)."""
+    return np.ascontiguousarray(np.asarray(x)).view(np.uint8)
+
+
+class TestDisaggregation:
+    """ISSUE 18 acceptance: prefill-pool export -> codec wire ->
+    decode-pool import is BIT-IDENTICAL to local prefill for the
+    lossless codecs (cache dtype bf16, so ``none``/``bf16`` round-trip
+    exactly), atomically published through the journal, and
+    recoverable past a dead prefill replica (pool-scoped drains,
+    orphan re-prefill)."""
+
+    @pytest.mark.parametrize("codec", ["none", "bf16"])
+    def test_handoff_bit_identical_to_local_prefill(self, codec, lm):
+        """Export -> pack(codec) -> unpack -> import: the imported
+        pages equal the exporter's at 0 tolerance, and decoding from
+        them equals the unified single-engine serve token for token."""
+        model, params = lm
+        prompt = _prompts(33, 1, lo=9, hi=14)[0]
+        max_new = 6
+        pe = DecodeEngine(model, params, capacity=2, page_size=8)
+        slot = pe.admit(pe.prompt_bucket(len(prompt)))
+        logits = pe.prefill(slot, prompt)
+        kv = pe.export_kv(slot)
+        kv2, first = transfer_kv(kv, int(np.argmax(logits)), codec)
+        de = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = ContinuousBatcher(de)
+        r = Request(prompt, max_new, id="h")
+        b.ingest(r, kv2, first)
+        exp = list(pe.cache._slot_pages[slot])
+        imp = list(de.cache._slot_pages[r.slot])[:len(exp)]
+        np.testing.assert_array_equal(
+            _bits(de.cache.k_pages[:, imp]),
+            _bits(pe.cache.k_pages[:, exp]))
+        np.testing.assert_array_equal(
+            _bits(de.cache.v_pages[:, imp]),
+            _bits(pe.cache.v_pages[:, exp]))
+        b.run()
+        oracle = DecodeEngine(model, params, capacity=1,
+                              page_size=8).generate(prompt, max_new)
+        assert b.finished["h"].output == oracle
+
+    def test_int8_handoff_gated_by_greedy_agreement(self, lm_long):
+        """The int8 codec is transfer-once (no next step for an
+        error-feedback residual to ride), so its gate is MEASURED
+        greedy-token agreement over >= 64 generated tokens against the
+        unified oracle — an accuracy question, never a loss pin."""
+        model, params = lm_long
+        rng = np.random.RandomState(9)
+        prompt = rng.randint(0, VOCAB, 12).tolist()
+        max_new = 64
+        pe = DecodeEngine(model, params, capacity=1, page_size=8)
+        slot = pe.admit(pe.prompt_bucket(len(prompt)))
+        logits = pe.prefill(slot, prompt)
+        kv = pe.export_kv(slot)
+        kv2, first = transfer_kv(kv, int(np.argmax(logits)), "int8")
+        de = DecodeEngine(model, params, capacity=1, page_size=8)
+        b = ContinuousBatcher(de)
+        r = Request(prompt, max_new, id="q")
+        b.ingest(r, kv2, first)
+        b.run()
+        got = b.finished["q"].output
+        want = DecodeEngine(model, params, capacity=1,
+                            page_size=8).generate(prompt, max_new)
+        assert len(want) - len(prompt) >= 64
+        # greedy decode diverges PERMANENTLY at the first argmax flip,
+        # so the gate is the exact-prefix length, not fraction
+        # agreement.  Random-init logits are near-uniform — the
+        # adversarial case for an argmax gate — and the quantized
+        # handoff still carries >= 16 tokens exactly (28 measured).
+        div = next((i for i, (a, e) in enumerate(zip(got, want))
+                    if a != e), len(want))
+        assert div - len(prompt) >= 16, (
+            f"int8 KV handoff diverged after {div - len(prompt)} "
+            f"greedy tokens (< 16) over a {len(want) - len(prompt)}"
+            f"-token window"
+        )
+        agree = sum(int(a == e) for a, e in zip(got, want)) / len(want)
+        assert agree >= 0.5  # post-divergence floor: not corrupted
+
+    def test_import_validates_geometry(self, lm):
+        model, params = lm
+        pe = DecodeEngine(model, params, capacity=2, page_size=8)
+        prompt = _prompts(21, 1, lo=5, hi=9)[0]
+        slot = pe.admit(pe.prompt_bucket(len(prompt)))
+        pe.prefill(slot, prompt)
+        kv = pe.export_kv(slot)
+        with pytest.raises(ValueError, match="page_size"):
+            _cache(capacity=2, page_size=4).import_kv(kv, 32)
+        de = DecodeEngine(model, params, capacity=2, page_size=8)
+        with pytest.raises(ValueError, match="total_tokens"):
+            de.cache.import_kv(kv, kv.length - 1)
+        with pytest.raises(ValueError, match="dtype"):
+            de.cache.import_kv(kv._replace(dtype="float32"), 32)
+        with pytest.raises(ValueError, match="geometry"):
+            de.cache.import_kv(
+                kv._replace(k=kv.k[:, :, :, :2], v=kv.v[:, :, :, :2]),
+                32)
+
+    def test_allocator_invariants_after_import_churn(self, lm):
+        """Import admits FRESH pages per handoff; an admit/import/
+        release mix must keep the allocator's invariants and return the
+        pool to empty — imports never leak or alias the exporter."""
+        model, params = lm
+        prompt = _prompts(41, 1, lo=9, hi=13)[0]
+        pe = DecodeEngine(model, params, capacity=1, page_size=8)
+        slot = pe.admit(pe.prompt_bucket(len(prompt)))
+        logits = pe.prefill(slot, prompt)
+        kv = pe.export_kv(slot)
+        first = int(np.argmax(logits))
+        de = DecodeEngine(model, params, capacity=2, page_size=8)
+        total = len(prompt) + 6
+        live = []
+        for _ in range(8):
+            kv2, _ = transfer_kv(kv, first, "none")
+            live.append(de.cache.import_kv(kv2, total))
+            de.cache.check_invariants()
+            if len(live) == de.cache.capacity:
+                de.cache.release(live.pop(0))
+                de.cache.check_invariants()
+        for s in live:
+            de.cache.release(s)
+        de.cache.check_invariants()
+        assert de.cache.used_pages == 0
+
+    def test_prefix_reregistration_on_import(self, lm):
+        """The handoff's prefix chain re-registers against the IMPORTED
+        pages, so a later request on the decode pool aliases them —
+        prefix sharing survives the pool boundary without re-hashing
+        or re-prefilling."""
+        model, params = lm
+        head = _prompts(55, 1, lo=8, hi=9)[0]  # exactly one page
+        p1 = head + [1, 2, 3]
+        p2 = head + [4, 5]
+        pe = DecodeEngine(model, params, capacity=2, page_size=8)
+        slot = pe.admit(pe.prompt_bucket(len(p1)))
+        logits = pe.prefill(slot, p1)
+        pe.cache.register_prefix(slot, p1)
+        kv = pe.export_kv(slot)
+        assert len(kv.prefix_chain) == 1  # the one full-page depth
+        kv2, first = transfer_kv(kv, int(np.argmax(logits)), "bf16")
+        de = DecodeEngine(model, params, capacity=2, page_size=8)
+        b = ContinuousBatcher(de)
+        r1 = Request(p1, 4, id="a")
+        b.ingest(r1, kv2, first)
+        m = de.cache.lookup_prefix(p2)
+        assert m is not None and m.shared_len == 8
+        r2 = Request(p2, 4, id="b")
+        b.submit(r2)
+        b.run()
+        assert b.prefix_hits == 1
+        assert r2.shared_len == 8
+        sol = DecodeEngine(model, params, capacity=1, page_size=8)
+        assert b.finished["a"].output == sol.generate(p1, 4)
+        assert b.finished["b"].output == sol.generate(p2, 4)
+
+    def test_pack_handoff_wire_bytes_exact_and_codec_validated(self):
+        """The disclosed ``wire_bytes`` is EXACT: payload bytes plus 4
+        per int8 scale (one absmax grid per layer per tensor) — the
+        number ``attribute()`` prices and the bench fingerprints."""
+        k = np.asarray(jnp.ones((2, 3, 4, 2, 2), jnp.bfloat16))
+        kv = KVExport(k=k, v=k, length=10, page_size=4,
+                      dtype="bfloat16", prefix_chain=())
+        ph = pack_handoff(kv, 7, "bf16")
+        assert ph.meta["wire_bytes"] == 2 * k.size * 2  # bf16: 2B each
+        ph8 = pack_handoff(kv, 7, "int8")
+        # 1 byte/elem + 4B per scale, 2 layers x 2 tensors = 4 scales
+        assert ph8.meta["wire_bytes"] == 2 * k.size + 4 * 4
+        kv2, first = unpack_handoff(ph)
+        assert first == 7
+        np.testing.assert_array_equal(_bits(kv2.k), _bits(k))
+        with pytest.raises(ValueError, match="codec"):
+            pack_handoff(kv, 0, "f32")
+
+    def test_handoff_codec_path_issues_zero_collectives(self):
+        """The handoff path's own pin: encode/decode are jnp-pure casts
+        — a codec that grew a collective (say, a global absmax pmax)
+        would put KV transfer on the interconnect's critical path."""
+        from chainermn_tpu.analysis import trace_collectives
+        from chainermn_tpu.comm_wire.codecs import (
+            decode_buffer,
+            encode_buffer,
+        )
+
+        def roundtrip(x):
+            a = decode_buffer(encode_buffer(x, "bf16"))
+            c = decode_buffer(encode_buffer(x, "int8"))
+            return a.astype(jnp.float32) + c.astype(jnp.float32)
+
+        tr = trace_collectives(roundtrip, jnp.ones((4, 16), jnp.bfloat16))
+        assert tr.census() == {}
+
+    def test_kv_spans_priced_by_attribute(self, lm):
+        """``kv.export``/``kv.ship``/``kv.import`` spans carry exact
+        byte counts and ``kv_transfer_points`` prices each leg —
+        bytes, achieved B/s, duration."""
+        from chainermn_tpu import observability as obs
+        from chainermn_tpu.observability.attribute import (
+            kv_transfer_points,
+        )
+
+        model, params = lm
+        tel = obs.Telemetry(label="kv-price")
+        obs.install(tel)
+        try:
+            pe = DecodeEngine(model, params, capacity=1, page_size=8)
+            prompt = _prompts(25, 1, lo=5, hi=9)[0]
+            slot = pe.admit(pe.prompt_bucket(len(prompt)))
+            logits = pe.prefill(slot, prompt)
+            kv = pe.export_kv(slot)
+            kv2, _first = transfer_kv(kv, int(np.argmax(logits)), "bf16")
+            de = DecodeEngine(model, params, capacity=1, page_size=8)
+            de.ingest_kv(kv2, len(prompt) + 4)
+        finally:
+            obs.install(None)
+        pts = kv_transfer_points(tel.timeline)
+        by = {p[0]: p for p in pts}
+        assert set(by) == {"kv.export", "kv.ship", "kv.import"}
+        # bf16 wire over a bf16 cache: wire bytes == the raw buffer
+        assert by["kv.ship"][1] == kv.k.nbytes + kv.v.nbytes
+        for _name, nbytes, _rate, dur in pts:
+            assert nbytes > 0
+            assert dur >= 0.0
+
+    def test_disagg_serve_bit_identical_and_handoffs_cleared(
+            self, lm, tmp_path):
+        """The role-pool round trip through the journal: prefill pool
+        publishes, decode pool ingests, every output equals the
+        unified oracle at 0 tolerance — and consumed handoffs are
+        cleared once their results exist."""
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        docs = [Request(p, 4, id=f"d{i}")
+                for i, p in enumerate(_prompts(71, 4))]
+        j.submit_all(docs)
+        pr = PrefillReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, codec="bf16")
+        assert pr.serve() == 4
+        assert sorted(j.handoffs()) == sorted(r.id for r in docs)
+        assert pr.wire_bytes > 0
+        dr = DisaggDecodeReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, handoff_timeout_s=60.0)
+        dr.serve(until_complete=4, timeout_s=120.0)
+        assert dr.ingested == 4 and dr.local_prefills == 0
+        res = j.results()
+        sol = DecodeEngine(model, params, capacity=1, page_size=8)
+        for r in docs:
+            assert res[r.id]["tokens"] == sol.generate(
+                r.prompt, r.max_new_tokens), r.id
+        assert j.handoffs() == []  # hygiene: consumed == cleared
+
+    def test_orphaned_handoff_reprefilled_bit_identical(
+            self, lm, tmp_path):
+        """A handoff that never appears (its prefill replica died
+        before publishing) falls back to LOCAL prefill past
+        ``handoff_timeout_s`` — greedy replay from the prompt, so the
+        stream still completes bit-identically with no prefill pool at
+        all."""
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        docs = [Request(p, 3, id=f"o{i}")
+                for i, p in enumerate(_prompts(81, 3))]
+        j.submit_all(docs)
+        dr = DisaggDecodeReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, handoff_timeout_s=0.0)
+        dr.serve(until_complete=3, timeout_s=120.0)
+        assert dr.local_prefills == 3 and dr.ingested == 0
+        res = j.results()
+        sol = DecodeEngine(model, params, capacity=1, page_size=8)
+        for r in docs:
+            assert res[r.id]["tokens"] == sol.generate(
+                r.prompt, r.max_new_tokens), r.id
+
+    def test_dead_prefill_share_rederives_on_pool_drain(
+            self, lm, tmp_path):
+        """Marking a prefill replica draining (pool="prefill")
+        re-routes its unpublished share onto the healthy prefill
+        replicas — the same claim algebra the decode pool uses, scoped
+        to the prefill marker namespace."""
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        docs = [Request(p, 2, id=f"s{i}")
+                for i, p in enumerate(_prompts(61, 4))]
+        j.submit_all(docs)
+        p1 = PrefillReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8),
+            j, replica_index=1, n_replicas=2)
+        assert p1.serve() == 2  # its own share: seq 1 and 3
+        assert len(j.handoffs()) == 2
+        j.mark_draining(0, pool="prefill")
+        assert p1.serve() == 4  # re-derived the dead replica's share
+        assert sorted(j.handoffs()) == sorted(r.id for r in docs)
+
+    def test_pool_scoped_drain_markers_are_disjoint(self, tmp_path):
+        """Prefill-pool drains must not re-route decode-pool claims
+        (and vice versa): the marker namespaces are disjoint by
+        construction, and a pool name that could collide with the
+        default digit namespace is rejected."""
+        j = RequestJournal(str(tmp_path))
+        j.mark_draining(0, pool="prefill")
+        assert j.draining() == []
+        assert j.draining(pool="prefill") == [0]
+        j.mark_draining(1)
+        assert j.draining() == [1]
+        assert j.draining(pool="prefill") == [0]
+        j.clear_draining(0, pool="prefill")
+        assert j.draining(pool="prefill") == []
+        assert j.draining() == [1]
+        with pytest.raises(ValueError, match="alphabetic"):
+            j.mark_draining(0, pool="pre_fill")
+
+    def test_oversize_request_fails_loudly_in_prefill_pool(
+            self, lm, tmp_path):
+        """A request no decode-pool engine could ever admit fails
+        LOUDLY at the prefill pool (result written, stream not
+        wedged) — the unified replica's contract, kept across the
+        split."""
+        model, params = lm
+        j = RequestJournal(str(tmp_path))
+        j.submit_all([Request(list(range(5)), 500, id="big"),
+                      Request([1, 2, 3], 2, id="ok")])
+        pr = PrefillReplica(
+            DecodeEngine(model, params, capacity=2, page_size=8), j)
+        assert pr.serve() == 1  # "ok" published; "big" failed loudly
+        res = j.results()
+        assert res["big"]["state"] == "failed"
+        assert "max_total" in res["big"]["error"]
+        assert j.handoffs() == ["ok"]
+
+    def test_ttft_splits_into_queue_plus_prefill(self, lm):
+        """``serving.ttft`` decomposes into ``.queue`` (submit ->
+        prefill start) + ``.prefill`` (prefill start -> first token):
+        same timestamps, so the single-request algebra is exact — and
+        under a capacity-1 backlog the wait lands in the QUEUE term,
+        the split disaggregation exists to expose."""
+        model, params = lm
+        eng = DecodeEngine(model, params, capacity=1, page_size=8)
+        b = ContinuousBatcher(eng)
+        b.serve([Request(p, 3, id=f"t{i}")
+                 for i, p in enumerate(_prompts(13, 3))])
+        rep = b.latency_report()
+        for key in ("serving.ttft", "serving.ttft.queue",
+                    "serving.ttft.prefill"):
+            assert rep[key]["n"] == 3, key
+        assert rep["serving.ttft.queue"]["p99_ms"] > 0
+        b2 = ContinuousBatcher(
+            DecodeEngine(model, params, capacity=1, page_size=8))
+        b2.serve([Request([5, 4, 3], 2, id="solo")])
+        r2 = b2.latency_report()
+        assert r2["serving.ttft"]["p50_ms"] == pytest.approx(
+            r2["serving.ttft.queue"]["p50_ms"]
+            + r2["serving.ttft.prefill"]["p50_ms"], abs=1e-3)
+
+    def test_dense_oracle_and_bad_codec_rejected(self, lm, tmp_path):
+        model, params = lm
+        dense = DecodeEngine(model, params, capacity=2, layout="dense")
+        j = RequestJournal(str(tmp_path))
+        with pytest.raises(ValueError, match="dense"):
+            PrefillReplica(dense, j)
+        with pytest.raises(ValueError, match="dense"):
+            DisaggDecodeReplica(dense, j)
+        with pytest.raises(ValueError, match="paged-layout"):
+            dense.export_kv(0)
+        paged = DecodeEngine(model, params, capacity=2, page_size=8)
+        with pytest.raises(ValueError, match="codec"):
+            PrefillReplica(paged, j, codec="zstd")
+
+    def test_pending_memoized_by_directory_signature(self, tmp_path):
+        """ISSUE 18 bugfix pin: ``pending()`` rescans only when the
+        req/res name signature changes — replicas poll it every round,
+        and the old always-rescan turned the poll loop O(requests) in
+        json loads."""
+        j = RequestJournal(str(tmp_path))
+        j.submit_all([Request([1, 2], 2, id=f"m{i}") for i in range(3)])
+        base = j._pending_scans
+        assert len(j.pending()) == 3
+        j.pending()
+        j.pending()
+        assert j._pending_scans == base + 1  # repeats hit the memo
+        j.submit(Request([3], 1, id="m3"))
+        assert len(j.pending()) == 4
+        assert j._pending_scans == base + 2  # new request -> rescan
+        j.write_result(Request([1, 2], 2, id="m0"))
+        assert len(j.pending()) == 3
+        assert j._pending_scans == base + 3  # new result -> rescan
+        j.pending()
+        assert j._pending_scans == base + 3
+
+
+# ----------------------------------------------------------------------
 # mnlint: serving is NOT part of the sanctioned comm layer
 # ----------------------------------------------------------------------
 class TestServingLint:
@@ -1739,7 +2180,9 @@ class TestDecodeBenchCI:
                 "decode_prefix_shared_tokens_per_sec_per_chip",
                 "decode_prefix_cold_tokens_per_sec_per_chip",
                 "decode_spec_k4_tokens_per_sec_per_chip",
-                "decode_spec_off_tokens_per_sec_per_chip"}
+                "decode_spec_off_tokens_per_sec_per_chip",
+                "decode_disagg_on_tokens_per_sec_per_chip",
+                "decode_disagg_off_tokens_per_sec_per_chip"}
         assert want <= set(recs), sorted(recs)
         for name in want:
             r = recs[name]
@@ -1794,3 +2237,25 @@ class TestDecodeBenchCI:
         assert len(spec["verify_trace_hash"]) == 12
         assert "spec_k" not in recs[
             "decode_spec_off_tokens_per_sec_per_chip"]
+        # disaggregation A/B pair: the on rung serves the same mixed
+        # stream through role pools and fingerprints the handoff
+        # (codec, exact wire bytes, count) plus the prefill program's
+        # own pinned budget; both legs split TTFT into queue/prefill
+        don = recs["decode_disagg_on_tokens_per_sec_per_chip"]
+        doff = recs["decode_disagg_off_tokens_per_sec_per_chip"]
+        assert don["disagg"] is True
+        assert doff["disagg"] is False
+        assert don["handoff_codec"] == "bf16"
+        assert doff["handoff_codec"] is None
+        assert don["handoff_bytes"] > 0
+        assert don["n_handoffs"] == 4  # 2 * HUNT_DECODE_CAPACITY
+        for leg in (don, doff):
+            assert leg["prefill_budget"] == "prefill_step"
+            assert leg["prefill_budget_within"] is True
+            assert leg["prefill_census"] == {}  # non-TP smoke
+            for f in ("ttft_p50_ms", "ttft_p99_ms",
+                      "ttft_queue_p50_ms", "ttft_prefill_p50_ms"):
+                assert f in leg, f
+        # the ingest phase only exists on the disaggregated leg
+        assert "ingest_p50_ms" in don
+        assert "ingest_p50_ms" not in doff

@@ -45,6 +45,24 @@ the decision snapshot):
                            ``promote_quorum`` when several hosts heal
                            together)
 
+A fourth rung is the ISSUE 19 A/B: two 4-process worlds run the same
+single-rank-loss recovery (``peer_recover_leg``), one restoring from
+the peer RAM ring, one from the shared-FS checkpointer, and the
+``recover_action`` → ``recovered`` event gap prices each tier:
+
+  recover_peer_s    RAM-ring election + payload exchange + re-place
+                    (no filesystem in the loop)
+  recover_fs_s      FS election + orbax read of the same step
+  recover_speedup   recover_fs_s / recover_peer_s (higher-better — the
+                    sub-second-recovery claim, gated as a ratio)
+
+Unlike the other rungs these time RECOVERY only (the loss is modeled
+in-process; the world stays formed), so the numbers isolate the tier
+difference from the relaunch gap the other rungs already charge.
+These rows are emitted ``metric``/``value``-keyed (unit ``s``), so
+``perf_history`` regression-gates them directly — ``*_s`` is
+lower-is-better, ``*speedup`` higher-is-better.
+
 Honesty: the worlds timeshare the host (CI runs this on a single
 core), so these are END-TO-END wall numbers dominated by process
 launch and XLA compile, useful for DIRECTION (did recovery regress
@@ -188,6 +206,58 @@ def run_grow_once(scratch):
     }
 
 
+PEER_PROCS, PEER_STEPS, PEER_LOSE_AT, PEER_DIM = 4, 6, 4, 4096
+
+
+def run_peer_ab_once(scratch):
+    """One pass of the recovery-tier A/B (ISSUE 19): the same
+    single-rank loss recovered once from the peer RAM ring and once
+    from the shared FS, in separate scratches (the merged report walls
+    must not interleave), timed ``recover_action`` → ``recovered``."""
+    out = {}
+    for tier in ("peer", "fs"):
+        sub = os.path.join(scratch, tier)
+        os.makedirs(sub, exist_ok=True)
+        FleetWorld(PEER_PROCS, sub, budget_s=300,
+                   label=f"recover_{tier}").launch(
+            "peer_recover_leg",
+            {"n_steps": PEER_STEPS, "lose_at": PEER_LOSE_AT,
+             "tier": tier, "dim": PEER_DIM},
+            expect_exit={},
+        )
+        rep = FleetReport.from_scratch(sub)
+        rep.assert_order("recover_action", "recovered")
+        out[f"recover_{tier}_s"] = (rep.first("recovered")["wall"]
+                                    - rep.first("recover_action")["wall"])
+    return out
+
+
+def _recover_rows(samples):
+    """The A/B rows, ``metric``/``value``-keyed so ``perf_history``
+    loads them directly (the legacy ``name``-keyed rows predate the
+    loader and are skipped by it)."""
+    rows = []
+    extra = {"n_procs": PEER_PROCS, "lose_at": PEER_LOSE_AT,
+             "dim": PEER_DIM, "unit": "s"}
+    for metric, vals in samples.items():
+        row = {"metric": f"fleet_recovery.{metric}",
+               "value": round(min(vals), 4)}
+        row.update(extra)
+        row.update(protocol_fields(vals))
+        rows.append(row)
+        print(json.dumps(row))
+    speedups = [f / p for f, p in zip(samples["recover_fs_s"],
+                                      samples["recover_peer_s"])]
+    row = {"metric": "fleet_recovery.recover_speedup",
+           "value": round(max(speedups), 2), "unit": "x",
+           "n_procs": PEER_PROCS, "lose_at": PEER_LOSE_AT,
+           "dim": PEER_DIM}
+    row.update(protocol_fields(speedups))
+    rows.append(row)
+    print(json.dumps(row))
+    return rows
+
+
 def _rows_for(samples, extra):
     rows = []
     for metric, vals in samples.items():
@@ -210,6 +280,7 @@ def main():
                "chain_wall_s": []}
     adaptive = {"convict_to_action_s": [], "action_to_recover_s": []}
     growth = {"probation_to_promote_s": [], "promote_to_restart_s": []}
+    recover = {"recover_peer_s": [], "recover_fs_s": []}
     for _ in range(repeats):
         scratch = tempfile.mkdtemp(prefix="fleet_bench_")
         try:
@@ -232,6 +303,13 @@ def main():
             shutil.rmtree(scratch, ignore_errors=True)
         for k, v in one.items():
             growth[k].append(v)
+        scratch = tempfile.mkdtemp(prefix="fleet_bench_peer_")
+        try:
+            one = run_peer_ab_once(scratch)
+        finally:
+            shutil.rmtree(scratch, ignore_errors=True)
+        for k, v in one.items():
+            recover[k].append(v)
     rows = _rows_for(samples, {"n_procs_wave": 8, "n_procs_resume": 6})
     rows += _rows_for(adaptive, {
         "n_procs": ADAPT_PROCS,
@@ -245,6 +323,7 @@ def main():
         "probation_windows": 2,
         "promote_quorum": 1,
     })
+    rows += _recover_rows(recover)
     return rows
 
 

@@ -17,9 +17,11 @@ Run from the repo root (tier-1 runs it as a smoke via
 
 Exit status 0 = no regressions beyond spread, 1 = regressions listed.
 
-Direction is inferred per metric: ``*_ms`` / ``*sec_per*`` keys and
-units are lower-is-better; throughputs, MFU, and speedup ratios are
-higher-is-better.  Rows without a recorded spread use the default
+Direction is inferred per metric: ``*_ms`` / ``*_s`` / ``*sec_per*``
+keys and units are lower-is-better (the recovery-latency rows —
+``fleet_recovery.recover_peer_s`` and friends — ride the ``_s``
+spelling); throughputs, MFU, and speedup ratios are higher-is-better
+(``*_per_s`` wins over the ``_s`` suffix by precedence).  Rows without a recorded spread use the default
 tolerance (``DEFAULT_TOLERANCE``, 10 % — roughly the worst spread the
 committed captures have recorded on the virtual-mesh configs).  Rows
 whose value is null (failed capture) are skipped, not compared.
@@ -71,7 +73,9 @@ _BENCH_NAME_RE = re.compile(r"^BENCH_r(\d+)(_local)?\.json$")
 _HIGHER_BETTER_RE = re.compile(
     r"(_per_sec|_per_s$|per_chip|speedup|mfu|\.v$)"
 )
-_LOWER_BETTER_RE = re.compile(r"(_ms$|\.ms$|(^|_)ms(_|$)|^sec_|_time)")
+_LOWER_BETTER_RE = re.compile(
+    r"(_ms$|\.ms$|(^|_)ms(_|$)|^sec_|_time|_s$)"
+)
 
 
 def repo_root() -> str:
@@ -204,7 +208,7 @@ def lower_is_better(name: str, row: dict) -> bool:
     unit = str(row.get("unit", ""))
     if _HIGHER_BETTER_RE.search(name) or "per_sec" in unit:
         return False
-    return bool(_LOWER_BETTER_RE.search(name) or unit == "ms")
+    return bool(_LOWER_BETTER_RE.search(name) or unit in ("ms", "s"))
 
 
 @dataclass(frozen=True)

@@ -659,6 +659,236 @@ def scenario_probe_host(pid, nproc, scratch, label, args):
 
 
 # ----------------------------------------------------------------------
+def _assert_bit_identical(a, b, what):
+    """0-tolerance leaf equality, shard-aware: a ZeRO leaf is a global
+    array whose host view is per-process — compare addressable shards
+    by index instead of materializing (np.asarray on a cross-process
+    global array raises)."""
+    import jax
+    import numpy as np
+
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    assert len(la) == len(lb), (what, len(la), len(lb))
+    for x, y in zip(la, lb):
+        if hasattr(x, "is_fully_addressable") and \
+                not x.is_fully_addressable:
+            sx = sorted(x.addressable_shards, key=lambda s: str(s.index))
+            sy = sorted(y.addressable_shards, key=lambda s: str(s.index))
+            assert len(sx) == len(sy), (what, len(sx), len(sy))
+            for u, v in zip(sx, sy):
+                assert u.index == v.index, (what, u.index, v.index)
+                assert np.array_equal(
+                    np.asarray(u.data), np.asarray(v.data)
+                ), f"{what}: shard {u.index} differs"
+        else:
+            assert np.array_equal(np.asarray(x), np.asarray(y)), \
+                f"{what}: leaf differs"
+
+
+def _recover_trainer(step, opt, rows, dim, n_steps):
+    """A throwaway Trainer carrying the state templates a collective
+    restore needs (the resume_wave pattern)."""
+    import jax.numpy as jnp
+    from chainermn_tpu.iterators import SerialIterator
+    from chainermn_tpu.training.trainer import Trainer, Updater
+
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    it = SerialIterator(rows, 2, shuffle=False)
+    return Trainer(Updater(it, step, params, opt_state),
+                   stop_trigger=(n_steps, "iteration"))
+
+
+def scenario_peer_recover_leg(pid, nproc, scratch, label, args):
+    """The sub-second-recovery A/B leg (ISSUE 19): one world trains
+    the standard chain pieces, snapshotting each step into ONE tier —
+    ``tier="peer"`` replicates into the RAM ring
+    (:class:`~chainermn_tpu.resilience.peer_ckpt.PeerCheckpointStore`),
+    ``tier="fs"`` saves through the shared-FS checkpointer — then a
+    single rank loses its state at ``lose_at`` (modeled in-process:
+    params/opt_state re-zeroed, its peer RAM forgotten; the world stays
+    formed so the A/B times RECOVERY, not relaunch) and every rank runs
+    the collective restore.  The ``recover_action`` → ``recovered``
+    event gap is the tier's recovery latency; the bench prices the two
+    legs against each other.
+
+    The peer leg additionally FS-saves the election step (outside the
+    timed window) and, after recovery, restores it back through the FS
+    checkpointer to pin the acceptance contract: peer-restored state is
+    bit-identical — 0 tolerance, ZeRO blocked leaves included — to the
+    FS restore of the same step.  Both legs then train on to
+    ``n_steps`` and must land on the single-world numpy oracle."""
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+    import chainermn_tpu as cmn
+    from chainermn_tpu.fleet.chain import momentum_oracle
+    from chainermn_tpu.resilience import PeerCheckpointStore
+    from chainermn_tpu.resilience.log import emit
+
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+    n_steps = int(args["n_steps"])
+    lose_at = int(args["lose_at"])
+    tier = str(args.get("tier", "peer"))
+    victim = int(args.get("victim", 1))
+    assert victim != 0, "process 0 is the jax.distributed coordinator"
+    assert 1 < lose_at <= n_steps, (lose_at, n_steps)
+
+    comm = cmn.create_communicator("tpu")
+    got = _lockstep_allgather(comm, pid)
+    assert got == list(range(nproc)), got
+    opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
+    peer = PeerCheckpointStore(comm) if tier == "peer" else None
+    oracle = momentum_oracle(n_steps, lr=lr, mom=mom, dim=dim)
+    # the throwaway restore target doubles as the trainer-state
+    # template: manual saves must carry the full state_dict shape or
+    # the same-world orbax restore rejects the like-template mismatch
+    t = _recover_trainer(step, opt, rows, dim, n_steps)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    batch = np.stack(rows)
+    for s in range(1, lose_at):
+        params, opt_state, _m = step(params, opt_state, batch)
+        state = {"params": params, "opt_state": opt_state,
+                 "trainer": dict(t.state_dict(), iteration=s)}
+        if peer is not None:
+            peer.replicate(s, state)
+            if s == lose_at - 1:
+                # the election step also lands on the FS tier — OUTSIDE
+                # the timed window — purely for the post-recovery
+                # bit-identity cross-check below
+                ckpt.save(s, state)
+        else:
+            ckpt.save(s, state)
+
+    # -- the loss: one rank's state (and peer RAM) evaporates.  Purely
+    # local (drop the references): a victim-only re-place would run a
+    # host collective alone and shift the world's exchange stream -----
+    if pid == victim:
+        params = opt_state = None
+        if peer is not None:
+            peer.forget()
+    emit("recover_action", "fleet.recover", tier=tier, victim=victim,
+         step=lose_at - 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        if peer is not None:
+            restored = peer.restore_trainer(t)
+        else:
+            restored = ckpt.restore_trainer(t)
+    assert restored == lose_at - 1, (restored, lose_at - 1)
+    params, opt_state = t.updater.params, t.updater.opt_state
+    emit("recovered", "fleet.recover", tier=tier, step=int(restored))
+
+    bit_identical = None
+    if peer is not None:
+        # acceptance pin: the SAME step back through the FS cold tier
+        # must match the peer restore bit for bit
+        t2 = _recover_trainer(step, opt, rows, dim, n_steps)
+        with warnings.catch_warnings():
+            warnings.simplefilter("ignore")
+            fs_step = ckpt.restore_trainer(t2)
+        assert fs_step == restored, (fs_step, restored)
+        _assert_bit_identical(params, t2.updater.params, "params")
+        _assert_bit_identical(opt_state, t2.updater.opt_state,
+                              "opt_state")
+        bit_identical = True
+
+    for s in range(int(restored) + 1, n_steps + 1):
+        params, opt_state, _m = step(params, opt_state, batch)
+        if peer is not None:
+            peer.replicate(s, {
+                "params": params, "opt_state": opt_state,
+                "trainer": {"iteration": s, "iterator": None},
+            })
+    w = np.asarray(params["w"])
+    np.testing.assert_allclose(w, oracle[n_steps - 1], rtol=1e-5)
+    return {
+        "tier": tier,
+        "restored_step": int(restored),
+        "bit_identical": bit_identical,
+        "oracle_match": True,
+        "w": float(w[0]),
+    }
+
+
+def scenario_peer_ring_broken(pid, nproc, scratch, label, args):
+    """Correlated loss (ISSUE 19 satellite): a rank AND its ring
+    replica holder lose their RAM in one wave — the slice-loss shape —
+    so no peer snapshot has complete owner coverage.  The collective
+    peer restore must detect the broken ring (``peer_ring_broken``
+    logged), return empty-handed, and the survivors degrade to the FS
+    COLD tier (the per-step checkpoints the same loop committed),
+    landing on the single-world numpy oracle."""
+    import warnings
+
+    import numpy as np
+    import jax.numpy as jnp
+    import chainermn_tpu as cmn
+    from chainermn_tpu.fleet.chain import momentum_oracle
+    from chainermn_tpu.resilience import PeerCheckpointStore
+    from chainermn_tpu.resilience.log import emit
+
+    lr = float(args.get("lr", 0.1))
+    mom = float(args.get("mom", 0.9))
+    dim = int(args.get("dim", 4))
+    n_steps = int(args["n_steps"])
+    lose_at = int(args["lose_at"])
+    victim = int(args.get("victim", 1))
+    assert victim != 0, "process 0 is the jax.distributed coordinator"
+
+    comm = cmn.create_communicator("tpu")
+    got = _lockstep_allgather(comm, pid)
+    assert got == list(range(nproc)), got
+    opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
+    peer = PeerCheckpointStore(comm)
+    holder = peer.holder if pid == victim else (victim + 1) % nproc
+    oracle = momentum_oracle(n_steps, lr=lr, mom=mom, dim=dim)
+    t = _recover_trainer(step, opt, rows, dim, n_steps)
+    p0 = {"w": jnp.zeros((dim,))}
+    params, opt_state = step.place(p0, opt.init(p0))
+    batch = np.stack(rows)
+    for s in range(1, lose_at):
+        params, opt_state, _m = step(params, opt_state, batch)
+        state = {"params": params, "opt_state": opt_state,
+                 "trainer": dict(t.state_dict(), iteration=s)}
+        peer.replicate(s, state)
+        ckpt.save(s, state)  # the cold tier the fallback lands on
+
+    # correlated loss: the victim AND its replica holder forget — the
+    # victim's envelope now survives NOWHERE in the ring.  Purely
+    # local, as in the A/B leg (no victim-only collectives)
+    if pid in (victim, holder):
+        params = opt_state = None
+        peer.forget()
+    emit("recover_action", "fleet.recover", tier="peer_then_fs",
+         victim=victim, holder=holder, step=lose_at - 1)
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        restored = peer.restore_trainer(t)
+        assert restored is None, "a broken ring must not elect"
+        restored = ckpt.restore_trainer(t)  # the FS cold fallback
+    assert restored == lose_at - 1, (restored, lose_at - 1)
+    params, opt_state = t.updater.params, t.updater.opt_state
+    emit("recovered", "fleet.recover", tier="fs_cold",
+         step=int(restored))
+    for s in range(int(restored) + 1, n_steps + 1):
+        params, opt_state, _m = step(params, opt_state, batch)
+    w = np.asarray(params["w"])
+    np.testing.assert_allclose(w, oracle[n_steps - 1], rtol=1e-5)
+    return {
+        "restored_step": int(restored),
+        "fell_back": True,
+        "oracle_match": True,
+        "w": float(w[0]),
+    }
+
+
+# ----------------------------------------------------------------------
 def _serving_fixture(n_requests: int):
     """Deterministic tiny LM (same seed on every process → identical
     params → greedy decode of any request is bit-identical no matter

@@ -37,6 +37,7 @@ from .fault_injection import (  # noqa: F401
     install,
 )
 from .log import ResilienceEvent, ResilienceLog, attach, detach, emit  # noqa: F401
+from .peer_ckpt import PeerCheckpointStore  # noqa: F401  (RAM recovery tier)
 from .retry import (  # noqa: F401
     DEFAULT_POLICY,
     RetryPolicy,

@@ -655,13 +655,21 @@ class AdaptiveExecution:
 
     def __init__(self, policy: Optional[AdaptPolicy] = None, *,
                  comm=None, report=None, watcher=None,
-                 hosts: Optional[Sequence[str]] = None):
+                 hosts: Optional[Sequence[str]] = None,
+                 peer_store=None):
         self.policy = policy if policy is not None else AdaptPolicy()
         self._comm = comm
         self._report = report
         self._watcher = watcher
         self._hosts = None if hosts is None else [str(h) for h in hosts]
         self._seen_report: Optional[int] = None
+        # sub-second recovery tier: with a PeerCheckpointStore attached,
+        # the demote decision snapshots to peer RAM synchronously at the
+        # decision step and the FS write is demoted to a background
+        # thread (joined in finalize) — the restart's hot tier is RAM,
+        # the FS stays the cold fallback
+        self._peer_store = peer_store
+        self._bg_save = None
 
     # -- extension protocol ---------------------------------------------
     def initialize(self, trainer) -> None:
@@ -846,7 +854,31 @@ class AdaptiveExecution:
         p = int(action["process"])
         ckpt = trainer._find_checkpointer()
         step = None
-        if ckpt is not None:
+        ram = False
+        if self._peer_store is not None:
+            # RAM first: replicate the decision step into the peer ring
+            # synchronously (all ranks reach this together — the
+            # decision was agreed, so the ring exchange is collective-
+            # safe), then demote the FS write to a background thread.
+            # The restart prefers the peer tier; the FS snapshot still
+            # commits (finalize joins the thread) as the cold fallback
+            # for a correlated loss that breaks the ring.
+            self._peer_store.replicate(int(trainer.iteration), {
+                "params": trainer.updater.params,
+                "opt_state": trainer.updater.opt_state,
+                "trainer": trainer.state_dict(),
+            })
+            step = int(trainer.iteration)
+            ram = True
+            if ckpt is not None:
+                import threading
+
+                self._bg_save = threading.Thread(
+                    target=ckpt, args=(trainer,),
+                    name="peer_ckpt_fs_cold_save",
+                )
+                self._bg_save.start()
+        elif ckpt is not None:
             # commit the CURRENT iteration collectively (all ranks reach
             # this point together — the decision was agreed), so the
             # N-1 resume loses no step; a same-step re-save is an
@@ -856,6 +888,7 @@ class AdaptiveExecution:
         emit(
             "adapt_action", "adaptive.demote",
             action="demote", process=p, checkpoint_step=step,
+            ram_snapshot=ram, fs_async=ram and ckpt is not None,
             iteration=int(trainer.iteration),
         )
         raise DemotionRequiredError(
@@ -867,6 +900,17 @@ class AdaptiveExecution:
                if step is not None else "from the newest common step"),
             site="adaptive.demote", peer=p,
         )
+
+    def finalize(self, trainer=None) -> None:
+        """Join the demoted-to-background FS save, if one is in flight:
+        the cold tier must commit before process exit — peer RAM dies
+        with the processes, so a relaunch that finds no FS snapshot
+        would have nothing to restore.  Runs on error exits too (the
+        trainer's finalize pass), i.e. right after the
+        DemotionRequiredError this extension raised."""
+        t, self._bg_save = self._bg_save, None
+        if t is not None:
+            t.join()
 
     def _promote(self, trainer, action: dict) -> None:
         hosts = [str(h) for h in action["hosts"]]

@@ -1,0 +1,631 @@
+"""Peer-replicated in-memory checkpoints: the sub-second recovery tier.
+
+The shared-FS checkpointer (``extensions.checkpoint``) prices a demote →
+N−1 restart at seconds: a collective orbax write, a world re-formation,
+and a cold read back through the filesystem.  Production MTTR wants the
+common case — ONE rank lost its state — to recover without the FS in
+the loop at all.  This module keeps the newest snapshot sharded across
+peer host RAM: each rank holds its own serialized state plus its ring
+predecessor's replica (rank ``r`` replicates to ``(r+1) % n`` and holds
+``(r-1) % n``), exchanged over the existing obj store on the same
+lockstep retry as ``plan_agreement`` / ``newest_common_step`` and
+digest-verified like the snapshot inventory (sha256 over the exact
+bytes on the wire).  A single-rank loss then restores from the
+surviving replica — RAM to RAM — and the shared-FS tier becomes the
+COLD fallback for correlated loss: when a rank and its replica holder
+die in one wave (the chaos tier's slice-loss shape), the ring is
+broken, survivors emit ``peer_ring_broken``, and step election falls
+back to the filesystem.
+
+Election mirrors ``newest_common_step``: ranks exchange inventories of
+held ``(step, world-signature, owner)`` envelopes and elect the newest
+step whose ring coverage is COMPLETE — every owner of that signature's
+ring is held by some live rank.  A stale replica from a pre-resize
+world can therefore never win election on its own (its ring is wider
+than the survivors can cover), and :meth:`PeerCheckpointStore.rebind`
+drops such orphans explicitly after any N→M re-formation.  A complete
+snapshot whose world size differs from the current communicator's
+routes through the SAME elastic resharder as the FS tier
+(``resilience.elastic.reshard_state``), so a peer-restored state is
+bit-identical to the FS restore of the same step — ZeRO blocked leaves
+included — by construction.
+
+Serialization is per-rank and addressability-aware: a fully-addressable
+leaf ships as one host array ("full" — identical on every rank, like
+orbax's chief-written aggregate), a cross-process global array ships as
+this rank's addressable shards with their global indices ("shards").
+Same-world restore is LOCAL: each rank rebuilds its addressable state
+from its OWN envelope — already in RAM unless this rank's memory died,
+in which case ONE point-to-point pull from the ring holder heals it.
+Survivors move zero payload bytes, which is what makes the tier
+sub-second: recovery latency is one inventory exchange plus host→device
+placement, independent of world size.  A world-RESIZE restore falls
+back to full reassembly — every owner's envelope gathered, the global
+host state rebuilt and routed through the elastic resharder — so ZeRO
+state lands sharded exactly as a fresh build would place it.
+
+Single-controller mode: one process hosts every rank, so a ring of
+store instances (explicit ``rank=``/``world=``) shares the process
+heap as its "peer RAM" — replicate ingests the envelope directly into
+the holder instance (digest-verified on ingest, same check as the
+wire), and inventories are read ring-wide from the registry.  The
+multi-process tier exchanges everything over the obj store wire.
+
+Replicate and restore run under ``peer_ckpt.replicate`` /
+``peer_ckpt.restore`` spans carrying the exact payload bytes moved, so
+``analysis.attribute`` prices the recovery wire like any other
+transfer.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import pickle
+from typing import Any, Dict, List, Optional, Tuple
+
+import jax
+import numpy as np
+
+from ..observability import timeline as _obs
+from . import elastic as _elastic
+from .errors import PayloadCorruptionError, WorldResizeRequiredError
+from .log import emit
+from .retry import lockstep_allgather
+
+# dedicated obj-store tag for ring payloads: the mailbox/KV keyspace is
+# (peer, tag)-addressed, so replica traffic can never interleave with
+# user sends or the agreement exchanges
+PEER_TAG = 7919
+
+REPLICATE_SITE = "peer_ckpt.replicate"
+RESTORE_SITE = "peer_ckpt.restore"
+INVENTORY_SITE = "peer_ckpt.inventory"
+
+
+def _sig_key(sig: dict) -> Tuple[int, int, int]:
+    return (int(sig["world_size"]), int(sig["process_count"]),
+            int(sig["ring"]))
+
+
+def _serialize_state(state: Any) -> bytes:
+    """This rank's view of ``state`` as one pickled blob: full host
+    arrays for fully-addressable leaves, (global index, shard) pairs
+    for cross-process global arrays."""
+    leaves, treedef = jax.tree_util.tree_flatten(state)
+    entries: List[tuple] = []
+    for leaf in leaves:
+        if hasattr(leaf, "is_fully_addressable") and \
+                not leaf.is_fully_addressable:
+            shards = [(s.index, np.asarray(s.data))
+                      for s in leaf.addressable_shards]
+            entries.append(("shards", {
+                "shape": tuple(int(d) for d in leaf.shape),
+                "dtype": np.dtype(leaf.dtype),
+                "shards": shards,
+            }))
+        else:
+            entries.append(("full", np.asarray(leaf)))
+    return pickle.dumps({"treedef": treedef, "entries": entries},
+                        protocol=pickle.HIGHEST_PROTOCOL)
+
+
+def _assemble(payloads: Dict[int, dict]) -> Any:
+    """Reassemble the GLOBAL host state from every owner's decoded
+    payload.  "full" leaves are rank-replicated by construction (the
+    lowest owner's copy wins, mirroring the chief-written orbax
+    aggregate); "shards" leaves fill a zero canvas by each owner's
+    global indices."""
+    owners = sorted(payloads)
+    base = payloads[owners[0]]
+    leaves: List[Any] = []
+    for i, (kind, val) in enumerate(base["entries"]):
+        if kind == "full":
+            leaves.append(val)
+            continue
+        out = np.zeros(val["shape"], val["dtype"])
+        for o in owners:
+            _, v = payloads[o]["entries"][i]
+            for idx, arr in v["shards"]:
+                out[idx] = arr
+        leaves.append(out)
+    return jax.tree_util.tree_unflatten(base["treedef"], leaves)
+
+
+def _rebuild_local(payload: dict, like: Any) -> Any:
+    """Rebuild this rank's state from its OWN decoded payload — no
+    cross-rank data.  "full" leaves are host arrays (rank-replicated by
+    construction); "shards" leaves become global arrays directly from
+    the local shards, laid out per the matching ``like`` leaf's
+    sharding — the template the restoring trainer already holds."""
+    like_leaves = jax.tree_util.tree_flatten(like)[0]
+    entries = payload["entries"]
+    if len(entries) != len(like_leaves):
+        raise RuntimeError(
+            f"peer snapshot has {len(entries)} leaves but the restore "
+            f"template has {len(like_leaves)}; same-world local rebuild "
+            "needs a structurally matching like="
+        )
+    leaves: List[Any] = []
+    for (kind, val), ref in zip(entries, like_leaves):
+        if kind == "full":
+            leaves.append(val)
+            continue
+        sh = getattr(ref, "sharding", None)
+        if sh is None:
+            raise RuntimeError(
+                "peer snapshot holds a sharded leaf but the matching "
+                "template leaf carries no sharding to rebuild against"
+            )
+        shape = tuple(int(d) for d in val["shape"])
+        by_index = {str(idx): arr for idx, arr in val["shards"]}
+        arrs = [
+            jax.device_put(by_index[str(idx)], d)
+            for d, idx in sh.addressable_devices_indices_map(shape).items()
+        ]
+        leaves.append(
+            jax.make_array_from_single_device_arrays(shape, sh, arrs)
+        )
+    return jax.tree_util.tree_unflatten(payload["treedef"], leaves)
+
+
+class PeerCheckpointStore:
+    """The in-memory recovery tier: ring-replicated snapshots in peer
+    host RAM.
+
+    ``comm``: the communicator whose obj store carries the ring.  Under
+    multi-process the ring spans the process indices; under a single
+    controller pass explicit ``rank=`` / ``world=`` to build an N-store
+    ring sharing one comm (tests), or leave the defaults for a
+    degenerate 1-ring (the store then holds only its own snapshots —
+    still useful as an in-memory election tier).  ``keep`` bounds held
+    steps, newest first (RAM is the budget here, not disk).
+    """
+
+    def __init__(self, comm, *, rank: Optional[int] = None,
+                 world: Optional[int] = None, keep: int = 2):
+        if keep < 1:
+            raise ValueError(f"keep must be >= 1, got {keep}")
+        self._keep = int(keep)
+        # (step, sig_key, owner) -> envelope
+        self._held: Dict[Tuple[int, tuple, int], dict] = {}
+        # (old_world, new_world) when the last restore routed through
+        # the elastic resharder; the elected snapshot's signature
+        self.last_resize: Optional[tuple] = None
+        self.last_sig: Optional[dict] = None
+        self._bind(comm, rank=rank, world=world)
+
+    # -- ring topology ---------------------------------------------------
+    def _bind(self, comm, *, rank: Optional[int] = None,
+              world: Optional[int] = None) -> None:
+        self._comm = comm
+        self._multiproc = int(comm.process_count) > 1
+        if self._multiproc:
+            self._rank = int(comm.process_index)
+            self._world = int(comm.process_count)
+        else:
+            self._rank = 0 if rank is None else int(rank)
+            self._world = 1 if world is None else int(world)
+        if not 0 <= self._rank < self._world:
+            raise ValueError(
+                f"rank {self._rank} outside ring of {self._world}"
+            )
+        # single-controller N-ring: the instances registered on the
+        # same comm ARE the peer RAM (one process hosts every rank)
+        self._ring_peers: Optional[Dict[int, "PeerCheckpointStore"]] = None
+        if not self._multiproc and self._world > 1:
+            ring = getattr(comm, "_peer_ckpt_ring", None)
+            if ring is None:
+                ring = {}
+                try:
+                    comm._peer_ckpt_ring = ring
+                except AttributeError:
+                    pass
+            ring[self._rank] = self
+            self._ring_peers = ring
+
+    @property
+    def rank(self) -> int:
+        return self._rank
+
+    @property
+    def ring(self) -> int:
+        return self._world
+
+    @property
+    def holder(self) -> int:
+        """The ring successor holding THIS rank's replica."""
+        return (self._rank + 1) % self._world
+
+    @property
+    def donor(self) -> int:
+        """The ring predecessor whose replica THIS rank holds."""
+        return (self._rank - 1) % self._world
+
+    def world_signature(self) -> dict:
+        d = self._comm.world_descriptor()
+        return {"world_size": int(d["world_size"]),
+                "process_count": int(d["process_count"]),
+                "ring": int(self._world)}
+
+    def held(self) -> List[tuple]:
+        """Sorted (step, sig_key, owner) keys currently in RAM."""
+        return sorted(self._held)
+
+    def forget(self) -> None:
+        """Model this rank's RAM loss: drop every held snapshot and
+        replica (the scenario/test hook a fault schedule drives)."""
+        self._held.clear()
+
+    # -- envelopes -------------------------------------------------------
+    def _ingest(self, env: dict, verify: bool = True) -> None:
+        if verify and hashlib.sha256(
+            env["blob"]
+        ).hexdigest() != env["digest"]:
+            raise PayloadCorruptionError(
+                f"peer replica from rank {env.get('owner')} step "
+                f"{env.get('step')} failed sha256 verification",
+                site=REPLICATE_SITE, peer=env.get("owner"),
+            )
+        key = (int(env["step"]), _sig_key(env["sig"]), int(env["owner"]))
+        self._held[key] = env
+
+    def _gc(self) -> None:
+        steps = sorted({k[0] for k in self._held})
+        for s in steps[:-self._keep]:
+            for k in [k for k in self._held if k[0] == s]:
+                del self._held[k]
+
+    # -- replicate -------------------------------------------------------
+    def replicate(self, step: int, state: Any) -> dict:
+        """Snapshot ``state`` into the RAM tier: serialize this rank's
+        view, exchange digest manifests (lockstep-retried — a torn
+        manifest fails on all ranks together), ship the payload to the
+        ring successor, and verify + hold the predecessor's replica.
+        Collective: every ring member must call it at the same step."""
+        step = int(step)
+        sig = self.world_signature()
+        blob = _serialize_state(state)
+        digest = hashlib.sha256(blob).hexdigest()
+        env = {"owner": self._rank, "step": step, "sig": sig,
+               "digest": digest, "nbytes": len(blob), "blob": blob}
+        manifest = {"rank": self._rank, "step": step, "digest": digest,
+                    "nbytes": len(blob), "sig": sig}
+        wire = 0
+        with _obs.span(REPLICATE_SITE, step=step) as sp:
+            peers = None
+            if self._multiproc:
+                peers = lockstep_allgather(
+                    self._comm, manifest, site=REPLICATE_SITE
+                )
+                steps = sorted({int(m["step"]) for m in peers})
+                if steps != [step]:
+                    raise RuntimeError(
+                        f"peer replicate desynchronized: this rank at "
+                        f"step {step}, ring saw steps {steps}"
+                    )
+            self._ingest(env, verify=False)
+            if self._multiproc:
+                self._comm.send_obj(env, dest=self.holder, tag=PEER_TAG)
+                wire += len(blob)
+                got = self._comm.recv_obj(source=self.donor, tag=PEER_TAG)
+                want = peers[self.donor]["digest"]
+                if got.get("digest") != want or hashlib.sha256(
+                    got["blob"]
+                ).hexdigest() != want:
+                    raise PayloadCorruptionError(
+                        f"replica from ring donor {self.donor} at "
+                        f"step {step} does not match its manifest "
+                        "digest",
+                        site=REPLICATE_SITE, peer=self.donor,
+                    )
+                wire += int(got["nbytes"])
+                self._ingest(got, verify=False)
+            elif self._world > 1:
+                # single-controller ring: the holder instance IS the
+                # peer RAM — hand it the envelope, digest-verified on
+                # ingest exactly like a wire arrival
+                peer = (self._ring_peers or {}).get(self.holder)
+                if peer is None:
+                    raise RuntimeError(
+                        "single-controller ring incomplete: no store "
+                        f"registered for holder rank {self.holder}"
+                    )
+                peer._ingest(dict(env))
+                wire += len(blob)
+            sp.set(bytes=wire if wire else len(blob))
+        self._gc()
+        emit(
+            "peer_replicate", REPLICATE_SITE,
+            step=step, bytes=wire if wire else len(blob),
+            holder=self.holder, donor=self.donor, ring=self._world,
+        )
+        return {"step": step, "digest": digest, "nbytes": len(blob)}
+
+    # -- election --------------------------------------------------------
+    def _all_inventories(self) -> Dict[int, list]:
+        if self._multiproc:
+            invs = lockstep_allgather(
+                self._comm, self._inventory(), site=INVENTORY_SITE
+            )
+            return {r: inv for r, inv in enumerate(invs)}
+        stores = self._ring_peers or {self._rank: self}
+        return {r: store._inventory()
+                for r, store in sorted(stores.items())}
+
+    def _inventory(self) -> list:
+        return [
+            {"step": k[0], "sig": self._held[k]["sig"], "owner": k[2],
+             "digest": self._held[k]["digest"],
+             "nbytes": self._held[k]["nbytes"]}
+            for k in sorted(self._held)
+        ]
+
+    @staticmethod
+    def _electable(invs: Dict[int, list]):
+        """Coverage-complete (step, sig_key) groups: every owner of the
+        signature's ring is held by SOME live rank — the in-memory
+        analogue of "a step counts only if every process has it"."""
+        cover: Dict[tuple, set] = {}
+        sigs: Dict[tuple, dict] = {}
+        for inv in invs.values():
+            for e in inv:
+                key = (int(e["step"]), _sig_key(e["sig"]))
+                cover.setdefault(key, set()).add(int(e["owner"]))
+                sigs[key] = e["sig"]
+        electable = [
+            key for key, owners in cover.items()
+            if owners >= set(range(key[1][2]))
+        ]
+        return electable, cover, sigs
+
+    def newest_common_step(self) -> Optional[int]:
+        """The newest step with complete ring coverage (the RAM tier's
+        vote in step election), or ``None`` — same contract as the FS
+        checkpointer's ``newest_common_step``."""
+        with _obs.span("peer_ckpt.agreement"):
+            electable, _, _ = self._electable(self._all_inventories())
+            return max((s for s, _ in electable), default=None)
+
+    # -- restore ---------------------------------------------------------
+    def restore(self, like: Optional[Any] = None):
+        """Elect and rebuild the newest coverage-complete snapshot;
+        returns ``(step, state)`` or ``(None, None)``.
+
+        Same-world with a ``like`` template: LOCAL rebuild — each rank
+        reconstitutes its addressable state from its own envelope, and
+        only a rank whose RAM died pulls its replica point-to-point
+        from the ring holder (survivors move zero payload bytes).
+        Resize or template-less restores gather every owner's envelope
+        and reassemble the global host state.
+
+        A broken ring — replicas held, but no step covering every owner
+        (the correlated-loss shape: a rank AND its replica holder died
+        in one wave) — emits ``peer_ring_broken`` naming the uncovered
+        owners and returns ``(None, None)``, telling the caller to fall
+        back to the FS cold tier.  A complete snapshot whose world size
+        differs from this communicator's routes through
+        ``elastic.reshard_state`` (template-driven by ``like``, exactly
+        like the FS path — no ``like`` raises
+        ``WorldResizeRequiredError``)."""
+        self.last_resize = None
+        self.last_sig = None
+        with _obs.span(RESTORE_SITE) as sp:
+            invs = self._all_inventories()
+            electable, cover, sigs = self._electable(invs)
+            if not electable:
+                if cover:
+                    step, sk = max(cover)
+                    missing = sorted(set(range(sk[2])) - cover[(step, sk)])
+                    emit(
+                        "peer_ring_broken", RESTORE_SITE,
+                        step=int(step), ring=int(sk[2]),
+                        missing=",".join(str(m) for m in missing),
+                    )
+                return None, None
+            step, sk = max(electable)
+            sig = sigs[(step, sk)]
+            # provider per owner: the smallest-ranked holder — the same
+            # deterministic choice on every rank, so the payload
+            # exchange needs no negotiation round
+            holders: Dict[int, List[int]] = {}
+            for r, inv in invs.items():
+                for e in inv:
+                    if (int(e["step"]), _sig_key(e["sig"])) == (step, sk):
+                        holders.setdefault(int(e["owner"]), []).append(r)
+            providers = {o: min(rs) for o, rs in holders.items()}
+            same_world = (
+                _sig_key(sig) == _sig_key(self.world_signature())
+                and int(sig["world_size"]) == int(self._comm.size)
+            )
+            if self._multiproc and same_world and like is not None:
+                # same-world fast path: owner o IS rank o, so each rank
+                # rebuilds its addressable state from its OWN envelope
+                # — already in local RAM unless this rank's memory died.
+                # Only a rank missing its own copy pulls it point-to-
+                # point from the ring holder; survivors move ZERO
+                # payload bytes, so recovery latency is the inventory
+                # exchange plus placement, independent of state size.
+                need = {
+                    o: providers[o] for o in range(self._world)
+                    if o not in holders.get(o, ())
+                }
+                for o, p in sorted(need.items()):
+                    if p == self._rank:
+                        self._comm.send_obj(
+                            self._held[(step, sk, o)], dest=o,
+                            tag=PEER_TAG + 1 + o,
+                        )
+                nbytes = 0
+                if self._rank in need:
+                    env = self._comm.recv_obj(
+                        source=need[self._rank],
+                        tag=PEER_TAG + 1 + self._rank,
+                    )
+                    nbytes = int(env["nbytes"])
+                    # verified + re-held: the healed rank owns its own
+                    # copy again for the next replicate/election round
+                    self._ingest(env)
+                else:
+                    env = self._held[(step, sk, self._rank)]
+                if hashlib.sha256(
+                    env["blob"]
+                ).hexdigest() != env["digest"]:
+                    raise PayloadCorruptionError(
+                        f"peer replica for owner {self._rank} at step "
+                        f"{step} failed sha256 verification at restore",
+                        site=RESTORE_SITE, peer=self._rank,
+                    )
+                state = _rebuild_local(pickle.loads(env["blob"]), like)
+                sp.set(bytes=nbytes)
+            else:
+                if self._multiproc:
+                    # resize (or template-less) restore: full global
+                    # reassembly.  Payloads move point-to-point over
+                    # the KV store, one tag per owner: the addressed
+                    # transport never compiles an XLA program, so
+                    # latency is wire + pickle — not a per-payload-
+                    # shape compile (the reason this is not a payload
+                    # allgather).  Providers and receivers derive the
+                    # same deterministic plan, so the seq-counted
+                    # streams stay aligned with no negotiation.
+                    mine = {
+                        o: self._held[(step, sk, o)]
+                        for o, p in providers.items() if p == self._rank
+                    }
+                    for o, env in sorted(mine.items()):
+                        for r in range(self._world):
+                            if r != self._rank:
+                                self._comm.send_obj(
+                                    env, dest=r, tag=PEER_TAG + 1 + o
+                                )
+                    envs: Dict[int, dict] = dict(mine)
+                    for o, p in sorted(providers.items()):
+                        if p != self._rank:
+                            envs[o] = self._comm.recv_obj(
+                                source=p, tag=PEER_TAG + 1 + o
+                            )
+                else:
+                    stores = self._ring_peers or {self._rank: self}
+                    envs = {
+                        o: stores[p]._held[(step, sk, o)]
+                        for o, p in providers.items()
+                    }
+                nbytes = 0
+                payloads: Dict[int, dict] = {}
+                for o, env in sorted(envs.items()):
+                    if hashlib.sha256(
+                        env["blob"]
+                    ).hexdigest() != env["digest"]:
+                        raise PayloadCorruptionError(
+                            f"peer replica for owner {o} at step {step} "
+                            "failed sha256 verification at restore",
+                            site=RESTORE_SITE, peer=o,
+                        )
+                    nbytes += int(env["nbytes"])
+                    payloads[int(o)] = pickle.loads(env["blob"])
+                state = _assemble(payloads)
+                sp.set(bytes=nbytes)
+                old_world = int(sig["world_size"])
+                new_world = int(self._comm.size)
+                if old_world != new_world:
+                    if like is None:
+                        raise WorldResizeRequiredError(
+                            f"peer snapshot step {step} was replicated "
+                            f"at world size {old_world} but this world "
+                            f"spans {new_world} chips; resharding needs "
+                            "a template — call restore(like=...)",
+                            site=RESTORE_SITE,
+                        )
+                    state = _elastic.reshard_state(
+                        state, like, old_world, new_world,
+                        label=f"peer_step_{step}",
+                    )
+                    self.last_resize = (old_world, new_world)
+                    emit(
+                        "elastic_resume", RESTORE_SITE,
+                        step=int(step), old_world=old_world,
+                        new_world=new_world, tier="peer",
+                    )
+            self.last_sig = dict(sig)
+        emit(
+            "peer_restore", RESTORE_SITE,
+            step=int(step), bytes=int(nbytes), ring=int(sk[2]),
+            resized=bool(self.last_resize),
+        )
+        return int(step), state
+
+    def restore_trainer(self, trainer) -> Optional[int]:
+        """Mirror of the FS checkpointer's ``restore_trainer``: restore
+        through :meth:`restore` with the trainer's own state as the
+        reshard template, remap the iterator cursor on a process-count
+        change, re-place the host leaves through the compiled step's
+        placement rule, and install.  Returns the step or ``None``."""
+        step, state = self.restore(like={
+            "params": trainer.updater.params,
+            "opt_state": trainer.updater.opt_state,
+            "trainer": trainer.state_dict(),
+        })
+        if step is None:
+            return None
+        old_pc = int((self.last_sig or {}).get("process_count") or 1)
+        new_pc = int(self._comm.process_count)
+        tr = state.get("trainer")
+        if old_pc != new_pc and isinstance(tr, dict) and isinstance(
+            tr.get("iterator"), dict
+        ):
+            tr["iterator"] = _elastic.reshard_iterator_state(
+                tr["iterator"], old_pc, new_pc
+            )
+        # re-place unconditionally: reassembled/resharded leaves are
+        # host arrays needing the full scatter, and fast-path leaves
+        # already laid out per the step's rule make device_put a no-op
+        place = getattr(trainer.updater.step_fn, "place", None)
+        if place is not None:
+            state["params"], state["opt_state"] = place(
+                state["params"], state["opt_state"]
+            )
+        trainer.updater.params = state["params"]
+        trainer.updater.opt_state = state["opt_state"]
+        trainer.load_state_dict(state["trainer"])
+        return step
+
+    # -- world re-formation ----------------------------------------------
+    def rebind(self, comm, *, rank: Optional[int] = None,
+               world: Optional[int] = None) -> None:
+        """Re-derive the ring after a world re-formation (collective:
+        every surviving member calls it on the NEW communicator) and
+        drop orphaned replicas — entries whose (step, signature) group
+        can no longer reach complete coverage among the survivors.  A
+        coverage-complete old-world group survives for the reshard
+        route; an orphan is dead weight that must never shadow the
+        election."""
+        if self._ring_peers is not None:
+            self._ring_peers.pop(self._rank, None)
+        self._bind(comm, rank=rank, world=world)
+        if self._ring_peers is not None:
+            # single-controller re-formation registers survivors one by
+            # one: judging coverage against a half-built registry would
+            # wrongly orphan a complete old-world group, so the stale
+            # sweep waits for the last survivor and then runs ring-wide
+            if len(self._ring_peers) < self._world:
+                return
+            for r in sorted(self._ring_peers):
+                self._ring_peers[r].drop_stale()
+        else:
+            self.drop_stale()
+
+    def drop_stale(self) -> int:
+        """Drop held entries in coverage-incomplete groups (collective:
+        rides the inventory exchange).  Returns the count dropped and
+        emits ``peer_stale_dropped`` when nonzero."""
+        electable, _, _ = self._electable(self._all_inventories())
+        keep = set(electable)
+        stale = [k for k in self._held if (k[0], k[1]) not in keep]
+        for k in stale:
+            del self._held[k]
+        if stale:
+            emit(
+                "peer_stale_dropped", "peer_ckpt.rebind",
+                dropped=len(stale), ring=int(self._world),
+            )
+        return len(stale)

@@ -61,6 +61,16 @@ Design points:
   N-shard cache onto M shards bit-identically to a fresh split of the
   concatenated global cache — the serving analogue of
   ``resilience.elastic.reshard_state``'s ZeRO block rule.
+* **Delta snapshots.**  Every mutation path marks the pages it touches
+  dirty (``admit``'s fresh+CoW-reserve pages, ``cow_for_write``'s copy
+  target, ``advance``'s written range, ``import_kv``'s copied pages);
+  :meth:`delta_state_dict` ships ONLY the pages dirtied since the last
+  marker — plus the full host accounting (tables, refcounts, CoW
+  reserves), which is tiny — under a sha256 digest, and
+  :meth:`apply_delta` installs it onto a replica at the same base
+  marker, bit-identical to a full snapshot.  This is what rides the
+  peer-RAM recovery tier (``resilience.peer_ckpt``): a serving replica
+  re-replicates per drain window at delta cost, not pool cost.
 """
 
 from __future__ import annotations
@@ -195,6 +205,12 @@ class PagedKVCache:
         # earmarked so a running request never hits mid-stream
         # out-of-pages (the allocator's no-midstream-failure contract)
         self._cow_reserve: Dict[int, int] = {}
+        # delta-snapshot tracking: pages whose CONTENT may have changed
+        # since the last delta marker.  Over-inclusive marking is safe
+        # (a clean page shipped twice is wasted bytes); under-inclusive
+        # is corruption — so every mutation path marks eagerly.
+        self._dirty: set = set()
+        self._delta_marker = 0
 
     # -- pool accounting ------------------------------------------------
     @property
@@ -394,6 +410,13 @@ class PagedKVCache:
         if reserve is not None:
             self._cow_reserve[slot] = reserve
             self._refcounts[reserve] = 1
+        # fresh pages (and the CoW reserve) will be written by the
+        # admitting request's prefill/decode — dirty from admission;
+        # aliased prefix pages stay clean (their content predates this
+        # admit and is never written through this slot un-copied)
+        self._dirty.update(fresh)
+        if reserve is not None:
+            self._dirty.add(reserve)
         self._slot_pages[slot] = pages
         self.block_tables[slot, :] = NULL_PAGE
         self.block_tables[slot, : len(pages)] = pages
@@ -475,6 +498,7 @@ class PagedKVCache:
             pages[i] = q
             self.block_tables[slot, i] = q
             self._refcounts[p] -= 1
+            self._dirty.add(q)
             copied = True
         return copied
 
@@ -499,6 +523,8 @@ class PagedKVCache:
                     f"slot {slot} wrote into shared page {pages[i]} "
                     "without copy-on-write"
                 )
+            # the advanced-over range was just written by the step
+            self._dirty.add(int(pages[i]))
         self.lengths[slot] = new
 
     def rollback(self, slot: int, length: int) -> None:
@@ -596,6 +622,7 @@ class PagedKVCache:
         slot = self.admit(int(total_tokens), slot=slot)
         pages = self._slot_pages[slot]
         n_copy = min(len(pages), got[1])
+        self._dirty.update(int(p) for p in pages[:n_copy])
         idx = np.asarray(pages[:n_copy], np.int64)
         self.k_pages = self.k_pages.at[:, idx].set(
             jnp.asarray(kv.k[:, :n_copy], self.dtype)
@@ -673,6 +700,14 @@ class PagedKVCache:
             )
         self.k_pages = jnp.asarray(k, self.dtype)
         self.v_pages = jnp.asarray(state["v_pages"], self.dtype)
+        self._load_host_accounting(state)
+        self.check_invariants()
+
+    def _load_host_accounting(self, state: dict) -> None:
+        """Rebuild the allocator's host state (tables, free list, slot
+        ownership, refcounts with cross-check) from a snapshot's
+        accounting arrays — shared by the full and delta restore
+        paths, so the two cannot drift apart."""
         self.block_tables = np.asarray(
             state["block_tables"], np.int32
         ).reshape(self.capacity, self.pages_per_slot).copy()
@@ -719,6 +754,95 @@ class PagedKVCache:
         # lookup structure over live pages, and a warm-started replica
         # rebuilds them as adopted requests re-register (replica layer)
         self._prefix_index = {}
+
+    # -- delta snapshots -----------------------------------------------
+    _DELTA_ACCOUNTING = ("block_tables", "lengths", "active",
+                         "slot_page_counts", "admit_order",
+                         "page_refcounts", "cow_reserve")
+
+    def delta_base_mark(self, value: Optional[int] = None) -> int:
+        """Establish a delta base: the point deltas ship FROM.  With no
+        ``value``, advance this cache's marker and clear the dirty set
+        (call right after taking/holding a full snapshot); with one,
+        adopt the sender's marker (call right after installing that
+        full snapshot on a replica) — both sides then agree on what
+        "since the last marker" means.  Returns the marker."""
+        if value is None:
+            self._delta_marker += 1
+        else:
+            self._delta_marker = int(value)
+        self._dirty.clear()
+        return self._delta_marker
+
+    def _delta_digest(self, delta: dict) -> str:
+        """sha256 over the delta's exact content in a fixed key order —
+        the integrity check :meth:`apply_delta` verifies, mirroring the
+        snapshot tier's per-file digests."""
+        h = hashlib.sha256()
+        h.update(f"base={int(delta['base_marker'])}"
+                 f":marker={int(delta['marker'])}".encode())
+        for name in ("page_ids", "k_delta", "v_delta",
+                     *self._DELTA_ACCOUNTING):
+            arr = np.ascontiguousarray(np.asarray(delta[name]))
+            h.update(f":{name}:{arr.shape}:{arr.dtype.str}:".encode())
+            h.update(arr.tobytes())
+        return h.hexdigest()
+
+    def delta_state_dict(self) -> dict:
+        """Incremental snapshot: ONLY the pages dirtied since the last
+        marker (content), plus the complete host accounting (tables,
+        lengths, refcounts, CoW reserves — tiny next to page bytes) and
+        a sha256 digest over the exact shipped content.  Advances the
+        marker: the next delta ships on top of this one, and a replica
+        applies deltas in marker order (:meth:`apply_delta` rejects a
+        base mismatch loudly)."""
+        ids = np.asarray(sorted(int(p) for p in self._dirty), np.int64)
+        full = self.state_dict()
+        delta = {
+            "base_marker": int(self._delta_marker),
+            "marker": int(self._delta_marker) + 1,
+            "page_ids": ids,
+            "k_delta": np.asarray(self.k_pages)[:, ids],
+            "v_delta": np.asarray(self.v_pages)[:, ids],
+            **{name: full[name] for name in self._DELTA_ACCOUNTING},
+        }
+        delta["digest"] = self._delta_digest(delta)
+        self._delta_marker += 1
+        self._dirty.clear()
+        return delta
+
+    def apply_delta(self, delta: dict) -> None:
+        """Install a :meth:`delta_state_dict` onto this cache.  The
+        digest is verified first (a tampered or torn delta raises
+        ``ValueError`` before any state mutates), then the base marker
+        must equal this cache's marker (deltas apply in order on top of
+        the snapshot they were cut from), then the shipped pages land
+        at their ids, the host accounting is rebuilt exactly as a full
+        restore would, and the invariants are re-checked.  The result
+        is bit-identical to loading the sender's full ``state_dict``
+        (pinned by test)."""
+        if self._delta_digest(delta) != delta.get("digest"):
+            raise ValueError(
+                "delta digest mismatch: snapshot delta is torn or "
+                "tampered"
+            )
+        if int(delta["base_marker"]) != int(self._delta_marker):
+            raise ValueError(
+                f"delta base marker {int(delta['base_marker'])} does "
+                f"not match this cache's marker {self._delta_marker}: "
+                "deltas apply in order on top of their base snapshot"
+            )
+        ids = np.asarray(delta["page_ids"], np.int64)
+        if ids.size:
+            self.k_pages = self.k_pages.at[:, ids].set(
+                jnp.asarray(delta["k_delta"], self.dtype)
+            )
+            self.v_pages = self.v_pages.at[:, ids].set(
+                jnp.asarray(delta["v_delta"], self.dtype)
+            )
+        self._load_host_accounting(delta)
+        self._delta_marker = int(delta["marker"])
+        self._dirty.clear()
         self.check_invariants()
 
 

@@ -402,8 +402,8 @@ class Trainer:
     @classmethod
     def run_elastic(cls, build, *, communicator_name: str = "tpu",
                     devices=None, max_restarts: int = 0,
-                    comm_kwargs: Optional[Dict[str, Any]] = None
-                    ) -> "Trainer":
+                    comm_kwargs: Optional[Dict[str, Any]] = None,
+                    peer_store=None) -> "Trainer":
         """Elastic restart: re-form the world from the surviving ranks,
         rebuild the trainer in it, resume THROUGH the checkpoint
         resharder, and run.
@@ -429,6 +429,16 @@ class Trainer:
         :class:`~chainermn_tpu.resilience.errors.
         PromotionRequiredError` asks for; growth floors the iterator
         cursor, re-visiting a sample rather than skipping one).
+
+        ``peer_store``: a :class:`~chainermn_tpu.resilience.peer_ckpt.
+        PeerCheckpointStore` adds the in-memory tier to step election —
+        the store rebinds its ring to the re-formed world (dropping
+        orphaned replicas), the peer and FS tiers each vote their
+        newest common step, and the PEER tier is preferred when its
+        step is at least as new (RAM restore, no FS read).  A broken
+        ring or an older peer step falls back to the FS cold tier; the
+        recorded ``elastic_restart`` event carries ``tier`` so the
+        fleet report prices which path recovery took.
         """
         from ..resilience import elastic as _elastic
 
@@ -437,13 +447,30 @@ class Trainer:
         )
         trainer = build(comm)
         ckpt = trainer._find_checkpointer()
-        restored = (
-            ckpt.restore_trainer(trainer) if ckpt is not None else None
-        )
+        restored = None
+        tier = None
+        if peer_store is not None:
+            peer_store.rebind(comm)
+            peer_step = peer_store.newest_common_step()
+            fs_step = (ckpt.newest_common_step()
+                       if ckpt is not None else None)
+            if peer_step is not None and (
+                fs_step is None or peer_step >= fs_step
+            ):
+                restored = peer_store.restore_trainer(trainer)
+                if restored is not None:
+                    tier = "peer"
+        if restored is None and ckpt is not None:
+            restored = ckpt.restore_trainer(trainer)
+            if restored is not None:
+                tier = "fs"
+        resized = (peer_store.last_resize
+                   if tier == "peer" and peer_store is not None
+                   else getattr(ckpt, "last_resize", None))
         trainer.resilience_log.record(
             "elastic_restart", "trainer.run_elastic",
             restored_step=restored, world=comm.size,
-            resized=getattr(ckpt, "last_resize", None),
+            resized=resized, tier=tier,
         )
         trainer.run(max_restarts=max_restarts)
         return trainer

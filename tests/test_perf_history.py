@@ -503,3 +503,105 @@ class TestPhaseSummaryRows:
             {"phase": 7, "p50_ms": 1.0},
         ])
         assert load_rows(cap) == {}
+
+# ----------------------------------------------------------------------
+# fleet recovery rungs (ISSUE 19 satellite): the peer-vs-FS A/B gates
+# ----------------------------------------------------------------------
+class TestRecoveryRungs:
+    def test_recover_seconds_rows_are_lower_better(self):
+        # the spelling trap this tier adds: "..._peer_s" ends in "_s"
+        # (a latency) and must NOT match the "_per_s" throughput rule
+        for name in ("fleet_recovery.recover_peer_s",
+                     "fleet_recovery.recover_fs_s"):
+            assert lower_is_better(name, {"unit": "s"}), name
+            assert lower_is_better(name, {}), name
+        assert not lower_is_better(
+            "fleet_recovery.recover_speedup", {"unit": "x"}
+        )
+
+    def test_recovery_regression_direction_aware(self, tmp_path):
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"metric": "fleet_recovery.recover_peer_s", "value": 0.011,
+             "unit": "s", "n_measurements": 3,
+             "spread_max_over_min": 1.3},
+        ])
+        # peer recovery got SLOWER beyond spread: flagged lower-better
+        worse = _capture(tmp_path, "BENCH_r91.json", [
+            {"metric": "fleet_recovery.recover_peer_s", "value": 0.02,
+             "unit": "s", "n_measurements": 3,
+             "spread_max_over_min": 1.3},
+        ])
+        regs = diff_rows(load_rows(old), load_rows(worse))
+        assert [r.metric for r in regs] == [
+            "fleet_recovery.recover_peer_s"
+        ]
+        assert regs[0].direction == "lower-better"
+        # got FASTER: lower-better, clean
+        better = _capture(tmp_path, "BENCH_r92.json", [
+            {"metric": "fleet_recovery.recover_peer_s", "value": 0.005,
+             "unit": "s", "n_measurements": 3,
+             "spread_max_over_min": 1.3},
+        ])
+        assert diff_rows(load_rows(old), load_rows(better)) == []
+
+    def test_speedup_collapse_flagged_higher_better(self, tmp_path):
+        """The acceptance ratio itself: dropping from 5.9x to 1.1x —
+        the RAM tier losing its edge over the FS — must gate."""
+        old = _capture(tmp_path, "BENCH_r90.json", [
+            {"metric": "fleet_recovery.recover_speedup", "value": 5.9,
+             "unit": "x", "n_measurements": 3,
+             "spread_max_over_min": 1.4},
+        ])
+        worse = _capture(tmp_path, "BENCH_r91.json", [
+            {"metric": "fleet_recovery.recover_speedup", "value": 1.1,
+             "unit": "x", "n_measurements": 3,
+             "spread_max_over_min": 1.4},
+        ])
+        regs = diff_rows(load_rows(old), load_rows(worse))
+        assert [r.metric for r in regs] == [
+            "fleet_recovery.recover_speedup"
+        ]
+        assert regs[0].direction == "higher-better"
+        better = _capture(tmp_path, "BENCH_r92.json", [
+            {"metric": "fleet_recovery.recover_speedup", "value": 8.0,
+             "unit": "x", "n_measurements": 3,
+             "spread_max_over_min": 1.4},
+        ])
+        assert diff_rows(load_rows(old), load_rows(better)) == []
+
+    def test_bench_recover_rows_load_and_self_diff_clean(self):
+        """The bench's _recover_rows emit the metric/value shape the
+        loader requires: min-of-samples latencies (unit s), max paired
+        speedup (unit x), protocol fields riding along."""
+        from fleet_chaos_bench import _recover_rows
+
+        rows = _recover_rows({
+            "recover_peer_s": [0.011, 0.012],
+            "recover_fs_s": [0.071, 0.066],
+        })
+        by = {r["metric"]: r for r in rows}
+        assert by["fleet_recovery.recover_peer_s"]["value"] == 0.011
+        assert by["fleet_recovery.recover_fs_s"]["unit"] == "s"
+        # paired ratios, NOT min/min across repeats: max(f_i / p_i)
+        want = round(max(0.071 / 0.011, 0.066 / 0.012), 2)
+        assert by["fleet_recovery.recover_speedup"]["value"] == want
+        assert all("n_measurements" in r for r in rows)
+
+        import json as _json
+        import tempfile as _tempfile
+
+        with _tempfile.TemporaryDirectory() as td:
+            tail = "\n".join(_json.dumps(r) for r in rows) + "\n"
+            p = os.path.join(td, "BENCH_r90.json")
+            with open(p, "w") as fh:
+                _json.dump({"n": 1, "rc": 0, "tail": tail}, fh)
+            loaded = load_rows(p)
+        assert lower_is_better(
+            "fleet_recovery.recover_peer_s",
+            loaded["fleet_recovery.recover_peer_s"],
+        )
+        assert not lower_is_better(
+            "fleet_recovery.recover_speedup",
+            loaded["fleet_recovery.recover_speedup"],
+        )
+        assert diff_rows(loaded, loaded) == []

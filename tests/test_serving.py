@@ -358,6 +358,125 @@ class TestCacheState:
 
 
 # ----------------------------------------------------------------------
+# KV delta snapshots (ISSUE 19): dirty-page increments for the RAM tier
+# ----------------------------------------------------------------------
+class TestKVDeltaSnapshot:
+    def _replica_pair(self):
+        """A populated cache and a replica synced by one full snapshot,
+        with agreed delta base markers — the handoff every delta ships
+        on top of."""
+        c = _cache()
+        rng = np.random.RandomState(3)
+        c.k_pages = jnp.asarray(rng.randn(*c.k_pages.shape), c.dtype)
+        c.v_pages = jnp.asarray(rng.randn(*c.v_pages.shape), c.dtype)
+        s0 = c.admit(9)
+        c.advance(s0, 8)
+        r = _cache()
+        r.load_state_dict(c.state_dict())
+        r.delta_base_mark(c.delta_base_mark())
+        return c, r, s0
+
+    def _assert_synced(self, c, r):
+        a, b = c.state_dict(), r.state_dict()
+        assert sorted(a) == sorted(b)
+        for k in a:
+            np.testing.assert_array_equal(
+                np.asarray(a[k]), np.asarray(b[k]), err_msg=k
+            )
+
+    def test_delta_ships_only_the_dirty_pages(self):
+        c, r, _ = self._replica_pair()
+        s1 = c.admit(5)
+        c.advance(s1, 3)
+        touched = set(c._slot_pages[s1])
+        d = c.delta_state_dict()
+        assert {int(p) for p in d["page_ids"]} == touched
+        assert d["k_delta"].shape[1] == len(touched)
+        r.apply_delta(d)
+        self._assert_synced(c, r)
+        # nothing written since the cut: the next delta is empty but
+        # still carries the full accounting and verifies
+        d2 = c.delta_state_dict()
+        assert d2["page_ids"].size == 0
+        r.apply_delta(d2)
+        self._assert_synced(c, r)
+
+    def test_admit_cow_evict_release_churn_applies_bit_identical(self):
+        """The acceptance pin: a delta cut after prefix-shared
+        admission, a copy-on-write, an eviction, and a release lands
+        the replica bit-identical to loading the sender's FULL
+        state_dict — refcounts and CoW reserves included."""
+        c, r, s0 = self._replica_pair()
+        toks = list(range(8))
+        c.register_prefix(s0, toks)
+        m = c.lookup_prefix(toks)  # full match → CoW'd final page
+        b = c.admit(12, prefix=m)
+        assert c.cow_for_write(b, 1) is True
+        c.advance(b, 1)
+        u = c.admit(5)
+        c.advance(u, 5)
+        c.evict(u)  # preempt: pages return to the pool
+        c.release(s0)  # shared pages survive for b alone
+        r.apply_delta(c.delta_state_dict())
+        self._assert_synced(c, r)
+        # the synced replica's allocator continues identically
+        assert c.admit(6) == r.admit(6)
+        np.testing.assert_array_equal(c.block_tables, r.block_tables)
+        assert c._free_pages == r._free_pages
+        c.check_invariants()
+        r.check_invariants()
+
+    def test_import_kv_marks_the_imported_pages_dirty(self):
+        # the disaggregated handoff writes pages outside admit/advance:
+        # those must land in the next delta too
+        c, _, s0 = self._replica_pair()
+        kv = c.export_kv(s0)
+        dst = _cache()
+        dst.delta_base_mark()
+        slot = dst.import_kv(kv, 12)
+        cut = dst.delta_state_dict()
+        assert {int(p) for p in cut["page_ids"]} == set(
+            dst._slot_pages[slot]
+        )
+
+    def test_tampered_delta_rejected_before_any_mutation(self):
+        c, r, _ = self._replica_pair()
+        s1 = c.admit(5)
+        c.advance(s1, 3)
+        d = c.delta_state_dict()
+        before = r.state_dict()
+        evil = dict(d, k_delta=np.asarray(d["k_delta"]) + 1e-3)
+        with pytest.raises(ValueError, match="digest mismatch"):
+            r.apply_delta(evil)
+        # accounting is covered by the digest as well
+        evil2 = dict(d, lengths=np.asarray(d["lengths"]) + 1)
+        with pytest.raises(ValueError, match="digest mismatch"):
+            r.apply_delta(evil2)
+        after = r.state_dict()
+        for k in before:
+            np.testing.assert_array_equal(
+                np.asarray(before[k]), np.asarray(after[k]), err_msg=k
+            )
+        r.apply_delta(d)  # the pristine delta still applies
+        self._assert_synced(c, r)
+
+    def test_out_of_order_delta_rejected(self):
+        c, r, _ = self._replica_pair()
+        s1 = c.admit(5)
+        c.advance(s1, 2)
+        d1 = c.delta_state_dict()
+        c.advance(s1, 1)
+        d2 = c.delta_state_dict()
+        with pytest.raises(ValueError, match="base marker"):
+            r.apply_delta(d2)  # skipped d1
+        r.apply_delta(d1)
+        r.apply_delta(d2)  # in order: lands
+        self._assert_synced(c, r)
+        with pytest.raises(ValueError, match="base marker"):
+            r.apply_delta(d2)  # replay
+
+
+# ----------------------------------------------------------------------
 # flash_decode kernel (decode-geometry Pallas variant)
 # ----------------------------------------------------------------------
 class TestFlashDecode:

@@ -27,7 +27,16 @@ inference comm studies).  This package makes both first-class:
 * :mod:`.budgets` — pinned per-program collective AND per-rank HBM
   ceilings;
 * :mod:`.lint` — the repo AST gate
-  (``python -m chainermn_tpu.analysis.lint``).
+  (``python -m chainermn_tpu.analysis.lint``; ``--host-protocol`` adds
+  the SPMD-determinism rules and the protolint catalog rules);
+* :mod:`.protolint` — the HOST-protocol analyzer: catalog every
+  obj-store exchange site/tag/atomic-write (``ProtocolCatalog``) and
+  enforce site uniqueness, lockstep-wrapped allgathers, registry-
+  resolved tags, and the single sanctioned manifest writer.  Its
+  runtime twin is :func:`checks.protocol_agreement` over
+  :mod:`chainermn_tpu.resilience.protocol`'s recorder, raising
+  ``ProtocolDivergenceError`` on every rank before a divergent host
+  protocol can deadlock.
 
 Every :class:`CollectiveRecord` additionally carries the cost model the
 comm_wire planner consumes: ``bytes_on_wire`` (ring-algorithm per-rank
@@ -65,6 +74,7 @@ from .checks import (  # noqa: F401
     check_overlap,
     check_wire,
     implicit_agreement,
+    protocol_agreement,
     run_all,
     trace_agreement,
 )
@@ -98,5 +108,9 @@ from .memory import (  # noqa: F401
 )
 
 # re-exported so `except analysis.CollectiveTraceMismatchError` works at
-# the place the guard is documented
-from ..resilience.errors import CollectiveTraceMismatchError  # noqa: F401
+# the place the guard is documented (ProtocolDivergenceError likewise,
+# for the host-protocol guard)
+from ..resilience.errors import (  # noqa: F401
+    CollectiveTraceMismatchError,
+    ProtocolDivergenceError,
+)

@@ -432,6 +432,83 @@ def trace_agreement(comm, trace: CollectiveTrace, *,
     return mine
 
 
+def protocol_agreement(comm, recorder=None, *,
+                       label: Optional[str] = None,
+                       max_attempts: int = 4) -> str:
+    """Verify every process issued the same ordered HOST-side exchange
+    sequence — the control-plane twin of :func:`trace_agreement`.
+
+    ``recorder`` is a :class:`~chainermn_tpu.resilience.protocol.
+    ProtocolRecorder` (default: the installed one); its window
+    signature — the ordered ``(site|tag)`` tokens since the last agreed
+    point, with by-design-asymmetric ops excluded — is hashed and
+    exchanged through the lockstep retry.  Any mismatch raises
+    :class:`~chainermn_tpu.resilience.errors.ProtocolDivergenceError`
+    on EVERY rank (non-recoverable: replaying the same divergent host
+    code re-diverges) *before* the mismatched protocol wedges a later
+    exchange into a deadlock.  On agreement the recorder's cursor
+    advances (``mark_agreed``), so successive calls check successive
+    windows.  Returns the agreed signature hash.
+
+    The guard's own exchange rides ``lockstep_allgather`` — a torn
+    payload on the agreement itself retries on all ranks together —
+    and is recorded under its ``analysis.protocol_agreement(...)``
+    site AFTER the signature is taken, so it never perturbs the window
+    it is checking.
+    """
+    from ..resilience import protocol as _proto
+    from ..resilience.errors import ProtocolDivergenceError
+    from ..resilience.retry import lockstep_allgather
+
+    rec = recorder if recorder is not None else _proto.active()
+    if rec is None:
+        raise RuntimeError(
+            "protocol_agreement: no ProtocolRecorder installed — set "
+            f"{_proto.ENV_RECORD}=1 (or protocol.install(...)) before "
+            "constructing the communicator"
+        )
+    sig = rec.window_signature()
+    mine = {
+        "hash": _proto.signature_hash(sig),
+        "n": len(sig),
+        "tail": sig[-8:],
+        # full signature when small enough to name the divergent index
+        "sig": sig if len(sig) <= 256 else None,
+    }
+    site = (f"analysis.protocol_agreement({label})" if label
+            else "analysis.protocol_agreement")
+    everyone = lockstep_allgather(comm, mine, site=site,
+                                  max_attempts=max_attempts)
+    if any(e["hash"] != mine["hash"] for e in everyone):
+        per_rank = "; ".join(
+            f"rank {r}: n={e['n']} hash={e['hash'][:12]} "
+            f"tail={e['tail']}"
+            for r, e in enumerate(everyone)
+        )
+        where = ""
+        sigs = [e["sig"] for e in everyone]
+        if all(s is not None for s in sigs):
+            upto = max(len(s) for s in sigs)
+            for i in range(upto):
+                toks = {s[i] if i < len(s) else None for s in sigs}
+                if len(toks) > 1:
+                    where = (f"; first divergent exchange at index {i}: "
+                             + ", ".join(
+                                 f"rank {r}={s[i] if i < len(s) else None!r}"
+                                 for r, s in enumerate(sigs)))
+                    break
+        raise ProtocolDivergenceError(
+            f"host-protocol divergence at {site}: processes issued "
+            f"different obj-store exchange sequences ({per_rank}"
+            f"{where}) — the control plane would deadlock on the next "
+            "mismatched exchange; diff the per-rank protocol jsonl "
+            "(FleetReport.protocol_divergence pinpoints the token)",
+            site=site,
+        )
+    rec.mark_agreed()
+    return mine["hash"]
+
+
 # ----------------------------------------------------------------------
 # ordering-aware overlap check (ISSUE 8)
 # ----------------------------------------------------------------------

@@ -49,11 +49,48 @@ Rules
     plain interval arithmetic, which the rule deliberately permits —
     it is the clock both sanctioned layers run on).
 
+Host-protocol rules (``--host-protocol`` / ``host_protocol=True``)
+------------------------------------------------------------------
+Ride-alongs from :mod:`.protolint` (exchange-site catalog rules:
+``proto-duplicate-site`` / ``proto-raw-allgather`` / ``proto-magic-tag``
+/ ``proto-adhoc-manifest``) plus three SPMD-determinism rules scoped to
+``DECISION_MODULES`` — the modules whose values feed cross-rank
+decisions (serving placement, fleet rendezvous, elastic resharding,
+checkpoint step election, wire planning), where any per-process
+nondeterminism becomes a protocol divergence:
+
+``spmd-hash``
+    Builtin ``hash()`` is salted per process (``PYTHONHASHSEED``): two
+    ranks hashing the same string disagree.  Use ``hashlib`` digests
+    for anything that crosses a rank boundary.
+
+``spmd-unsorted-scan``
+    Iterating a raw ``os.listdir``/``os.scandir``/``glob.glob``/
+    ``glob.iglob`` result (directly, or via a name assigned from one),
+    or iterating a ``set``, yields filesystem/hash order — which
+    differs across hosts.  Wrap in ``sorted(...)``; generator
+    expressions fed straight into an order-insensitive reducer
+    (``sorted``/``min``/``max``/``sum``/``len``/``any``/``all``/
+    ``set``/``frozenset``) are exempt.
+
+``spmd-random``
+    ``random``-module draws (and ``np.random`` global-state draws) are
+    seeded per process; a cross-rank decision sampled from them
+    diverges silently.  Use ``jax.random`` with an explicitly agreed
+    key, or a seeded ``np.random.RandomState``/``default_rng``
+    instance (constructors are not draws, so those are untouched).
+
+The SPMD allowlist is **closed and empty** (``SPMD_ALLOWLIST = ()``):
+no decision module is exempt; escapes are per-line pragmas only.
+
 Per-line escape hatch (same line or the line above)::
 
     # mnlint: allow(raw-collective)
     # mnlint: allow(untimed-row)
     # mnlint: allow(raw-timing)
+    # mnlint: allow(spmd-hash)
+    # mnlint: allow(spmd-unsorted-scan)
+    # mnlint: allow(spmd-random)
 """
 
 from __future__ import annotations
@@ -102,6 +139,49 @@ TIMING_KEY_RE = re.compile(
 )
 
 PRAGMA_RE = re.compile(r"#\s*mnlint:\s*allow\(([a-z-]+)\)")
+
+# ----------------------------------------------------------------------
+# host-protocol (--host-protocol) rule scoping
+# ----------------------------------------------------------------------
+# Modules whose values feed cross-rank decisions: serving placement and
+# scan-driven admission, fleet rendezvous/control, elastic resharding,
+# peer-checkpoint healing, checkpoint step election, wire planning.
+# Per-process nondeterminism here IS a protocol divergence.
+DECISION_MODULES = (
+    "chainermn_tpu/serving/",
+    "chainermn_tpu/fleet/",
+    "chainermn_tpu/resilience/adaptive.py",
+    "chainermn_tpu/resilience/elastic.py",
+    "chainermn_tpu/resilience/peer_ckpt.py",
+    "chainermn_tpu/extensions/checkpoint.py",
+    "chainermn_tpu/comm_wire/planner.py",
+    "chainermn_tpu/comm_wire/autotune.py",
+    "chainermn_tpu/comm_wire/schedules.py",
+)
+
+# CLOSED allowlist: no decision module may opt out wholesale.  Escapes
+# are per-line pragmas only, so every exemption is visible in the diff
+# that introduces it.  (The tuple stays defined so tests can pin that
+# serving/ and fleet/ never creep onto it.)
+SPMD_ALLOWLIST: tuple = ()
+
+# spmd-unsorted-scan: raw directory/glob scans whose order is
+# filesystem-dependent, and the order-insensitive reducers a generator
+# over one may feed directly
+SCAN_CALLS = frozenset({"listdir", "scandir", "glob", "iglob"})
+ORDER_INSENSITIVE = frozenset({
+    "sorted", "min", "max", "sum", "len", "any", "all", "set",
+    "frozenset",
+})
+
+# spmd-random: global-state draw names on random / np.random.
+# Constructors (RandomState, default_rng, PRNGKey, Generator) are NOT
+# here — a seeded instance is the sanctioned fix.
+RANDOM_DRAWS = frozenset({
+    "random", "rand", "randn", "randint", "randrange", "shuffle",
+    "permutation", "choice", "sample", "uniform", "gauss", "seed",
+    "getrandbits", "standard_normal", "bytes",
+})
 
 
 @dataclass(frozen=True)
@@ -323,12 +403,192 @@ def _lint_untimed_rows(tree: ast.AST, lines, rel: str) -> List[Violation]:
     return out
 
 
+def _lint_spmd_hash(tree: ast.AST, lines, rel: str) -> List[Violation]:
+    out = []
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(
+            node.func, ast.Name
+        ) and node.func.id == "hash":
+            if not _allowed(lines, node.lineno, "spmd-hash"):
+                out.append(Violation(
+                    rel, node.lineno, "spmd-hash",
+                    "builtin hash() is salted per process "
+                    "(PYTHONHASHSEED); in a decision module use a "
+                    "hashlib digest for anything that crosses a rank "
+                    "boundary",
+                ))
+    return out
+
+
+def _parent_map(tree: ast.AST) -> dict:
+    parents: dict = {}
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            parents[child] = node
+    return parents
+
+
+def _scan_hit(node: ast.expr, scan_mods: frozenset,
+              smuggled: frozenset):
+    """``"os.listdir"`` when ``node`` is a raw scan call, else None."""
+    if not isinstance(node, ast.Call):
+        return None
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in SCAN_CALLS:
+        base = f.value
+        if isinstance(base, ast.Name) and (
+            base.id in ("os", "glob") or base.id in scan_mods
+        ):
+            return f"{base.id}.{f.attr}"
+        # pathlib: p.glob / p.iterdir have no stable base name; keep
+        # the rule to os/glob where the repo's scans live
+    if isinstance(f, ast.Name) and f.id in smuggled:
+        return f.id
+    return None
+
+
+def _set_hit(node: ast.expr):
+    if isinstance(node, (ast.Set, ast.SetComp)):
+        return "a set"
+    if isinstance(node, ast.Call) and isinstance(
+        node.func, ast.Name
+    ) and node.func.id in ("set", "frozenset"):
+        return f"{node.func.id}(...)"
+    return None
+
+
+def _lint_spmd_unsorted_scan(tree: ast.AST, lines,
+                             rel: str) -> List[Violation]:
+    out = []
+    parents = _parent_map(tree)
+    scan_mods = _module_aliases(tree, "glob") | _module_aliases(
+        tree, "os")
+    smuggled = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module in (
+            "os", "glob"
+        ):
+            for a in node.names:
+                if a.name in SCAN_CALLS:
+                    smuggled.add(a.asname or a.name)
+    smuggled = frozenset(smuggled)
+
+    def flag(lineno, what):
+        if not _allowed(lines, lineno, "spmd-unsorted-scan"):
+            out.append(Violation(
+                rel, lineno, "spmd-unsorted-scan",
+                f"iterating {what} yields filesystem/hash order, "
+                "which differs across hosts; wrap in sorted(...) "
+                "before any cross-rank decision depends on it",
+            ))
+
+    for scope in (n for n in ast.walk(tree)
+                  if isinstance(n, _SCOPE_NODES)):
+        # names assigned a raw scan result inside this scope
+        tainted = set()
+        for n in _scope_body_walk(scope):
+            if isinstance(n, ast.Assign) and _scan_hit(
+                n.value, scan_mods, smuggled
+            ):
+                for t in n.targets:
+                    if isinstance(t, ast.Name):
+                        tainted.add(t.id)
+        for n in _scope_body_walk(scope):
+            if isinstance(n, ast.For):
+                iters = [(n.iter, n.iter.lineno, None)]
+            elif isinstance(n, (ast.ListComp, ast.SetComp,
+                                ast.DictComp, ast.GeneratorExp)):
+                iters = [(g.iter, g.iter.lineno, n)
+                         for g in n.generators]
+            else:
+                continue
+            for it, lineno, comp in iters:
+                hit = _scan_hit(it, scan_mods, smuggled)
+                if hit is None and isinstance(it, ast.Name) \
+                        and it.id in tainted:
+                    hit = f"{it.id} (a raw scan result)"
+                if hit is None:
+                    hit = _set_hit(it)
+                if hit is None:
+                    continue
+                # a comprehension handed straight to an
+                # order-insensitive reducer is fine
+                if comp is not None:
+                    p = parents.get(comp)
+                    if isinstance(p, ast.Call) and isinstance(
+                        p.func, ast.Name
+                    ) and p.func.id in ORDER_INSENSITIVE:
+                        continue
+                flag(lineno, hit)
+    return out
+
+
+def _lint_spmd_random(tree: ast.AST, lines, rel: str) -> List[Violation]:
+    out = []
+    aliases = set(_module_aliases(tree, "random"))
+    # names bound to jax.random are fine — jax PRNG draws take an
+    # explicit key, which is exactly the sanctioned discipline
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                if a.asname and a.name == "jax.random":
+                    aliases.discard(a.asname)
+        elif isinstance(node, ast.ImportFrom):
+            if node.module == "jax":
+                for a in node.names:
+                    if a.name == "random":
+                        aliases.discard(a.asname or a.name)
+        elif isinstance(node, ast.Assign):
+            v = node.value
+            if isinstance(v, ast.Attribute) and v.attr == "random" \
+                    and isinstance(v.value, ast.Name) \
+                    and v.value.id == "jax":
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        aliases.discard(t.id)
+    smuggled = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom) and node.module and \
+                node.module.split(".")[-1] == "random" and \
+                not node.module.startswith("jax"):
+            for a in node.names:
+                if a.name in RANDOM_DRAWS:
+                    smuggled.add(a.asname or a.name)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        hit = None
+        f = node.func
+        if isinstance(f, ast.Attribute) and f.attr in RANDOM_DRAWS:
+            base = f.value
+            if isinstance(base, ast.Name) and (
+                base.id == "random" or base.id in aliases
+            ):
+                hit = f"{base.id}.{f.attr}"
+            elif isinstance(base, ast.Attribute) and \
+                    base.attr == "random" and isinstance(
+                        base.value, ast.Name
+                    ) and base.value.id != "jax":
+                hit = f"{base.value.id}.random.{f.attr}"
+        elif isinstance(f, ast.Name) and f.id in smuggled:
+            hit = f.id
+        if hit and not _allowed(lines, node.lineno, "spmd-random"):
+            out.append(Violation(
+                rel, node.lineno, "spmd-random",
+                f"{hit}() draws from per-process global RNG state; "
+                "in a decision module use jax.random with an agreed "
+                "key or a seeded RandomState/default_rng instance",
+            ))
+    return out
+
+
 def _is_bench_file(rel: str) -> bool:
     parts = rel.split("/")
     return "benchmarks" in parts or parts[-1].startswith("bench")
 
 
-def lint_file(path: str, repo_root: str) -> List[Violation]:
+def lint_file(path: str, repo_root: str,
+              host_protocol: bool = False) -> List[Violation]:
     rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
     try:
         with open(path, encoding="utf-8") as f:
@@ -350,6 +610,12 @@ def lint_file(path: str, repo_root: str) -> List[Violation]:
         rel.startswith(p) for p in TIMING_SANCTIONED
     ):
         out += _lint_raw_timing(tree, lines, rel)
+    if host_protocol and any(
+        rel.startswith(p) for p in DECISION_MODULES
+    ) and not any(rel.startswith(p) for p in SPMD_ALLOWLIST):
+        out += _lint_spmd_hash(tree, lines, rel)
+        out += _lint_spmd_unsorted_scan(tree, lines, rel)
+        out += _lint_spmd_random(tree, lines, rel)
     return sorted(out, key=lambda v: (v.path, v.line))
 
 
@@ -386,13 +652,18 @@ def default_targets(root: Optional[str] = None) -> List[str]:
 
 
 def run_lint(paths: Optional[Sequence[str]] = None,
-             root: Optional[str] = None) -> List[Violation]:
+             root: Optional[str] = None,
+             host_protocol: bool = False) -> List[Violation]:
     root = root or repo_root()
     targets = list(paths) if paths else default_targets(root)
     out: List[Violation] = []
     for t in targets:
         for f in _iter_py_files(t):
-            out += lint_file(f, root)
+            out += lint_file(f, root, host_protocol=host_protocol)
+    if host_protocol:
+        # lazy: protolint imports this module's helpers
+        from . import protolint
+        out += protolint.catalog_violations(paths or None, root)
     return out
 
 
@@ -401,7 +672,9 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     if argv and argv[0] in ("-h", "--help"):
         print(__doc__)
         return 0
-    violations = run_lint(argv or None)
+    host_protocol = "--host-protocol" in argv
+    argv = [a for a in argv if a != "--host-protocol"]
+    violations = run_lint(argv or None, host_protocol=host_protocol)
     for v in violations:
         print(v)
     if violations:

@@ -1,0 +1,469 @@
+"""protolint — the host-protocol analyzer: exchange-site catalog.
+
+mnlint (:mod:`.lint`) guards the *compiled* collective surface; this
+module gives the HOST protocol — the obj-store exchanges, hand-assigned
+tags, and shared-FS atomic writes the serving/elastic/peer-ckpt tiers
+coordinate through — the same three-layer treatment:
+
+1. **This AST pass**: walk ``chainermn_tpu/`` and extract every
+   host-side exchange into a :class:`ProtocolCatalog` —
+   ``lockstep_allgather(site=...)`` agreement sites, raw
+   ``send_obj``/``recv_obj`` calls with their tags,
+   ``bcast_obj``/``gather_obj``/``allgather_obj`` collectives, and
+   tmp+rename JSON manifest writers — then enforce the catalog rules
+   below.
+2. **SPMD-determinism lint**: :mod:`.lint`'s ``--host-protocol`` rules
+   (``spmd-hash`` / ``spmd-unsorted-scan`` / ``spmd-random``) over the
+   modules that feed cross-rank decisions.
+3. **Runtime guard**: :mod:`chainermn_tpu.resilience.protocol` +
+   :func:`~chainermn_tpu.analysis.checks.protocol_agreement`.
+
+Catalog rules (rule ids; pragma escape ``# mnlint: allow(<rule>)``)
+-------------------------------------------------------------------
+``proto-duplicate-site``
+    Agreement site names must be globally unique across the package:
+    two ``lockstep_allgather`` call sites sharing one literal ``site=``
+    make retries, recorded protocols, and error messages ambiguous
+    about WHICH exchange tore.  F-string sites count as dynamic
+    prefixes (``prefix*``) and are exempt from uniqueness (they embed
+    a discriminator by construction).
+
+``proto-raw-allgather``
+    Every agreement-shaped allgather rides ``lockstep_allgather``: a
+    raw ``allgather_obj`` call outside ``resilience/retry.py`` (the
+    wrapper itself) / ``communicators/_obj_store.py`` (the transport)
+    is an error — it would skip the lockstep retry AND the protocol
+    recorder's site naming.
+
+``proto-magic-tag``
+    Every ``send_obj``/``recv_obj`` tag must be the default (0) or
+    resolve to the central registry (``resilience/tags.py`` — a name
+    imported from it, or a call to one of its helpers).  Tag literals
+    and arithmetic (the old ``PEER_TAG + 1 + o``) are errors, as are
+    module-level ``*_TAG = <int>`` constants outside the registry:
+    reserved ranges must be DECLARED where overlap is checked.
+
+``proto-adhoc-manifest``
+    A function that both ``json.dump``\\ s and ``os.rename``/
+    ``os.replace``\\ s is an ad-hoc atomic manifest writer; outside
+    ``resilience/elastic.py`` (``write_manifest`` — the sanctioned
+    one) it is an error, so the tmp-suffix/fsync/commit semantics
+    cannot fork per call site.
+
+Run it (also folded into ``python -m chainermn_tpu.analysis.lint
+--host-protocol`` and the tier-1 repo gate)::
+
+    python -m chainermn_tpu.analysis.protolint
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import sys
+from dataclasses import dataclass
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from .lint import (
+    Violation,
+    _allowed,
+    _iter_py_files,
+    _module_aliases,
+    repo_root,
+)
+
+# files sanctioned for raw allgather_obj: the lockstep wrapper itself
+# and the transport layer beneath it
+RAW_ALLGATHER_SANCTIONED = (
+    "chainermn_tpu/resilience/retry.py",
+    "chainermn_tpu/communicators/_obj_store.py",
+    "chainermn_tpu/communicators/communicator_base.py",
+)
+
+# the one sanctioned atomic-JSON-manifest writer
+MANIFEST_SANCTIONED = ("chainermn_tpu/resilience/elastic.py",)
+
+# the registry itself may declare integer tag constants
+TAGS_MODULE = "chainermn_tpu/resilience/tags.py"
+
+# call names the catalog keys on (the fleet worker's _lockstep_allgather
+# wrapper forwards to the real one, so its call sites carry the literal
+# site strings the catalog must see)
+LOCKSTEP_CALLS = frozenset({"lockstep_allgather", "_lockstep_allgather"})
+P2P_CALLS = frozenset({"send_obj", "recv_obj"})
+COLLECTIVE_OBJ_CALLS = frozenset({"bcast_obj", "gather_obj",
+                                  "allgather_obj", "exchange_obj"})
+
+
+@dataclass(frozen=True)
+class ExchangeSite:
+    """One cataloged host-side exchange."""
+
+    path: str               # repo-relative
+    line: int
+    kind: str               # lockstep | send | recv | exchange |
+    #                         atomic_write | tag_constant
+    site: Optional[str] = None   # resolved site name; "prefix*" for
+    #                              f-strings; None when unresolvable
+    dynamic: bool = False        # site not a compile-time literal
+    tag: Optional[str] = None        # rendered tag expression
+    tag_source: Optional[str] = None  # default | registry | literal | expr
+
+    def __str__(self) -> str:
+        bits = [self.kind]
+        if self.site is not None:
+            bits.append(f"site={self.site}")
+        if self.tag is not None:
+            bits.append(f"tag={self.tag}({self.tag_source})")
+        return f"{self.path}:{self.line}: " + " ".join(bits)
+
+
+@dataclass
+class ProtocolCatalog:
+    """Every host-side exchange the AST pass found."""
+
+    sites: List[ExchangeSite]
+
+    def by_kind(self, kind: str) -> List[ExchangeSite]:
+        return [s for s in self.sites if s.kind == kind]
+
+    def lockstep_sites(self) -> List[ExchangeSite]:
+        return self.by_kind("lockstep")
+
+    def site_names(self) -> List[str]:
+        """Resolved (non-dynamic) agreement site names, sorted."""
+        return sorted(s.site for s in self.lockstep_sites()
+                      if not s.dynamic and s.site is not None)
+
+    def __len__(self) -> int:
+        return len(self.sites)
+
+    def render(self) -> str:
+        lines = [f"ProtocolCatalog: {len(self.sites)} exchange site(s)"]
+        for s in sorted(self.sites, key=lambda s: (s.path, s.line)):
+            lines.append("  " + str(s))
+        return "\n".join(lines)
+
+
+# ----------------------------------------------------------------------
+# per-file extraction
+# ----------------------------------------------------------------------
+def _module_str_constants(tree: ast.AST) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` bindings — how most agreement
+    sites are spelled (``REPLICATE_SITE = "peer_ckpt.replicate"``)."""
+    out: Dict[str, str] = {}
+    for node in ast.iter_child_nodes(tree):
+        if isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, str):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = node.value.value
+    return out
+
+
+def _tags_bindings(tree: ast.AST) -> Tuple[frozenset, frozenset]:
+    """(names imported FROM resilience.tags, names bound to the tags
+    MODULE) — what a registry-resolved tag expression may reference."""
+    names = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            if mod.split(".")[-1] == "tags":
+                for a in node.names:
+                    names.add(a.asname or a.name)
+    mods = _module_aliases(tree, "tags")
+    return frozenset(names), frozenset(mods)
+
+
+def _classify_site(node: Optional[ast.expr],
+                   consts: Dict[str, str]) -> Tuple[Optional[str], bool]:
+    """Resolve a ``site=`` expression: (name, dynamic)."""
+    if node is None:
+        return None, True
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value, False
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id], False
+    if isinstance(node, ast.JoinedStr):
+        prefix = []
+        for v in node.values:
+            if isinstance(v, ast.Constant) and isinstance(v.value, str):
+                prefix.append(v.value)
+            else:
+                break
+        return "".join(prefix) + "*", True
+    return None, True
+
+
+def _classify_tag(node: Optional[ast.expr], tag_names: frozenset,
+                  tag_mods: frozenset) -> Tuple[str, Optional[str]]:
+    """Resolve a ``tag=`` expression: (source, rendered).
+
+    ``source``: ``default`` (absent / literal 0), ``registry`` (a name
+    imported from resilience.tags, an attribute of the tags module, or
+    a call to either), ``literal`` (any other int constant), ``expr``
+    (arithmetic / anything else)."""
+    if node is None:
+        return "default", None
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        if node.value == 0:
+            return "default", "0"
+        return "literal", repr(node.value)
+    target = node.func if isinstance(node, ast.Call) else node
+    if isinstance(target, ast.Name) and target.id in tag_names:
+        return "registry", ast.unparse(node)
+    if isinstance(target, ast.Attribute) and isinstance(
+        target.value, ast.Name
+    ) and target.value.id in tag_mods:
+        return "registry", ast.unparse(node)
+    return "expr", ast.unparse(node)
+
+
+def _kwarg(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for kw in call.keywords:
+        if kw.arg == name:
+            return kw.value
+    return None
+
+
+def _call_name(call: ast.Call) -> Optional[str]:
+    f = call.func
+    if isinstance(f, ast.Attribute):
+        return f.attr
+    if isinstance(f, ast.Name):
+        return f.id
+    return None
+
+
+def _tag_constant_assigns(tree: ast.AST):
+    """Module-level ``X_TAG = <int>`` / ``TAG_X = <int>`` assigns — a
+    hand-reserved tag outside the registry."""
+    for node in ast.iter_child_nodes(tree):
+        if not (isinstance(node, ast.Assign) and isinstance(
+            node.value, ast.Constant
+        ) and isinstance(node.value.value, int)
+                and not isinstance(node.value.value, bool)):
+            continue
+        for t in node.targets:
+            if isinstance(t, ast.Name) and (
+                t.id.endswith("_TAG") or t.id.startswith("TAG_")
+            ):
+                yield node.lineno, t.id, node.value.value
+
+
+def _atomic_write_functions(tree: ast.AST):
+    """Functions containing BOTH a ``json.dump`` call and an
+    ``os.rename``/``os.replace`` call — ad-hoc atomic JSON writers.
+    Keyed on the ``json`` module specifically (alias-tracked):
+    ``pickle.dump`` + rename is a binary payload commit, not a
+    manifest, and stays out of this rule."""
+    json_names = _module_aliases(tree, "json") | frozenset({"json"})
+    for fn in ast.walk(tree):
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        dump_line = None
+        renames = False
+        for node in ast.walk(fn):
+            if not isinstance(node, ast.Call):
+                continue
+            name = _call_name(node)
+            if name == "dump" and isinstance(
+                node.func, ast.Attribute
+            ) and isinstance(node.func.value, ast.Name) \
+                    and node.func.value.id in json_names:
+                dump_line = dump_line or node.lineno
+            elif name in ("rename", "replace") and isinstance(
+                node.func, ast.Attribute
+            ):
+                renames = True
+        if dump_line is not None and renames:
+            yield dump_line, fn.name
+
+
+def scan_file(path: str, root: str
+              ) -> Tuple[List[ExchangeSite], List[Violation]]:
+    """Extract one file's exchange sites and its per-file violations
+    (everything except cross-file site uniqueness)."""
+    rel = os.path.relpath(path, root).replace(os.sep, "/")
+    try:
+        with open(path, encoding="utf-8") as f:
+            src = f.read()
+    except (OSError, UnicodeDecodeError):
+        return [], []
+    try:
+        tree = ast.parse(src, filename=rel)
+    except SyntaxError as e:
+        return [], [Violation(rel, e.lineno or 0, "syntax",
+                              f"file does not parse: {e.msg}")]
+    lines = src.splitlines()
+    consts = _module_str_constants(tree)
+    tag_names, tag_mods = _tags_bindings(tree)
+    sites: List[ExchangeSite] = []
+    out: List[Violation] = []
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call):
+            name = _call_name(node)
+            if name in LOCKSTEP_CALLS:
+                site, dynamic = _classify_site(_kwarg(node, "site"),
+                                               consts)
+                sites.append(ExchangeSite(rel, node.lineno, "lockstep",
+                                          site=site, dynamic=dynamic))
+            elif name in P2P_CALLS:
+                kind = "send" if name == "send_obj" else "recv"
+                # tag may also arrive positionally: send_obj(obj, dest,
+                # tag) / recv_obj(source, tag)
+                tag_node = _kwarg(node, "tag")
+                if tag_node is None:
+                    pos = 2 if name == "send_obj" else 1
+                    if len(node.args) > pos:
+                        tag_node = node.args[pos]
+                source, rendered = _classify_tag(tag_node, tag_names,
+                                                 tag_mods)
+                sites.append(ExchangeSite(rel, node.lineno, kind,
+                                          tag=rendered,
+                                          tag_source=source))
+                if source in ("literal", "expr") and not _allowed(
+                    lines, node.lineno, "proto-magic-tag"
+                ):
+                    out.append(Violation(
+                        rel, node.lineno, "proto-magic-tag",
+                        f"{name} tag {rendered!r} does not resolve to "
+                        "the central registry; declare a reserved "
+                        "range in resilience/tags.py and import it",
+                    ))
+            elif name in COLLECTIVE_OBJ_CALLS:
+                sites.append(ExchangeSite(rel, node.lineno, "exchange",
+                                          site=name))
+                if name == "allgather_obj" and rel not in \
+                        RAW_ALLGATHER_SANCTIONED and not _allowed(
+                            lines, node.lineno, "proto-raw-allgather"):
+                    out.append(Violation(
+                        rel, node.lineno, "proto-raw-allgather",
+                        "raw allgather_obj outside the lockstep "
+                        "wrapper/transport: agreement-shaped "
+                        "exchanges must ride resilience.retry."
+                        "lockstep_allgather(site=...) so torn "
+                        "payloads retry on all ranks together",
+                    ))
+
+    if rel != TAGS_MODULE:
+        for lineno, cname, value in _tag_constant_assigns(tree):
+            sites.append(ExchangeSite(rel, lineno, "tag_constant",
+                                      tag=f"{cname}={value}",
+                                      tag_source="literal"))
+            if not _allowed(lines, lineno, "proto-magic-tag"):
+                out.append(Violation(
+                    rel, lineno, "proto-magic-tag",
+                    f"hand-reserved tag constant {cname} = {value} "
+                    "outside resilience/tags.py; register the range "
+                    "there so overlap is checked at import",
+                ))
+
+    for lineno, fname in _atomic_write_functions(tree):
+        sites.append(ExchangeSite(rel, lineno, "atomic_write",
+                                  site=fname))
+        if rel not in MANIFEST_SANCTIONED and not _allowed(
+            lines, lineno, "proto-adhoc-manifest"
+        ):
+            out.append(Violation(
+                rel, lineno, "proto-adhoc-manifest",
+                f"{fname}() hand-rolls an atomic JSON write "
+                "(json.dump + rename); route through "
+                "resilience.elastic.write_manifest so the commit "
+                "semantics cannot fork per call site",
+            ))
+    return sites, out
+
+
+# ----------------------------------------------------------------------
+# cross-file rules + drivers
+# ----------------------------------------------------------------------
+def _lines_of(path: str) -> List[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read().splitlines()
+    except (OSError, UnicodeDecodeError):
+        return []
+
+
+def default_targets(root: Optional[str] = None) -> List[str]:
+    """The package only: tests construct divergent protocols on
+    purpose, and benchmarks/examples exchange through the package's
+    audited call sites."""
+    root = root or repo_root()
+    return [os.path.join(root, "chainermn_tpu")]
+
+
+def run_protolint(paths: Optional[Sequence[str]] = None,
+                  root: Optional[str] = None
+                  ) -> Tuple[ProtocolCatalog, List[Violation]]:
+    """Build the catalog over ``paths`` (default: the package) and
+    return it with every catalog-rule violation."""
+    root = root or repo_root()
+    targets = list(paths) if paths else default_targets(root)
+    sites: List[ExchangeSite] = []
+    out: List[Violation] = []
+    for t in targets:
+        for f in _iter_py_files(t):
+            s, v = scan_file(f, root)
+            sites.extend(s)
+            out.extend(v)
+    # global site-name uniqueness (literal/resolved sites only; dynamic
+    # f-string prefixes discriminate by construction)
+    by_name: Dict[str, List[ExchangeSite]] = {}
+    for s in sites:
+        if s.kind == "lockstep" and not s.dynamic and s.site:
+            by_name.setdefault(s.site, []).append(s)
+    for name, dupes in sorted(by_name.items()):
+        if len(dupes) <= 1:
+            continue
+        spots = ", ".join(f"{d.path}:{d.line}" for d in dupes)
+        for d in dupes:
+            if _allowed(_lines_of(os.path.join(root, d.path)),
+                        d.line, "proto-duplicate-site"):
+                continue
+            out.append(Violation(
+                d.path, d.line, "proto-duplicate-site",
+                f"agreement site {name!r} is declared at multiple "
+                f"call sites ({spots}); site names must be globally "
+                "unique so retries and recorded protocols are "
+                "unambiguous",
+            ))
+    return ProtocolCatalog(sites), sorted(
+        set(out), key=lambda v: (v.path, v.line, v.rule)
+    )
+
+
+def build_catalog(paths: Optional[Sequence[str]] = None,
+                  root: Optional[str] = None) -> ProtocolCatalog:
+    return run_protolint(paths, root)[0]
+
+
+def catalog_violations(paths: Optional[Sequence[str]] = None,
+                       root: Optional[str] = None) -> List[Violation]:
+    """What ``analysis.lint --host-protocol`` folds into the gate."""
+    return run_protolint(paths, root)[1]
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv and argv[0] in ("-h", "--help"):
+        print(__doc__)
+        return 0
+    catalog, violations = run_protolint(argv or None)
+    print(catalog.render())
+    for v in violations:
+        print(v)
+    if violations:
+        print(f"protolint: {len(violations)} violation(s)",
+              file=sys.stderr)
+        return 1
+    print("protolint: clean")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,6 +26,8 @@ import numpy as np
 from .communicator_base import dumps, loads
 from ..observability import timeline as _obs
 from ..resilience import fault_injection as _fi
+from ..resilience import protocol as _proto
+from ..resilience import tags as _tags
 from ..resilience.errors import PayloadCorruptionError
 from ..resilience.retry import RetryPolicy, call_with_retry
 
@@ -95,15 +97,17 @@ class LocalObjStore:
         self._size = size
         self._mail: dict = collections.defaultdict(collections.deque)
 
-    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+    def send(self, obj: Any, dest: int, tag: int = _tags.DEFAULT) -> None:
         _check_rank(dest, self._size, "dest")
         with _obs.span("obj_store.send", peer=dest) as sp:
             payload = _maybe_fault("obj_store.send", peer=dest,
                                    payload=dumps(obj))
             sp.set(bytes=len(payload))
             self._mail[(dest, tag)].append(payload)
+            _proto.record_op("send", tag=tag, peer=dest, payload=payload)
 
-    def recv(self, source: int, tag: int = 0, dest: int = 0) -> Any:
+    def recv(self, source: int, tag: int = _tags.DEFAULT,
+             dest: int = 0) -> Any:
         """Drain the mailbox of rank ``dest``.
 
         Under one controller there is no ambient "my rank", so the receiving
@@ -125,9 +129,12 @@ class LocalObjStore:
                 )
             payload = box.popleft()
             sp.set(bytes=len(payload))
+            # local recv has no ambient "my rank": the mailbox owner
+            # (dest) stands in as the recorded peer
+            _proto.record_op("recv", tag=tag, peer=dest, payload=payload)
             return _loads_checked(payload, "obj_store.recv", dest)
 
-    def recv_for(self, dest: int, tag: int = 0) -> Any:
+    def recv_for(self, dest: int, tag: int = _tags.DEFAULT) -> Any:
         return self.recv(source=-1, tag=tag, dest=dest)
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
@@ -138,6 +145,7 @@ class LocalObjStore:
             payload = _maybe_fault("obj_store.exchange", peer=root,
                                    payload=dumps(obj))
             sp.set(bytes=len(payload))
+            _proto.record_op("exchange", payload=payload)
             return _loads_checked(payload, "obj_store.exchange", root)
 
     def gather(self, obj: Any, root: int = 0) -> list:
@@ -146,6 +154,7 @@ class LocalObjStore:
             payload = _maybe_fault("obj_store.exchange", peer=root,
                                    payload=dumps(obj))
             sp.set(bytes=len(payload))
+            _proto.record_op("exchange", payload=payload)
             return [_loads_checked(payload, "obj_store.exchange", root)
                     for _ in range(self._size)]
 
@@ -154,6 +163,7 @@ class LocalObjStore:
             payload = _maybe_fault("obj_store.exchange",
                                    payload=dumps(obj))
             sp.set(bytes=len(payload))
+            _proto.record_op("exchange", payload=payload)
             return [_loads_checked(payload, "obj_store.exchange")
                     for _ in range(self._size)]
 
@@ -238,18 +248,24 @@ class MultiprocessObjStore:
             ]
             maxlen = max(lengths)
             if maxlen <= r1 - hdr:
-                return [
+                out = [
                     g1[q, hdr:hdr + lengths[q]].tobytes()
                     for q in range(nproc)
                 ]
-            bucket = max(1 << max(maxlen - 1, 0).bit_length(), r1)
-            buf2 = np.zeros((bucket,), np.uint8)
-            arr = np.frombuffer(p, np.uint8)
-            buf2[: arr.size] = arr
-            g2 = multihost_utils.process_allgather(buf2)
-            return [
-                g2[q, : lengths[q]].tobytes() for q in range(nproc)
-            ]
+            else:
+                bucket = max(1 << max(maxlen - 1, 0).bit_length(), r1)
+                buf2 = np.zeros((bucket,), np.uint8)
+                arr = np.frombuffer(p, np.uint8)
+                buf2[: arr.size] = arr
+                g2 = multihost_utils.process_allgather(buf2)
+                out = [
+                    g2[q, : lengths[q]].tobytes() for q in range(nproc)
+                ]
+            # recorded on transport SUCCESS only (a lockstep retry
+            # re-records on every rank together, so attempt counts
+            # stay symmetric); the digest is this rank's contribution
+            _proto.record_op("exchange", payload=payload)
+            return out
 
     def bcast(self, obj: Any, root: int = 0) -> Any:
         """Every process returns the payload contributed by the process
@@ -288,7 +304,7 @@ class MultiprocessObjStore:
             )
         return client
 
-    def send(self, obj: Any, dest: int, tag: int = 0) -> None:
+    def send(self, obj: Any, dest: int, tag: int = _tags.DEFAULT) -> None:
         with _obs.span("obj_store.send", peer=dest) as sp:
             self._send(obj, dest, tag, sp)
 
@@ -319,8 +335,10 @@ class MultiprocessObjStore:
 
         call_with_retry(publish, site="obj_store.send", peer=dest,
                         policy=_obj_policy())
+        _proto.record_op("send", tag=tag, peer=dest, payload=payload)
 
-    def recv(self, source: int, tag: int = 0, dest: int = None) -> Any:
+    def recv(self, source: int, tag: int = _tags.DEFAULT,
+             dest: int = None) -> Any:
         if dest is not None and dest != jax.process_index():
             raise ValueError(
                 f"multi-process recv_obj can only receive for this process "
@@ -359,6 +377,7 @@ class MultiprocessObjStore:
             data = call_with_retry(attempt, site="obj_store.recv",
                                    peer=source, policy=policy)
             sp.set(bytes=len(data))
+        _proto.record_op("recv", tag=tag, peer=source, payload=data)
         return _loads_checked(data, "obj_store.recv", source)
 
 

@@ -119,7 +119,10 @@ class _MultiNodeCheckpointer:
     def _available_steps(self) -> list:
         steps = []
         if os.path.isdir(self._root):
-            for d in os.listdir(self._root):
+            # sorted: the step inventory feeds newest_common_step's
+            # cross-rank agreement; listdir order must not differ per
+            # host (spmd-unsorted-scan)
+            for d in sorted(os.listdir(self._root)):
                 m = re.fullmatch(r"step_(\d+)", d)
                 if m and self._is_complete(os.path.join(self._root, d)):
                     steps.append(int(m.group(1)))
@@ -252,7 +255,7 @@ class _MultiNodeCheckpointer:
         tmp = f"{target}.tmp{os.getpid()}"
         # glob.escape: a checkpoint path containing [ ? * is legal and
         # must not silently skip the stale-dir sweep
-        for stale in _glob.glob(f"{_glob.escape(target)}.tmp*"):
+        for stale in sorted(_glob.glob(f"{_glob.escape(target)}.tmp*")):
             shutil.rmtree(stale, ignore_errors=True)  # crashed saves
         os.makedirs(tmp)
         leaves, treedef = jax.tree_util.tree_flatten(state)
@@ -285,7 +288,7 @@ class _MultiNodeCheckpointer:
         # save of the same step, so they cannot accumulate or make the
         # rename-aside fail with ENOTEMPTY.
         old = f"{target}.old{os.getpid()}"
-        for stale in _glob.glob(f"{_glob.escape(target)}.old*"):
+        for stale in sorted(_glob.glob(f"{_glob.escape(target)}.old*")):
             shutil.rmtree(stale, ignore_errors=True)
         if os.path.exists(target):
             os.rename(target, old)

@@ -118,8 +118,15 @@ def create_multi_node_evaluator(actual_evaluator, communicator):
             self._comm = comm
 
         def evaluate(self, *a, **kw):
+            from ..resilience.retry import lockstep_allgather
+
             local = self._ev.evaluate(*a, **kw)
-            gathered = self._comm.allgather_obj(local)
+            # agreement-shaped: every rank folds every rank's metrics,
+            # so a torn payload must retry on all ranks together
+            # (proto-raw-allgather)
+            gathered = lockstep_allgather(
+                self._comm, local, site="evaluator.aggregate"
+            )
             keys = gathered[0].keys()
             return {
                 k: float(np.mean([g[k] for g in gathered])) for k in keys

@@ -36,6 +36,7 @@ from ..resilience.log import ResilienceLog, event_row
 
 _EVENTS_RE = re.compile(r"(?P<label>.+)_p(?P<pid>\d+)(?:_trainer)?_events\.jsonl$")
 _TRACE_RE = re.compile(r"(?P<label>.+)_p(?P<pid>\d+)_trace\.jsonl$")
+_PROTOCOL_RE = re.compile(r"(?P<label>.+)_p(?P<pid>\d+)_protocol\.jsonl$")
 
 
 def export_resilience_log(log: ResilienceLog, path: str) -> str:
@@ -76,8 +77,14 @@ class FleetReport:
     ``span:<name>`` for telemetry spans), ``site``, ``info``.
     """
 
-    def __init__(self, entries: List[dict]):
+    def __init__(self, entries: List[dict],
+                 protocol: Optional[Dict[tuple, List[dict]]] = None):
         self.entries = sorted(entries, key=lambda e: e["wall"])
+        # (leg, pid) -> ordered recorder rows from
+        # {label}_p{k}_protocol.jsonl (the host-protocol recorder's
+        # export) — kept apart from the wall-clock timeline because a
+        # protocol is an ORDERED SEQUENCE contract, not an instant
+        self.protocol: Dict[tuple, List[dict]] = protocol or {}
 
     # -- construction ---------------------------------------------------
     @classmethod
@@ -137,7 +144,16 @@ class FleetReport:
                     "info": dict(row.get("args") or {},
                                  dur=row.get("dur")),
                 })
-        return cls(entries)
+        protocol: Dict[tuple, List[dict]] = {}
+        for path in sorted(glob.glob(
+                os.path.join(scratch, "*_protocol.jsonl"))):
+            m = _PROTOCOL_RE.search(os.path.basename(path))
+            if not m:
+                continue
+            rows = [r for r in _read_jsonl(path) if "token" in r]
+            rows.sort(key=lambda r: r.get("seq", 0))
+            protocol[(m.group("label"), int(m.group("pid")))] = rows
+        return cls(entries, protocol)
 
     # -- queries --------------------------------------------------------
     def filter(self, *, legs: Optional[List[str]] = None,
@@ -157,7 +173,7 @@ class FleetReport:
             if (legs_s is None or e["leg"] in legs_s)
             and (kinds_s is None or e["kind"] in kinds_s)
             and (procs_s is None or e["process"] in procs_s)
-        ])
+        ], self.protocol)
 
     def between(self, t0: Optional[float] = None,
                 t1: Optional[float] = None) -> "FleetReport":
@@ -168,7 +184,7 @@ class FleetReport:
             e for e in self.entries
             if (t0 is None or e["wall"] >= float(t0))
             and (t1 is None or e["wall"] <= float(t1))
-        ])
+        ], self.protocol)
 
     def events(self, kind: Optional[str] = None) -> List[dict]:
         if kind is None:
@@ -195,6 +211,44 @@ class FleetReport:
         for e in self.entries:
             out.setdefault(e["leg"], set()).add(e["process"])
         return {k: sorted(v) for k, v in out.items()}
+
+    def protocol_sequences(self, leg: Optional[str] = None
+                           ) -> Dict[int, List[str]]:
+        """pid -> ordered symmetric exchange tokens for ``leg`` (the
+        only leg when ``None`` and unambiguous).  Tokens are the
+        recorder's ``exchange|site`` / ``op|tag=..|peer=+d`` strings;
+        by-design-asymmetric rows (peer-ckpt healing) are excluded,
+        mirroring :meth:`ProtocolRecorder.signature`."""
+        legs = sorted({l for (l, _pid) in self.protocol})
+        if leg is None:
+            if len(legs) > 1:
+                raise ValueError(
+                    f"protocol_sequences: multiple legs {legs}; pick one"
+                )
+            leg = legs[0] if legs else None
+        return {
+            pid: [r["token"] for r in rows if not r.get("asymmetric")]
+            for (l, pid), rows in sorted(self.protocol.items())
+            if l == leg
+        }
+
+    def protocol_divergence(self, leg: Optional[str] = None
+                            ) -> Optional[dict]:
+        """The first index where the per-process exchange sequences
+        disagree: ``{"leg", "index", "tokens": {pid: token-or-None}}``,
+        or ``None`` when every recorded process agrees (or fewer than
+        two processes left a protocol file)."""
+        seqs = self.protocol_sequences(leg)
+        if len(seqs) < 2:
+            return None
+        legs = sorted({l for (l, _pid) in self.protocol})
+        leg = leg if leg is not None else (legs[0] if legs else None)
+        for i in range(max(len(s) for s in seqs.values())):
+            toks = {pid: (s[i] if i < len(s) else None)
+                    for pid, s in seqs.items()}
+            if len(set(toks.values())) > 1:
+                return {"leg": leg, "index": i, "tokens": toks}
+        return None
 
     # -- contracts ------------------------------------------------------
     def assert_order(self, *kinds: str) -> List[dict]:
@@ -231,9 +285,9 @@ class FleetReport:
         first entry."""
         rows = [e for e in self.entries
                 if include_spans or not e["kind"].startswith("span:")]
-        if not rows:
+        if not rows and not self.protocol:
             return "FleetReport(empty)"
-        t0 = rows[0]["wall"]
+        t0 = rows[0]["wall"] if rows else 0.0
         lines = [f"FleetReport: {len(rows)} event(s), "
                  f"legs {sorted({e['leg'] for e in rows})}"]
         shown = rows if max_rows is None else rows[:max_rows]
@@ -250,6 +304,17 @@ class FleetReport:
             )
         if max_rows is not None and len(rows) > max_rows:
             lines.append(f"  ... {len(rows) - max_rows} more")
+        for leg in sorted({l for (l, _pid) in self.protocol}):
+            div = self.protocol_divergence(leg)
+            if div is not None:
+                toks = ", ".join(
+                    f"p{pid}={tok!r}"
+                    for pid, tok in sorted(div["tokens"].items())
+                )
+                lines.append(
+                    f"  protocol divergence on leg {leg} at exchange "
+                    f"#{div['index']}: {toks}"
+                )
         return "\n".join(lines)
 
     def to_jsonl(self, path: str) -> str:

@@ -44,6 +44,9 @@ def _export_artifacts() -> None:
     tel = _CTX.get("telemetry")
     if tel is not None:
         tel.timeline.to_jsonl(_CTX["trace_path"], meta=True)
+    rec = _CTX.get("protocol")
+    if rec is not None:
+        rec.to_jsonl(_CTX["protocol_path"])
 
 
 def finish_and_exit(out: dict, code: int = 0,
@@ -75,7 +78,7 @@ def scenario_rendezvous(pid, nproc, scratch, label, args):
 
     comm = cmn.create_communicator(args.get("comm", "tpu"))
     assert comm.process_count == nproc, (comm.process_count, nproc)
-    got = _lockstep_allgather(comm, pid)
+    got = _lockstep_allgather(comm, pid, site="fleet.rendezvous")
     assert got == list(range(nproc)), got
     inj = fi.active()
     counts = dict(inj.log.counts) if inj is not None else {}
@@ -172,7 +175,7 @@ def scenario_chain_leg(pid, nproc, scratch, label, args):
 
         wave_at = int(wave_at)
         comm = cmn.create_communicator("tpu")
-        got = _lockstep_allgather(comm, pid)
+        got = _lockstep_allgather(comm, pid, site="fleet.chain_leg.rendezvous")
         assert got == list(range(nproc)), got
         opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
         p0 = {"w": jnp.zeros((dim,))}
@@ -347,7 +350,7 @@ def scenario_adaptive_leg(pid, nproc, scratch, label, args):
     n_steps = int(args["n_steps"])
 
     comm = cmn.create_communicator("tpu")
-    got = _lockstep_allgather(comm, pid)
+    got = _lockstep_allgather(comm, pid, site="fleet.adaptive_leg.rendezvous")
     assert got == list(range(nproc)), got
 
     # the SAME pieces (loss, ZeRO sgd+momentum optimizer, step, and —
@@ -459,7 +462,7 @@ def scenario_grow_leg(pid, nproc, scratch, label, args):
     n_steps = int(args["n_steps"])
 
     comm = cmn.create_communicator("tpu")
-    got = _lockstep_allgather(comm, pid)
+    got = _lockstep_allgather(comm, pid, site="fleet.grow_leg.rendezvous")
     assert got == list(range(nproc)), got
 
     # the SAME pieces (and checkpointer root) as every chain leg, so
@@ -739,7 +742,7 @@ def scenario_peer_recover_leg(pid, nproc, scratch, label, args):
     assert 1 < lose_at <= n_steps, (lose_at, n_steps)
 
     comm = cmn.create_communicator("tpu")
-    got = _lockstep_allgather(comm, pid)
+    got = _lockstep_allgather(comm, pid, site="fleet.peer_recover.rendezvous")
     assert got == list(range(nproc)), got
     opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
     peer = PeerCheckpointStore(comm) if tier == "peer" else None
@@ -842,7 +845,7 @@ def scenario_peer_ring_broken(pid, nproc, scratch, label, args):
     assert victim != 0, "process 0 is the jax.distributed coordinator"
 
     comm = cmn.create_communicator("tpu")
-    got = _lockstep_allgather(comm, pid)
+    got = _lockstep_allgather(comm, pid, site="fleet.ring_broken.rendezvous")
     assert got == list(range(nproc)), got
     opt, step, ckpt, rows = _chain_pieces(comm, scratch, lr, mom, dim)
     peer = PeerCheckpointStore(comm)
@@ -1447,9 +1450,21 @@ def main():
         os.path.join(scratch, f"{label}_p{pid}_events.jsonl")
     )
     attach(sink)
+    # opt-in host-protocol recorder (CHAINERMN_TPU_PROTOCOL_RECORD=1):
+    # every obj-store exchange this worker issues is logged in order,
+    # exported next to the trace for FleetReport.protocol_divergence
+    from chainermn_tpu.resilience import protocol as _proto
+
+    rec = _proto.install_from_env(
+        label=f"{label}_p{pid}", rank=pid, world=nproc
+    )
     _CTX.update(
         telemetry=tel,
         trace_path=os.path.join(scratch, f"{label}_p{pid}_trace.jsonl"),
+        protocol=rec,
+        protocol_path=os.path.join(
+            scratch, f"{label}_p{pid}_protocol.jsonl"
+        ),
     )
 
     out = globals()[f"scenario_{scenario}"](pid, nproc, scratch, label,

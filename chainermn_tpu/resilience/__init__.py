@@ -16,6 +16,7 @@ from .errors import (  # noqa: F401
     DemotionRequiredError,
     PayloadCorruptionError,
     PreemptionError,
+    ProtocolDivergenceError,
     ResilienceError,
     RestartBudgetExceededError,
     StepDivergedError,
@@ -37,7 +38,10 @@ from .fault_injection import (  # noqa: F401
     install,
 )
 from .log import ResilienceEvent, ResilienceLog, attach, detach, emit  # noqa: F401
+from . import protocol  # noqa: F401  (host-protocol recorder, ISSUE 20)
+from . import tags  # noqa: F401  (central obj-store tag registry)
 from .peer_ckpt import PeerCheckpointStore  # noqa: F401  (RAM recovery tier)
+from .protocol import ProtocolRecorder  # noqa: F401
 from .retry import (  # noqa: F401
     DEFAULT_POLICY,
     RetryPolicy,

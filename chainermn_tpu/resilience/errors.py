@@ -158,6 +158,21 @@ class AdaptDecisionMismatchError(ResilienceError):
     recoverable = False
 
 
+class ProtocolDivergenceError(ResilienceError):
+    """Processes issued host-side (obj-store) exchanges in divergent
+    orders (the host-protocol guard of ``analysis.protocol_agreement``
+    — the control-plane twin of :class:`CollectiveTraceMismatchError`).
+    Raised on EVERY rank together, before whichever exchange mis-pairs
+    first can block: the agreement itself rides the lockstep retry, so
+    all ranks observe the same per-rank sequence summaries and raise
+    as one.  NOT recoverable: restarting replays the same divergent
+    host protocol — the rank-dependent control flow (an unsorted scan,
+    a ``hash()``-keyed decision, an unguarded extra exchange) must be
+    fixed at the source."""
+
+    recoverable = False
+
+
 class RestartBudgetExceededError(ResilienceError):
     """Auto-resume gave up: more recoverable failures than
     ``max_restarts``.  Carries the last underlying error as

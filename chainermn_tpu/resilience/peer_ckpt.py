@@ -68,14 +68,18 @@ import numpy as np
 
 from ..observability import timeline as _obs
 from . import elastic as _elastic
+from . import protocol as _proto
 from .errors import PayloadCorruptionError, WorldResizeRequiredError
 from .log import emit
 from .retry import lockstep_allgather
 
-# dedicated obj-store tag for ring payloads: the mailbox/KV keyspace is
-# (peer, tag)-addressed, so replica traffic can never interleave with
-# user sends or the agreement exchanges
-PEER_TAG = 7919
+# dedicated obj-store tags for ring payloads: the mailbox/KV keyspace
+# is (peer, tag)-addressed, so replica traffic can never interleave
+# with user sends or the agreement exchanges.  Both the ring tag and
+# the per-owner restore streams are reserved ranges in the central
+# registry (resilience.tags) — protolint rejects any stray literal
+from .tags import PEER_CKPT_RING as PEER_TAG
+from .tags import peer_owner_tag
 
 REPLICATE_SITE = "peer_ckpt.replicate"
 RESTORE_SITE = "peer_ckpt.restore"
@@ -449,24 +453,29 @@ class PeerCheckpointStore:
                     o: providers[o] for o in range(self._world)
                     if o not in holders.get(o, ())
                 }
-                for o, p in sorted(need.items()):
-                    if p == self._rank:
-                        self._comm.send_obj(
-                            self._held[(step, sk, o)], dest=o,
-                            tag=PEER_TAG + 1 + o,
-                        )
                 nbytes = 0
-                if self._rank in need:
-                    env = self._comm.recv_obj(
-                        source=need[self._rank],
-                        tag=PEER_TAG + 1 + self._rank,
-                    )
-                    nbytes = int(env["nbytes"])
-                    # verified + re-held: the healed rank owns its own
-                    # copy again for the next replicate/election round
-                    self._ingest(env)
-                else:
-                    env = self._held[(step, sk, self._rank)]
+                # asymmetric BY DESIGN: only providers send, only the
+                # needy receive — excluded from the host-protocol
+                # agreement signature (still logged for post-mortems)
+                with _proto.asymmetric():
+                    for o, p in sorted(need.items()):
+                        if p == self._rank:
+                            self._comm.send_obj(
+                                self._held[(step, sk, o)], dest=o,
+                                tag=peer_owner_tag(o),
+                            )
+                    if self._rank in need:
+                        env = self._comm.recv_obj(
+                            source=need[self._rank],
+                            tag=peer_owner_tag(self._rank),
+                        )
+                        nbytes = int(env["nbytes"])
+                        # verified + re-held: the healed rank owns its
+                        # own copy again for the next replicate/
+                        # election round
+                        self._ingest(env)
+                    else:
+                        env = self._held[(step, sk, self._rank)]
                 if hashlib.sha256(
                     env["blob"]
                 ).hexdigest() != env["digest"]:
@@ -492,18 +501,22 @@ class PeerCheckpointStore:
                         o: self._held[(step, sk, o)]
                         for o, p in providers.items() if p == self._rank
                     }
-                    for o, env in sorted(mine.items()):
-                        for r in range(self._world):
-                            if r != self._rank:
-                                self._comm.send_obj(
-                                    env, dest=r, tag=PEER_TAG + 1 + o
+                    # asymmetric BY DESIGN (rank-dependent send/recv
+                    # counts): excluded from the protocol signature
+                    with _proto.asymmetric():
+                        for o, env in sorted(mine.items()):
+                            for r in range(self._world):
+                                if r != self._rank:
+                                    self._comm.send_obj(
+                                        env, dest=r,
+                                        tag=peer_owner_tag(o),
+                                    )
+                        envs: Dict[int, dict] = dict(mine)
+                        for o, p in sorted(providers.items()):
+                            if p != self._rank:
+                                envs[o] = self._comm.recv_obj(
+                                    source=p, tag=peer_owner_tag(o)
                                 )
-                    envs: Dict[int, dict] = dict(mine)
-                    for o, p in sorted(providers.items()):
-                        if p != self._rank:
-                            envs[o] = self._comm.recv_obj(
-                                source=p, tag=PEER_TAG + 1 + o
-                            )
                 else:
                     stores = self._ring_peers or {self._rank: self}
                     envs = {

@@ -1,0 +1,288 @@
+"""Host-protocol recorder: the obj-store twin of the jaxpr trace guard.
+
+``analysis.trace_agreement`` makes divergence in *compiled* programs a
+loud pre-deadlock error by exchanging trace hashes before the first
+collective.  The host control plane has the same failure mode with no
+equivalent guard: ranks that issue obj-store exchanges in different
+orders (an unsorted directory scan feeding a decision, a ``hash()``
+keyed branch, one rank taking an extra exchange) mis-pair whichever
+collective comes next and wedge the fleet silently.  This module is
+the runtime third layer of protolint (``analysis.protolint`` is the
+static catalog, ``analysis.lint --host-protocol`` the determinism
+rules): an opt-in :class:`ProtocolRecorder` on the obj store logs each
+rank's ordered ``(op, site|tag, payload digest)`` exchange sequence,
+and :func:`~chainermn_tpu.analysis.checks.protocol_agreement`
+exchanges order-sensitive sequence hashes through the lockstep retry,
+raising :class:`~chainermn_tpu.resilience.errors.
+ProtocolDivergenceError` on EVERY rank when the sequences differ.
+
+Activation mirrors fault injection and telemetry exactly: a
+module-global ``_ACTIVE`` that is ``None`` unless :func:`install` /
+:class:`observe` / the ``CHAINERMN_TPU_PROTOCOL_RECORD`` env var
+enabled a recorder, and the hot-path hook (:func:`record_op`) pays a
+single ``is None`` check when disabled — the same zero-overhead
+contract ``fault_injection.fire`` and ``observability.emit_point``
+pin.
+
+What the agreement hashes
+-------------------------
+The *symmetric* signature: one token per recorded op —
+``exchange|<site>`` for host collectives (the site is the lockstep
+agreement name installed by ``lockstep_allgather`` via
+:func:`exchange_site`), ``send|tag=..|peer=+k`` / ``recv|tag=..|peer=+k``
+for addressed traffic, with the peer normalized RELATIVE to this rank
+(``(peer - rank) % world``) so a symmetric ring (every rank sends to
+its successor) hashes identically on every rank.  Payload digests are
+recorded for the post-mortem but excluded from the hash — ranks'
+payloads legitimately differ.  Ops issued inside an
+:func:`asymmetric` block (peer-checkpoint restore heals, where only
+providers send and only the needy receive BY DESIGN) are logged but
+excluded from the signature.  A passed agreement advances a cursor
+(:meth:`ProtocolRecorder.mark_agreed`), so each check covers only the
+exchanges since the last one.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from typing import Any, List, Optional
+
+ENV_RECORD = "CHAINERMN_TPU_PROTOCOL_RECORD"
+
+_ACTIVE: Optional["ProtocolRecorder"] = None
+_TLS = threading.local()
+
+
+class _NullCtx:
+    """Shared no-op context — what the site/asymmetric markers return
+    when no recorder is active, so the disabled path allocates
+    nothing."""
+
+    __slots__ = ()
+
+    def __enter__(self):
+        return None
+
+    def __exit__(self, *exc):
+        return False
+
+
+_NULL = _NullCtx()
+
+
+class _SiteCtx:
+    __slots__ = ("site",)
+
+    def __init__(self, site: str):
+        self.site = site
+
+    def __enter__(self):
+        stack = getattr(_TLS, "sites", None)
+        if stack is None:
+            stack = _TLS.sites = []
+        stack.append(self.site)
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.sites.pop()
+        return False
+
+
+class _AsymCtx:
+    __slots__ = ()
+
+    def __enter__(self):
+        _TLS.asym = getattr(_TLS, "asym", 0) + 1
+        return self
+
+    def __exit__(self, *exc):
+        _TLS.asym -= 1
+        return False
+
+
+def exchange_site(site: str):
+    """Context manager naming the logical agreement site for obj-store
+    ops issued inside the block (``lockstep_allgather`` wraps its
+    exchange in this, so recorded collectives carry their ``site=``
+    string instead of an anonymous ``exchange``)."""
+    return _NULL if _ACTIVE is None else _SiteCtx(site)
+
+
+def asymmetric():
+    """Context manager marking obj-store ops that are asymmetric BY
+    DESIGN (rank-dependent send/recv counts — the peer-checkpoint
+    restore heal, where only providers send): the ops are still logged
+    for the post-mortem, but excluded from the agreement signature so
+    a legitimate heal cannot trip the guard."""
+    return _NULL if _ACTIVE is None else _AsymCtx()
+
+
+def current_site() -> Optional[str]:
+    stack = getattr(_TLS, "sites", None)
+    return stack[-1] if stack else None
+
+
+def _in_asymmetric() -> bool:
+    return getattr(_TLS, "asym", 0) > 0
+
+
+class ProtocolRecorder:
+    """Ordered record of this process's host-side exchanges.
+
+    ``rank``/``world`` enable relative-peer normalization in the
+    signature tokens (ring traffic hashes identically everywhere);
+    without them peers are recorded absolute and p2p tokens carry the
+    raw index — fine for single-process tests, wrong for a real ring.
+    """
+
+    def __init__(self, *, label: str = "", rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        self.label = label
+        self.rank = None if rank is None else int(rank)
+        self.world = None if world is None else int(world)
+        self._entries: List[dict] = []
+        self._agreed = 0  # entries[:_agreed] covered by a passed check
+        self._lock = threading.Lock()
+
+    # -- recording -------------------------------------------------------
+    def record(self, op: str, *, site: Optional[str] = None,
+               tag: Optional[int] = None, peer=None,
+               payload: Optional[bytes] = None,
+               nbytes: Optional[int] = None) -> None:
+        digest = None
+        if payload is not None:
+            if nbytes is None:
+                nbytes = len(payload)
+            digest = hashlib.sha256(payload).hexdigest()[:16]
+        entry = {
+            "op": op,
+            "site": site,
+            "tag": None if tag is None else int(tag),
+            "peer": None if peer is None else int(peer),
+            "nbytes": None if nbytes is None else int(nbytes),
+            "digest": digest,
+            "asymmetric": _in_asymmetric(),
+        }
+        with self._lock:
+            entry["seq"] = len(self._entries)
+            entry["token"] = self._token(entry)
+            self._entries.append(entry)
+
+    # -- sequences / signatures ------------------------------------------
+    def entries(self) -> List[dict]:
+        with self._lock:
+            return list(self._entries)
+
+    def __len__(self) -> int:
+        return len(self._entries)
+
+    def _token(self, e: dict) -> str:
+        if e["op"] == "exchange":
+            return f"exchange|{e['site'] or '?'}"
+        peer = e["peer"]
+        if peer is not None and self.rank is not None and self.world:
+            peer = f"+{(int(peer) - self.rank) % self.world}"
+        site = f"|{e['site']}" if e["site"] else ""
+        return f"{e['op']}|tag={e['tag']}|peer={peer}{site}"
+
+    def signature(self, *, since: int = 0) -> List[str]:
+        """Order-sensitive token sequence of the SYMMETRIC entries from
+        raw-entry index ``since`` on — what ranks must agree on."""
+        with self._lock:
+            return [e["token"] for e in self._entries[since:]
+                    if not e["asymmetric"]]
+
+    def window_signature(self) -> List[str]:
+        """The signature since the last passed agreement."""
+        return self.signature(since=self._agreed)
+
+    def mark_agreed(self) -> None:
+        """Advance the agreement cursor past everything recorded so
+        far (called by a PASSED ``protocol_agreement``)."""
+        with self._lock:
+            self._agreed = len(self._entries)
+
+    # -- export ----------------------------------------------------------
+    def to_jsonl(self, path: str) -> str:
+        """One entry per row, for the FleetReport post-mortem merge."""
+        d = os.path.dirname(path)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(path, "w", encoding="utf-8") as f:
+            for e in self.entries():
+                f.write(json.dumps(e) + "\n")
+        return path
+
+
+def signature_hash(signature: List[str]) -> str:
+    """Canonical hash of a token sequence (order-sensitive)."""
+    return hashlib.sha256("\n".join(signature).encode()).hexdigest()
+
+
+# -- activation ---------------------------------------------------------
+def active() -> Optional[ProtocolRecorder]:
+    return _ACTIVE
+
+
+def install(recorder: Optional[ProtocolRecorder]) -> None:
+    """Set (or clear, with ``None``) the process-global recorder."""
+    global _ACTIVE
+    _ACTIVE = recorder
+
+
+def record_op(op: str, *, tag: Optional[int] = None, peer=None,
+              payload: Optional[bytes] = None,
+              nbytes: Optional[int] = None) -> None:
+    """Hot-path hook at every obj-store transport site.
+
+    The un-instrumented fast path is this one ``is None`` check — no
+    digest, no allocation, no lock (the same contract as
+    ``fault_injection.fire``).
+    """
+    rec = _ACTIVE
+    if rec is None:
+        return
+    rec.record(op, site=current_site(), tag=tag, peer=peer,
+               payload=payload, nbytes=nbytes)
+
+
+class observe:
+    """Context manager: activate a recorder for a ``with`` block.
+
+        with protocol.observe(rank=0, world=2) as rec:
+            ...
+        rec.signature()
+
+    Nesting restores the previous recorder on exit."""
+
+    def __init__(self, *, label: str = "", rank: Optional[int] = None,
+                 world: Optional[int] = None):
+        self.recorder = ProtocolRecorder(label=label, rank=rank,
+                                         world=world)
+        self._prev: Optional[ProtocolRecorder] = None
+
+    def __enter__(self) -> ProtocolRecorder:
+        self._prev = _ACTIVE
+        install(self.recorder)
+        return self.recorder
+
+    def __exit__(self, *exc):
+        install(self._prev)
+        return False
+
+
+def install_from_env(*, label: str = "", rank: Optional[int] = None,
+                     world: Optional[int] = None
+                     ) -> Optional[ProtocolRecorder]:
+    """Activate from ``CHAINERMN_TPU_PROTOCOL_RECORD`` (any non-empty
+    value) — how spawned fleet/mp workers opt in without an object
+    reference.  Returns the installed recorder, or ``None`` when the
+    env leaves recording off."""
+    if not os.environ.get(ENV_RECORD):
+        return None
+    rec = ProtocolRecorder(label=label, rank=rank, world=world)
+    install(rec)
+    return rec

@@ -120,16 +120,21 @@ def lockstep_allgather(comm, payload, *, site: str,
     property is what makes the retry safe here when retrying ordinary
     one-sided host collectives would not be.  One helper so the retry
     semantics (attempt budget, retryable set) cannot drift apart
-    between the agreement sites."""
+    between the agreement sites.  The exchange runs under
+    ``protocol.exchange_site(site)``, so an active host-protocol
+    recorder logs it under its agreement name instead of an anonymous
+    ``exchange`` (a no-op when no recorder is installed)."""
+    from . import protocol as _proto
     from .errors import PayloadCorruptionError
 
-    return call_with_retry(
-        lambda: comm.allgather_obj(payload),
-        site=site,
-        policy=RetryPolicy(max_attempts=max_attempts),
-        retryable=lambda e: is_transient(e)
-        or isinstance(e, PayloadCorruptionError),
-    )
+    with _proto.exchange_site(site):
+        return call_with_retry(
+            lambda: comm.allgather_obj(payload),
+            site=site,
+            policy=RetryPolicy(max_attempts=max_attempts),
+            retryable=lambda e: is_transient(e)
+            or isinstance(e, PayloadCorruptionError),
+        )
 
 
 def resilient_call(site: str, fn: Callable, *, peer=None,

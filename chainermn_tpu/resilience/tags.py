@@ -1,0 +1,141 @@
+"""Central obj-store tag registry with reserved-range declarations.
+
+The obj store's mailbox / KV keyspace is ``(peer, tag)``-addressed, so
+two subsystems that pick the same tag can silently interleave their
+payload streams — the peer-checkpoint ring's ``PEER_TAG = 7919`` and
+its ``PEER_TAG + 1 + o`` per-owner arithmetic only avoided the user
+tag space by folklore.  This module makes the avoidance structural:
+every tag a subsystem hand-assigns is a :class:`TagRange` registered
+here, ranges are checked disjoint at import time, and the protolint
+catalog (``analysis.protolint``) rejects any ``send_obj``/``recv_obj``
+tag literal that does not resolve back to this registry — so two
+subsystems can never collide without failing the repo gate first.
+
+Reserved ranges
+---------------
+``default``            tag 0 — the untagged send/recv stream (the obj
+                       store's parameter default).
+``user``               1..4095 — application payloads (tests, examples,
+                       ad-hoc point-to-point traffic).
+``peer_ckpt.ring``     7919 — ring replica payloads
+                       (``peer_ckpt.replicate``).
+``peer_ckpt.restore``  7920..8943 — per-owner restore streams
+                       (:func:`peer_owner_tag`); one tag per owner rank
+                       so a resize reassembly's point-to-point streams
+                       can never interleave across owners.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from typing import Dict, List, Optional
+
+
+@dataclass(frozen=True)
+class TagRange:
+    """One reserved, half-open tag range ``[start, start + length)``."""
+
+    name: str
+    start: int
+    length: int
+    doc: str = ""
+
+    def __post_init__(self):
+        if self.start < 0:
+            raise ValueError(f"{self.name}: start must be >= 0, got "
+                             f"{self.start}")
+        if self.length < 1:
+            raise ValueError(f"{self.name}: length must be >= 1, got "
+                             f"{self.length}")
+
+    @property
+    def stop(self) -> int:
+        """Exclusive end."""
+        return self.start + self.length
+
+    def __contains__(self, tag: int) -> bool:
+        return self.start <= int(tag) < self.stop
+
+    def tag(self, offset: int = 0) -> int:
+        """The tag at ``offset`` into the range, bounds-checked — the
+        sanctioned spelling of what used to be ``PEER_TAG + 1 + o``
+        arithmetic (which could walk out of its reservation without
+        anyone noticing)."""
+        offset = int(offset)
+        if not 0 <= offset < self.length:
+            raise ValueError(
+                f"tag offset {offset} outside reserved range "
+                f"{self.name!r} [{self.start}, {self.stop})"
+            )
+        return self.start + offset
+
+
+_REGISTRY: Dict[str, TagRange] = {}
+
+
+def register(name: str, start: int, length: int = 1,
+             doc: str = "") -> TagRange:
+    """Reserve ``[start, start + length)`` under ``name``.  Raises on a
+    duplicate name or any overlap with an existing reservation — the
+    collision is an import-time error, not a runtime interleave."""
+    rng = TagRange(name, int(start), int(length), doc)
+    if name in _REGISTRY:
+        raise ValueError(f"tag range {name!r} already registered")
+    for other in _REGISTRY.values():
+        if rng.start < other.stop and other.start < rng.stop:
+            raise ValueError(
+                f"tag range {name!r} [{rng.start}, {rng.stop}) overlaps "
+                f"{other.name!r} [{other.start}, {other.stop})"
+            )
+    _REGISTRY[name] = rng
+    return rng
+
+
+def ranges() -> List[TagRange]:
+    """Every reservation, ordered by start."""
+    return sorted(_REGISTRY.values(), key=lambda r: r.start)
+
+
+def owner_range(tag: int) -> Optional[TagRange]:
+    """The reservation containing ``tag``, or ``None``."""
+    for rng in _REGISTRY.values():
+        if tag in rng:
+            return rng
+    return None
+
+
+# -- the reservations --------------------------------------------------
+_DEFAULT = register(
+    "default", 0, 1,
+    "the untagged send/recv stream (obj-store parameter default)",
+)
+_USER = register(
+    "user", 1, 4095,
+    "application payloads: tests, examples, ad-hoc point-to-point",
+)
+_PEER_RING = register(
+    "peer_ckpt.ring", 7919, 1,
+    "peer-checkpoint ring replica payloads (peer_ckpt.replicate)",
+)
+_PEER_RESTORE = register(
+    "peer_ckpt.restore", 7920, 1024,
+    "per-owner peer-checkpoint restore streams (one tag per owner rank)",
+)
+
+DEFAULT = _DEFAULT.start
+PEER_CKPT_RING = _PEER_RING.start
+MAX_PEER_RESTORE_OWNERS = _PEER_RESTORE.length
+
+
+def user_tag(offset: int) -> int:
+    """A tag in the application range (``user``)."""
+    return _USER.tag(int(offset) - _USER.start)
+
+
+def peer_owner_tag(owner: int) -> int:
+    """The restore-stream tag for ring owner ``owner`` — the registered
+    spelling of the old ``PEER_TAG + 1 + owner`` arithmetic, bounds-
+    checked against the declared reservation so a ring wider than the
+    reserved range fails loudly instead of bleeding into foreign
+    tags."""
+    return _PEER_RESTORE.tag(int(owner))

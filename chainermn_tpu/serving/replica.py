@@ -114,7 +114,9 @@ class RequestJournal:
 
     def results(self) -> dict:
         out = {}
-        for name in os.listdir(self.root):
+        # sorted: listdir order is filesystem-dependent and this scan
+        # feeds cross-replica decisions (spmd-unsorted-scan)
+        for name in sorted(os.listdir(self.root)):
             if not (name.startswith("res_") and name.endswith(".json")):
                 continue
             try:
@@ -204,7 +206,7 @@ class RequestJournal:
         else:
             pat = r"drain_(\d+)\.json"
         out = []
-        for name in os.listdir(self.root):
+        for name in sorted(os.listdir(self.root)):
             m = re.fullmatch(pat, name)
             if m:
                 out.append(int(m.group(1)))
@@ -226,7 +228,7 @@ class RequestJournal:
     def handoffs(self) -> List[str]:
         """Request ids with a published handoff."""
         out = []
-        for name in os.listdir(self.handoff_dir()):
+        for name in sorted(os.listdir(self.handoff_dir())):
             m = re.fullmatch(r"kv_(.+)\.npz", name)
             if m:
                 out.append(m.group(1))

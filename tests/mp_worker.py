@@ -1196,6 +1196,79 @@ def scenario_trace_divergence(pid, nproc, scratch):
     )
 
 
+def scenario_protocol_divergence(pid, nproc, scratch):
+    """ISSUE 20: the HOST-protocol guard fires on every rank before a
+    divergent control plane can deadlock.
+
+    Phase 1 proves the guard rides the lockstep retry: symmetric
+    obj-store traffic, then ``protocol_agreement`` with a truncate
+    fault injected on the guard's OWN agreement exchange — every
+    process observes the torn payload (``PayloadCorruptionError``),
+    every process retries together, and the agreement succeeds with
+    identical hashes.
+
+    Phase 2 diverges the protocol two ways at once: the rank named by
+    CHAINERMN_TPU_DIVERGE_RANK issues an EXTRA obj-store ``send_obj``
+    (a non-blocking KV publish — deliberately chosen so the world is
+    still alive for the guard; an extra host *collective* would
+    deadlock at transport before any check could run), and the two
+    ranks issue their two lockstep agreement sites in OPPOSITE order
+    (transport still pairs — both run two allgathers — but the ordered
+    site tokens differ).  ``protocol_agreement`` must raise the
+    non-recoverable ``ProtocolDivergenceError`` on BOTH ranks."""
+    from chainermn_tpu.analysis.checks import protocol_agreement
+    from chainermn_tpu.resilience import fault_injection as fi
+    from chainermn_tpu.resilience import protocol as proto
+    from chainermn_tpu.resilience.errors import ProtocolDivergenceError
+    from chainermn_tpu.resilience.retry import lockstep_allgather
+
+    # install BEFORE the communicator so world-formation exchanges are
+    # recorded symmetrically on every rank (launcher sets the env)
+    rec = proto.install_from_env(label=f"protodiv_p{pid}", rank=pid,
+                                 world=nproc)
+    assert rec is not None, "CHAINERMN_TPU_PROTOCOL_RECORD must be set"
+    comm = _comm()
+    diverge = int(os.environ["CHAINERMN_TPU_DIVERGE_RANK"])
+
+    # -- phase 1: symmetric traffic; torn payload on the guard itself --
+    comm.send_obj({"pid": pid}, dest=(pid + 1) % nproc, tag=7)
+    got = comm.recv_obj(source=(pid - 1) % nproc, tag=7)
+    assert got == {"pid": (pid - 1) % nproc}, got
+    lockstep_allgather(comm, pid, site="mp.protocol.phase1")
+    with fi.inject_faults([
+        fi.FaultSpec("obj_store.exchange", "truncate", at=[1])
+    ]):
+        # each process truncates its own outgoing agreement payload on
+        # attempt 1; ALL observe the corruption, ALL retry in lockstep
+        h1 = protocol_agreement(comm, label="phase1")
+        inj = fi.active()
+        assert inj.log.counts.get("fault_injected", 0) >= 1, (
+            "the truncate fault must have fired on the guard's exchange"
+        )
+
+    # -- phase 2: one extra KV publish + swapped agreement-site order --
+    if pid == diverge:
+        comm.send_obj({"extra": True}, dest=(pid + 1) % nproc, tag=6)
+    sites = ["mp.protocol.siteA", "mp.protocol.siteB"]
+    if pid == diverge:
+        sites.reverse()
+    for s in sites:
+        lockstep_allgather(comm, pid, site=s)
+    try:
+        protocol_agreement(comm, label="phase2")
+    except ProtocolDivergenceError as e:
+        assert e.recoverable is False
+        # export for the FleetReport merge the spawning test asserts on
+        rec.to_jsonl(os.path.join(
+            scratch, f"protodiv_p{pid}_protocol.jsonl"
+        ))
+        return {"raised": type(e).__name__, "phase1": h1,
+                "entries": len(rec)}
+    raise AssertionError(
+        "host-protocol guard did not fire on a divergent world"
+    )
+
+
 def scenario_mismatched_sharding(pid, nproc, scratch):
     """ISSUE 6 satellite: rank 1 is handed a MISMATCHED input sharding
     (row-sharded where every other rank declares replicated), so its

@@ -388,3 +388,228 @@ class TestRawTimingRule:
                 return c.time()
         """)
         assert vs == []
+
+
+# ----------------------------------------------------------------------
+# host-protocol rules (ISSUE 20): spmd-hash / spmd-unsorted-scan /
+# spmd-random, scoped to DECISION_MODULES, behind --host-protocol
+# ----------------------------------------------------------------------
+class TestSpmdRules:
+    def _lint_decision(self, tmp_path, src,
+                       name="chainermn_tpu/serving/mod.py"):
+        p = tmp_path / name
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        return lint_file(str(p), str(tmp_path), host_protocol=True)
+
+    def test_builtin_hash_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            def pick(key, n):
+                return hash(key) % n
+        """)
+        assert [v.rule for v in vs] == ["spmd-hash"]
+
+    def test_hashlib_not_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import hashlib
+            def pick(key, n):
+                return int(hashlib.sha256(key).hexdigest(), 16) % n
+        """)
+        assert vs == []
+
+    def test_unsorted_listdir_iteration_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import os
+            def scan(root):
+                for name in os.listdir(root):
+                    yield name
+        """)
+        assert [v.rule for v in vs] == ["spmd-unsorted-scan"]
+
+    def test_tainted_name_iteration_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import os
+            def scan(root):
+                names = os.listdir(root)
+                return [n for n in names]
+        """)
+        assert [v.rule for v in vs] == ["spmd-unsorted-scan"]
+
+    def test_glob_alias_and_smuggled_listdir_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import glob as _glob
+            from os import listdir
+            def scan(root):
+                for p in _glob.glob(root + "/*"):
+                    pass
+                for n in listdir(root):
+                    pass
+        """)
+        assert [v.rule for v in vs] == ["spmd-unsorted-scan"] * 2
+
+    def test_sorted_scan_is_clean(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import glob, os
+            def scan(root):
+                for name in sorted(os.listdir(root)):
+                    pass
+                for p in sorted(glob.glob(root + "/*")):
+                    pass
+        """)
+        assert vs == []
+
+    def test_order_insensitive_reducer_exempts_genexp(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import os
+            def scan(root):
+                n = len([x for x in os.listdir(root)])
+                newest = max(int(x) for x in os.listdir(root))
+                every = all(x for x in os.listdir(root))
+                return n, newest, every
+        """)
+        assert vs == []
+
+    def test_set_iteration_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            def f(items):
+                for x in set(items):
+                    pass
+                for y in {1, 2, 3}:
+                    pass
+        """)
+        assert [v.rule for v in vs] == ["spmd-unsorted-scan"] * 2
+
+    def test_sorted_set_is_clean(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            def f(items):
+                for x in sorted(set(items)):
+                    pass
+        """)
+        assert vs == []
+
+    def test_random_module_draws_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import random
+            import numpy as np
+            def f(items):
+                random.shuffle(items)
+                return np.random.randint(10)
+        """)
+        assert [v.rule for v in vs] == ["spmd-random"] * 2
+
+    def test_smuggled_draw_flagged(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            from random import choice
+            def f(items):
+                return choice(items)
+        """)
+        assert [v.rule for v in vs] == ["spmd-random"]
+
+    def test_jax_random_and_seeded_instances_clean(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import jax
+            import numpy as np
+            def f(seed):
+                key = jax.random.PRNGKey(seed)
+                key = jax.random.split(key)[0]
+                rng = np.random.RandomState(seed)
+                gen = np.random.default_rng(seed)
+                return key, rng.randn(3), gen.standard_normal(3)
+        """)
+        assert vs == []
+
+    def test_pragma_escapes_each_rule(self, tmp_path):
+        vs = self._lint_decision(tmp_path, """
+            import os, random
+            def f(root, items, key):
+                h = hash(key)  # mnlint: allow(spmd-hash)
+                # mnlint: allow(spmd-unsorted-scan)
+                for n in os.listdir(root):
+                    pass
+                random.shuffle(items)  # mnlint: allow(spmd-random)
+                return h
+        """)
+        assert vs == []
+
+    def test_rules_scoped_to_decision_modules(self, tmp_path):
+        """The same hazards OUTSIDE a decision module (and anywhere
+        with host_protocol off) are not flagged — the rules target
+        cross-rank decision surfaces, not all Python."""
+        src = """
+            import os, random
+            def f(root, items, key):
+                random.shuffle(items)
+                for n in os.listdir(root):
+                    pass
+                return hash(key)
+        """
+        vs = self._lint_decision(
+            tmp_path, src, name="chainermn_tpu/utils/mod.py"
+        )
+        assert vs == []
+        p = tmp_path / "chainermn_tpu/serving/off.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+        assert lint_file(str(p), str(tmp_path)) == []  # flag off
+
+    def test_spmd_allowlist_is_closed_and_empty(self):
+        """ISSUE 20 acceptance: serving/ and fleet/ are decision
+        modules and sit on NO sanctioned allowlist — not the raw-psum
+        one, not the timing one, and the SPMD allowlist itself is
+        empty by contract."""
+        from chainermn_tpu.analysis.lint import (
+            DECISION_MODULES,
+            SPMD_ALLOWLIST,
+            TIMING_SANCTIONED,
+        )
+
+        assert SPMD_ALLOWLIST == ()
+        for pkg in ("chainermn_tpu/serving/", "chainermn_tpu/fleet/"):
+            assert pkg in DECISION_MODULES
+            assert not any(pkg.startswith(p) for p in SANCTIONED)
+            assert not any(pkg.startswith(p) for p in TIMING_SANCTIONED)
+            assert not any(pkg.startswith(p) for p in SPMD_ALLOWLIST)
+
+
+class TestHostProtocolGate:
+    def test_repo_self_lints_clean_under_host_protocol(self):
+        """ISSUE 20 acceptance: the repo passes the FULL rule set —
+        the classic rules, the SPMD-determinism rules over every
+        decision module, and the protolint catalog rules — in tier-1."""
+        violations = run_lint(host_protocol=True)
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_cli_flag_folds_protolint_in(self, tmp_path):
+        import subprocess
+        import sys
+
+        bad = tmp_path / "offender.py"
+        bad.write_text("SHARD_TAG = 4242\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis.lint",
+             "--host-protocol", str(bad)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 1
+        assert "proto-magic-tag" in proc.stdout
+
+    def test_unsorted_listdir_fixture_trips_gate(self, tmp_path):
+        """The end-to-end satellite contract: a decision-module file
+        iterating a raw listdir fails the gate."""
+        p = tmp_path / "chainermn_tpu/fleet/bad.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(
+            "import os\n"
+            "def pick(root):\n"
+            "    return [d for d in os.listdir(root)]\n"
+        )
+        vs = run_lint([str(tmp_path)], str(tmp_path),
+                      host_protocol=True)
+        assert [v.rule for v in vs] == ["spmd-unsorted-scan"]
+
+    def test_flag_off_keeps_legacy_behaviour(self, tmp_path):
+        p = tmp_path / "chainermn_tpu/fleet/bad.py"
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text("import os\nX = [d for d in os.listdir('.')]\n")
+        assert run_lint([str(tmp_path)], str(tmp_path)) == []

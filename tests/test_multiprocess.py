@@ -386,6 +386,44 @@ class TestTraceDivergence:
         )
 
 
+class TestProtocolDivergence:
+    def test_guard_raises_on_both_ranks_before_deadlock(self, tmp_path):
+        """ISSUE 20 acceptance: rank 1 issues one extra obj-store
+        publish and swaps its two agreement-site orderings; the
+        host-protocol guard exchanges sequence hashes (through the
+        lockstep retry — phase 1 tears the guard's own payload and it
+        recovers) and raises ProtocolDivergenceError on BOTH ranks
+        while the world is still alive (this test's timeout is the
+        deadlock detector).  The per-rank recorded protocols merge
+        into the FleetReport post-mortem, which pinpoints the first
+        divergent exchange token."""
+        res = run_world(
+            "protocol_divergence", n_procs=2, local_devices=1,
+            tmpdir=tmp_path, timeout=240,
+            extra_env={
+                "CHAINERMN_TPU_PROTOCOL_RECORD": "1",
+                "CHAINERMN_TPU_DIVERGE_RANK": "1",
+            },
+        )
+        payloads = _assert_ok(res, "protocol_divergence")
+        assert all(
+            p["raised"] == "ProtocolDivergenceError" for p in payloads
+        )
+        # the torn-then-retried phase-1 agreement converged
+        assert payloads[0]["phase1"] == payloads[1]["phase1"]
+        assert all(p["entries"] > 0 for p in payloads)
+
+        from chainermn_tpu.fleet.report import FleetReport
+
+        rep = FleetReport.from_scratch(str(tmp_path))
+        div = rep.protocol_divergence("protodiv")
+        assert div is not None, "merged report must expose the divergence"
+        toks = div["tokens"]
+        # rank 1's extra publish is the first divergent token
+        assert toks[0] != toks[1]
+        assert "protocol divergence" in rep.post_mortem()
+
+
 class TestMismatchedSharding:
     def test_implicit_collectives_fail_both_ranks_before_dispatch(
         self, tmp_path

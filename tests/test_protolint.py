@@ -1,0 +1,522 @@
+"""protolint (ISSUE 20): the host-protocol analyzer's three layers.
+
+* the tag registry (``resilience/tags.py``) — disjoint reserved ranges;
+* the AST catalog + rules (``analysis/protolint.py``) — synthetic
+  fixtures trip each rule, the repo's own catalog is clean;
+* the runtime recorder + guard (``resilience/protocol.py`` /
+  ``analysis.checks.protocol_agreement``) — including the pinned
+  disabled-path contract (one ``is None`` check, shared null context)
+  and the FleetReport protocol merge;
+* the determinism fixes the lint forced (sorted scans in
+  ``serving/replica.py`` and ``extensions/checkpoint.py``), each pinned
+  against a reversed-``listdir`` filesystem.
+
+Fast by construction: AST + in-memory recorders, no jax world.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from chainermn_tpu.analysis import protolint
+from chainermn_tpu.analysis.protolint import (
+    build_catalog,
+    run_protolint,
+    scan_file,
+)
+from chainermn_tpu.resilience import protocol, tags
+from chainermn_tpu.resilience.errors import ProtocolDivergenceError
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_recorder():
+    """Every test must leave the process-global recorder disabled."""
+    yield
+    assert protocol.active() is None, "test leaked a ProtocolRecorder"
+    protocol.install(None)
+
+
+def _scan_src(tmp_path, src, name="mod.py"):
+    p = tmp_path / name
+    p.parent.mkdir(parents=True, exist_ok=True)
+    p.write_text(textwrap.dedent(src))
+    return scan_file(str(p), str(tmp_path))
+
+
+# ----------------------------------------------------------------------
+# tag registry
+# ----------------------------------------------------------------------
+class TestTagRegistry:
+    def test_reserved_ranges_are_disjoint(self):
+        spans = sorted(
+            (r.start, r.stop, r.name) for r in tags.ranges()
+        )
+        for (s0, e0, n0), (s1, e1, n1) in zip(spans, spans[1:]):
+            assert e0 <= s1, f"{n0} overlaps {n1}"
+
+    def test_register_rejects_overlap_and_duplicate(self):
+        with pytest.raises(ValueError):
+            tags.register("clash", tags.PEER_CKPT_RING, 1)
+        with pytest.raises(ValueError):
+            tags.register("peer_ckpt.ring", 99999, 1)
+
+    def test_owner_range_resolves_every_registered_tag(self):
+        r = tags.owner_range(tags.PEER_CKPT_RING)
+        assert r is not None and r.name == "peer_ckpt.ring"
+        assert tags.owner_range(tags.DEFAULT).name == "default"
+        assert tags.owner_range(10**9) is None
+
+    def test_peer_owner_tag_bounds(self):
+        t0 = tags.peer_owner_tag(0)
+        assert tags.owner_range(t0).name == "peer_ckpt.restore"
+        assert tags.peer_owner_tag(1) == t0 + 1
+        with pytest.raises(ValueError):
+            tags.peer_owner_tag(tags.MAX_PEER_RESTORE_OWNERS)
+
+    def test_user_tags_are_identity_within_range(self):
+        assert tags.user_tag(1) == 1
+        assert tags.user_tag(4095) == 4095
+        with pytest.raises(ValueError):
+            tags.user_tag(0)
+        with pytest.raises(ValueError):
+            tags.user_tag(4096)
+
+
+# ----------------------------------------------------------------------
+# catalog extraction
+# ----------------------------------------------------------------------
+class TestCatalogExtraction:
+    def test_lockstep_sites_resolved_from_literals_and_constants(
+        self, tmp_path
+    ):
+        sites, _ = _scan_src(tmp_path, """\
+            SITE = "my.agree"
+            def f(comm):
+                lockstep_allgather(comm, 1, site="direct.literal")
+                lockstep_allgather(comm, 2, site=SITE)
+        """)
+        names = {s.site for s in sites if s.kind == "lockstep"}
+        assert names == {"direct.literal", "my.agree"}
+        assert all(not s.dynamic for s in sites)
+
+    def test_fstring_site_is_dynamic_prefix(self, tmp_path):
+        sites, _ = _scan_src(tmp_path, """\
+            def f(comm, label):
+                lockstep_allgather(comm, 1, site=f"agree({label})")
+        """)
+        (s,) = [s for s in sites if s.kind == "lockstep"]
+        assert s.dynamic and s.site == "agree(*"
+
+    def test_p2p_tags_classified_by_source(self, tmp_path):
+        sites, _ = _scan_src(tmp_path, """\
+            from chainermn_tpu.resilience.tags import PEER_CKPT_RING
+            def f(comm):
+                comm.send_obj(1, dest=0)                 # default
+                comm.send_obj(1, dest=0, tag=0)          # default
+                comm.send_obj(1, dest=0, tag=PEER_CKPT_RING)  # registry
+                comm.recv_obj(source=0, tag=9)  # mnlint: allow(proto-magic-tag)
+        """)
+        srcs = [s.tag_source for s in sites
+                if s.kind in ("send", "recv")]
+        assert srcs == ["default", "default", "registry", "literal"]
+
+    def test_atomic_write_and_collectives_cataloged(self, tmp_path):
+        sites, _ = _scan_src(tmp_path, """\
+            import json, os
+            def write(doc, path):  # mnlint: allow(proto-adhoc-manifest)
+                with open(path + ".tmp", "w") as f:
+                    json.dump(doc, f)
+                os.replace(path + ".tmp", path)
+            def g(comm):
+                comm.bcast_obj(1)  # mnlint: allow(x)
+        """)
+        kinds = {s.kind for s in sites}
+        assert "atomic_write" in kinds and "exchange" in kinds
+
+
+# ----------------------------------------------------------------------
+# catalog rules
+# ----------------------------------------------------------------------
+class TestCatalogRules:
+    def test_duplicate_site_flagged_across_files(self, tmp_path):
+        for name in ("a.py", "b.py"):
+            (tmp_path / name).write_text(
+                'def f(c):\n    lockstep_allgather(c, 1, site="dup.x")\n'
+            )
+        _, violations = run_protolint([str(tmp_path)], str(tmp_path))
+        dups = [v for v in violations
+                if v.rule == "proto-duplicate-site"]
+        assert len(dups) == 2  # flagged at BOTH declaring call sites
+        assert all("dup.x" in v.message for v in dups)
+
+    def test_unique_and_dynamic_sites_not_flagged(self, tmp_path):
+        (tmp_path / "a.py").write_text(textwrap.dedent("""\
+            def f(c, label):
+                lockstep_allgather(c, 1, site="only.once")
+                lockstep_allgather(c, 1, site=f"per({label})")
+                lockstep_allgather(c, 2, site=f"per({label})")
+        """))
+        _, violations = run_protolint([str(tmp_path)], str(tmp_path))
+        assert violations == []
+
+    def test_raw_allgather_flagged_outside_sanctioned(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            def f(comm):
+                return comm.allgather_obj(1)
+        """, name="chainermn_tpu/extensions/thing.py")
+        assert [x.rule for x in v] == ["proto-raw-allgather"]
+
+    def test_raw_allgather_sanctioned_in_transport(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            def f(comm):
+                return comm.allgather_obj(1)
+        """, name="chainermn_tpu/resilience/retry.py")
+        assert v == []
+
+    def test_magic_tag_literal_and_arithmetic_flagged(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            BASE = 7000
+            def f(comm, o):
+                comm.send_obj(1, dest=0, tag=42)
+                comm.send_obj(1, dest=0, tag=BASE + 1 + o)
+        """)
+        assert [x.rule for x in v] == ["proto-magic-tag"] * 2
+
+    def test_hand_reserved_tag_constant_flagged(self, tmp_path):
+        _, v = _scan_src(tmp_path, "PEER_TAG = 7919\n")
+        assert [x.rule for x in v] == ["proto-magic-tag"]
+        assert "resilience/tags.py" in v[0].message
+
+    def test_registry_resolved_tags_clean(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            from chainermn_tpu.resilience import tags as _tags
+            from chainermn_tpu.resilience.tags import peer_owner_tag
+            def f(comm, o):
+                comm.send_obj(1, dest=0, tag=peer_owner_tag(o))
+                comm.send_obj(1, dest=0, tag=_tags.DEFAULT)
+        """)
+        assert v == []
+
+    def test_adhoc_manifest_flagged_pickle_exempt(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            import json, os, pickle
+            def bad(doc, path):
+                with open(path + ".tmp", "w") as f:
+                    json.dump(doc, f)
+                os.rename(path + ".tmp", path)
+            def binary_commit(obj, path):
+                with open(path + ".tmp", "wb") as f:
+                    pickle.dump(obj, f)
+                os.rename(path + ".tmp", path)
+        """)
+        assert [x.rule for x in v] == ["proto-adhoc-manifest"]
+        assert "bad()" in v[0].message
+
+    def test_manifest_rule_sanctions_elastic(self, tmp_path):
+        _, v = _scan_src(tmp_path, """\
+            import json, os
+            def write_manifest(doc, path):
+                with open(path + ".tmp", "w") as f:
+                    json.dump(doc, f)
+                os.replace(path + ".tmp", path)
+        """, name="chainermn_tpu/resilience/elastic.py")
+        assert v == []
+
+
+# ----------------------------------------------------------------------
+# the repo's own catalog
+# ----------------------------------------------------------------------
+class TestRepoCatalog:
+    def test_repo_catalog_is_clean(self):
+        """Acceptance: the package's host protocol passes every catalog
+        rule — unique sites, lockstep-wrapped allgathers, registry
+        tags, one manifest writer."""
+        _, violations = run_protolint()
+        assert violations == [], "\n".join(str(v) for v in violations)
+
+    def test_known_agreement_sites_cataloged_and_unique(self):
+        catalog = build_catalog()
+        names = catalog.site_names()
+        assert len(names) == len(set(names)), names
+        for expected in ("evaluator.aggregate", "fleet.rendezvous",
+                         "checkpoint.newest_common_step",
+                         "peer_ckpt.replicate", "adaptive.agree"):
+            assert expected in names, f"{expected} missing from {names}"
+
+    def test_console_entry_is_a_gate(self, tmp_path):
+        import subprocess
+        import sys
+
+        bad = tmp_path / "offender.py"
+        bad.write_text("MY_TAG = 31337\n")
+        proc = subprocess.run(
+            [sys.executable, "-m", "chainermn_tpu.analysis.protolint",
+             str(bad)],
+            capture_output=True, text=True, cwd=REPO,
+            env={**os.environ, "JAX_PLATFORMS": "cpu"},
+        )
+        assert proc.returncode == 1
+        assert "proto-magic-tag" in proc.stdout
+
+
+# ----------------------------------------------------------------------
+# runtime recorder
+# ----------------------------------------------------------------------
+class TestRecorder:
+    def test_disabled_path_is_the_null_fast_path(self):
+        """The pinned zero-overhead contract (telemetry's twin): with
+        no recorder installed, the hook is one ``is None`` check and
+        the site/asymmetric markers return the SHARED null context —
+        no allocation, no lock."""
+        assert protocol.active() is None
+        protocol.record_op("send", tag=1, peer=0, payload=b"x")
+        assert protocol.exchange_site("s") is protocol._NULL
+        assert protocol.asymmetric() is protocol._NULL
+
+    def test_obj_store_ops_recorded_with_site_and_digest(self):
+        from chainermn_tpu.communicators._obj_store import LocalObjStore
+
+        store = LocalObjStore(size=2)
+        with protocol.observe(rank=0, world=2) as rec:
+            with protocol.exchange_site("unit.agree"):
+                store.allgather("ha")
+            store.send("payload", dest=1, tag=5)
+            store.recv_for(dest=1, tag=5)
+        toks = [e["token"] for e in rec.entries()]
+        assert toks[0] == "exchange|unit.agree"
+        assert toks[1] == "send|tag=5|peer=+1"
+        ents = rec.entries()
+        assert ents[1]["digest"] and ents[1]["nbytes"] > 0
+
+    def test_relative_peer_normalization_makes_rings_agree(self):
+        sigs = []
+        for rank in (0, 1, 2):
+            with protocol.observe(rank=rank, world=3) as rec:
+                protocol.record_op("send", tag=7, peer=(rank + 1) % 3)
+                protocol.record_op("recv", tag=7, peer=(rank - 1) % 3)
+            sigs.append(rec.signature())
+        assert sigs[0] == sigs[1] == sigs[2]
+        assert sigs[0] == ["send|tag=7|peer=+1", "recv|tag=7|peer=+2"]
+
+    def test_asymmetric_ops_logged_but_unsigned(self):
+        with protocol.observe(rank=0, world=2) as rec:
+            protocol.record_op("send", tag=1, peer=1)
+            with protocol.asymmetric():
+                protocol.record_op("send", tag=2, peer=1)
+        assert len(rec.entries()) == 2
+        assert rec.signature() == ["send|tag=1|peer=+1"]
+        assert rec.entries()[1]["asymmetric"] is True
+
+    def test_window_advances_on_mark_agreed(self):
+        with protocol.observe() as rec:
+            protocol.record_op("send", tag=1, peer=0)
+            assert len(rec.window_signature()) == 1
+            rec.mark_agreed()
+            assert rec.window_signature() == []
+            protocol.record_op("recv", tag=1, peer=0)
+            assert len(rec.window_signature()) == 1
+
+    def test_payload_digest_excluded_from_signature(self):
+        sigs = []
+        for payload in (b"rank0-data", b"rank1-data"):
+            with protocol.observe(rank=0, world=2) as rec:
+                protocol.record_op("send", tag=1, peer=1,
+                                   payload=payload)
+            sigs.append(protocol.signature_hash(rec.signature()))
+        assert sigs[0] == sigs[1]
+
+    def test_jsonl_roundtrip(self, tmp_path):
+        with protocol.observe(label="x_p0", rank=0, world=2) as rec:
+            protocol.record_op("send", tag=3, peer=1, payload=b"z")
+        path = rec.to_jsonl(str(tmp_path / "x_p0_protocol.jsonl"))
+        rows = [json.loads(l) for l in open(path)]
+        assert rows[0]["token"] == "send|tag=3|peer=+1"
+        assert rows[0]["seq"] == 0
+
+    def test_env_activation(self, monkeypatch):
+        monkeypatch.delenv(protocol.ENV_RECORD, raising=False)
+        assert protocol.install_from_env(label="a") is None
+        monkeypatch.setenv(protocol.ENV_RECORD, "1")
+        rec = protocol.install_from_env(label="a", rank=0, world=2)
+        assert rec is protocol.active()
+        protocol.install(None)
+
+
+# ----------------------------------------------------------------------
+# the agreement guard
+# ----------------------------------------------------------------------
+class _FakeComm:
+    """lockstep_allgather target: returns this rank's payload plus a
+    scripted remote payload."""
+
+    def __init__(self, remote_payloads):
+        self.remote = remote_payloads
+
+    def allgather_obj(self, payload):
+        return [payload] + list(self.remote)
+
+
+def _remote_view(sig):
+    from chainermn_tpu.resilience.protocol import signature_hash
+
+    return {"hash": signature_hash(sig), "n": len(sig),
+            "tail": sig[-8:], "sig": sig}
+
+
+class TestProtocolAgreement:
+    def test_requires_a_recorder(self):
+        from chainermn_tpu.analysis import protocol_agreement
+
+        with pytest.raises(RuntimeError, match="PROTOCOL_RECORD"):
+            protocol_agreement(_FakeComm([]))
+
+    def test_agreement_passes_and_advances_cursor(self):
+        from chainermn_tpu.analysis import protocol_agreement
+
+        with protocol.observe(rank=0, world=2) as rec:
+            protocol.record_op("send", tag=1, peer=1)
+            mine = rec.window_signature()
+            comm = _FakeComm([_remote_view(mine)])
+            h = protocol_agreement(comm, label="unit")
+        assert h == protocol.signature_hash(mine)
+        # cursor advanced past the checked window AND the guard's own
+        # (symmetric, but fake here) exchange
+        assert rec.window_signature() == []
+
+    def test_divergence_raises_non_recoverable_with_index(self):
+        from chainermn_tpu.analysis import protocol_agreement
+
+        with protocol.observe(rank=0, world=2) as rec:
+            protocol.record_op("send", tag=1, peer=1)
+            protocol.record_op("recv", tag=1, peer=1)
+            other = ["send|tag=1|peer=+1", "send|tag=6|peer=+1",
+                     "recv|tag=1|peer=+1"]
+            comm = _FakeComm([_remote_view(other)])
+            with pytest.raises(ProtocolDivergenceError) as ei:
+                protocol_agreement(comm, label="unit")
+        assert ei.value.recoverable is False
+        assert "index 1" in str(ei.value)
+        # a FAILED agreement must NOT advance the cursor
+        assert len(rec.window_signature()) >= 2
+
+    def test_exported_error_names(self):
+        import chainermn_tpu.analysis as ana
+
+        assert ana.ProtocolDivergenceError is ProtocolDivergenceError
+        assert callable(ana.protocol_agreement)
+
+
+# ----------------------------------------------------------------------
+# FleetReport protocol merge
+# ----------------------------------------------------------------------
+class TestFleetReportProtocol:
+    def _write(self, scratch, pid, tokens, asym_at=()):
+        rows = [
+            {"seq": i, "token": t, "asymmetric": i in asym_at}
+            for i, t in enumerate(tokens)
+        ]
+        with open(os.path.join(
+            scratch, f"leg0_p{pid}_protocol.jsonl"
+        ), "w") as f:
+            for r in rows:
+                f.write(json.dumps(r) + "\n")
+
+    def test_agreeing_protocols_report_no_divergence(self, tmp_path):
+        from chainermn_tpu.fleet.report import FleetReport
+
+        toks = ["exchange|a", "send|tag=1|peer=+1"]
+        self._write(str(tmp_path), 0, toks)
+        self._write(str(tmp_path), 1, toks)
+        rep = FleetReport.from_scratch(str(tmp_path))
+        assert rep.protocol_sequences() == {0: toks, 1: toks}
+        assert rep.protocol_divergence() is None
+
+    def test_divergence_pinpoints_first_mismatched_token(self, tmp_path):
+        from chainermn_tpu.fleet.report import FleetReport
+
+        self._write(str(tmp_path), 0, ["exchange|a", "exchange|b"])
+        self._write(str(tmp_path), 1,
+                    ["exchange|a", "exchange|EXTRA", "exchange|b"])
+        rep = FleetReport.from_scratch(str(tmp_path))
+        div = rep.protocol_divergence()
+        assert div == {
+            "leg": "leg0", "index": 1,
+            "tokens": {0: "exchange|b", 1: "exchange|EXTRA"},
+        }
+        assert "protocol divergence" in rep.post_mortem()
+
+    def test_asymmetric_rows_excluded_from_comparison(self, tmp_path):
+        from chainermn_tpu.fleet.report import FleetReport
+
+        # rank 0 healed a peer (asymmetric send) — NOT a divergence
+        self._write(str(tmp_path), 0,
+                    ["exchange|a", "send|tag=8000|peer=+1"],
+                    asym_at={1})
+        self._write(str(tmp_path), 1, ["exchange|a"])
+        rep = FleetReport.from_scratch(str(tmp_path))
+        assert rep.protocol_divergence() is None
+
+
+# ----------------------------------------------------------------------
+# determinism fixes pinned against a hostile filesystem order
+# ----------------------------------------------------------------------
+class TestDeterminismFixes:
+    def test_journal_scans_invariant_under_listdir_order(
+        self, tmp_path, monkeypatch
+    ):
+        """The spmd-unsorted-scan fixes in serving/replica.py: results
+        / draining / handoffs return identical values when listdir
+        yields reverse order (two hosts disagreeing on directory order
+        must still agree on the scan)."""
+        from chainermn_tpu.serving.replica import RequestJournal
+
+        j = RequestJournal(str(tmp_path))
+        for i in range(4):
+            with open(os.path.join(
+                str(tmp_path), f"res_r{i}.json"
+            ), "w") as f:
+                json.dump({"id": f"r{i}", "state": "done",
+                           "tokens": [i]}, f)
+            with open(os.path.join(
+                str(tmp_path), f"drain_{i}.json"
+            ), "w") as f:
+                json.dump({}, f)
+            open(j.handoff_path(f"r{i}"), "wb").close()
+
+        forward = (j.results(), j.draining(), j.handoffs())
+        real = os.listdir
+        monkeypatch.setattr(
+            os, "listdir",
+            lambda p: sorted(real(p), reverse=True),
+        )
+        assert (j.results(), j.draining(), j.handoffs()) == forward
+        assert list(forward[0]) == sorted(forward[0])
+
+    def test_checkpoint_step_inventory_invariant(
+        self, tmp_path, monkeypatch
+    ):
+        """extensions/checkpoint.py:_available_steps feeds
+        newest_common_step's cross-rank agreement — the scan must not
+        depend on listdir order."""
+        from chainermn_tpu.extensions.checkpoint import (
+            _MultiNodeCheckpointer,
+        )
+
+        ck = object.__new__(_MultiNodeCheckpointer)
+        ck._root = str(tmp_path)
+        ck._verified = {}
+        ck._is_complete = lambda path: True
+        for s in (3, 1, 2):
+            os.makedirs(os.path.join(str(tmp_path), f"step_{s:012d}"))
+
+        assert ck._available_steps() == [1, 2, 3]
+        real = os.listdir
+        monkeypatch.setattr(
+            os, "listdir",
+            lambda p: sorted(real(p), reverse=True),
+        )
+        assert ck._available_steps() == [1, 2, 3]
